@@ -1,0 +1,20 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference-serving framework.
+
+A ground-up re-imagining of NVIDIA Dynamo's capability set
+(reference: /root/reference, `faradawn/dynamo`) for TPU hardware:
+
+- compute path: JAX / XLA / Pallas, SPMD over `jax.sharding.Mesh`
+- KV cache: paged, sharded device arrays with multi-tier offload
+- parallelism: TP / DP / EP / PP / sequence(ring) via mesh axes + XLA
+  collectives over ICI
+- control plane: component/endpoint model with leases, watches and
+  pub/sub (in-memory for single-process, TCP control-plane server for
+  multi-process)
+- serving: OpenAI-compatible HTTP frontend, KV-aware router,
+  disaggregated prefill/decode, planner-driven autoscaling
+
+Layer map mirrors the reference (SURVEY.md §1): runtime → llm → engine →
+workers/frontend, but every layer is TPU-first rather than a port.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,232 @@
+"""Token containers and chained block hashing.
+
+Serves the role of the reference's token library (`lib/tokens/src/lib.rs`,
+`lib/llm/src/tokens.rs:49-435`): fixed-size token blocks whose identity is a
+*chained* hash — each block's hash commits to the full prefix up to and
+including the block — so two sequences share a block hash iff they share the
+entire prefix.  These sequence hashes are the keys of the KV-cache world:
+the router's radix index, the block-manager reuse pools and the KV events
+all speak them.
+
+Hash function: xxh3_64 over (parent_hash_le64 || tokens_le_u32...), with a
+fixed salt for the root.  Pure-Python/NumPy; hot batch path vectorizes with
+numpy + xxhash over byte views.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import xxhash
+
+# Salt used as the "parent hash" of the first block of a sequence, so that
+# hash(block0) differs from a raw content hash (defensive versus accidental
+# collisions with other hash domains, e.g. local block hashes).
+ROOT_PARENT_HASH = 0xD1A0_0000_0000_0001
+
+TokenId = int
+
+
+def _as_u32(tokens) -> np.ndarray:
+    """Coerce tokens to uint32, raising (never wrapping) on out-of-range ids.
+
+    Silent u32 wrap-around would alias cache keys across distinct tokens, so
+    both the Python-int path (OverflowError) and the numpy-array path (which
+    numpy would happily wrap) must reject out-of-range values.
+    """
+    arr = np.asarray(tokens)
+    if arr.dtype == np.uint32:
+        return arr
+    if not np.issubdtype(arr.dtype, np.integer):
+        try:
+            arr = arr.astype(np.int64)
+        except (ValueError, OverflowError, TypeError) as e:
+            raise ValueError(f"token ids must be integers: {e}") from e
+    if arr.size and (arr.min() < 0 or arr.max() > 0xFFFFFFFF):
+        raise ValueError(
+            f"token ids must fit in uint32, got range [{arr.min()}, {arr.max()}]"
+        )
+    return arr.astype(np.uint32)
+
+
+def hash_block(parent_hash: int, tokens: Sequence[int]) -> int:
+    """Chained sequence hash of one block given its parent's sequence hash."""
+    h = xxhash.xxh3_64()
+    h.update(struct.pack("<Q", parent_hash & 0xFFFFFFFFFFFFFFFF))
+    h.update(_as_u32(tokens).tobytes())
+    return h.intdigest()
+
+
+def compute_block_hashes(
+    tokens: Sequence[int], block_size: int, parent_hash: int = ROOT_PARENT_HASH
+) -> List[int]:
+    """Sequence hashes for every *complete* block of `tokens`.
+
+    Analog of the reference's `compute_block_hash_for_seq`
+    (`lib/llm/src/kv_router/indexer.rs:123`).  The trailing partial block (if
+    any) is not hashed — only full blocks are eligible for reuse/routing.
+    """
+    if block_size <= 0:
+        raise ValueError(f"block_size must be positive, got {block_size}")
+    try:
+        arr = _as_u32(tokens)
+    except OverflowError as e:
+        raise ValueError(f"token ids must fit in uint32: {e}") from e
+    n_full = len(arr) // block_size
+    hashes: List[int] = []
+    h = parent_hash
+    for i in range(n_full):
+        h = hash_block(h, arr[i * block_size : (i + 1) * block_size])
+        hashes.append(h)
+    return hashes
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """A complete, immutable block of `block_size` tokens.
+
+    `block_hash` is the chained sequence hash (commits to the whole prefix);
+    `parent_hash` is the previous block's sequence hash (ROOT_PARENT_HASH for
+    the first block).
+    """
+
+    tokens: Tuple[TokenId, ...]
+    block_hash: int
+    parent_hash: int
+    position: int  # block index within its sequence
+
+
+class TokenBlockSequence:
+    """Incrementally maintains the block decomposition + chained hashes of a
+    growing token sequence (reference `TokenBlockSequence`,
+    `lib/llm/src/tokens.rs:394-435`).
+
+    Append tokens one at a time (decode) or in bulk (prefill); complete
+    blocks are frozen with their sequence hash, the partial tail stays
+    mutable.
+    """
+
+    def __init__(self, tokens: Optional[Iterable[TokenId]] = None, block_size: int = 64):
+        if block_size <= 0:
+            raise ValueError(f"block_size must be positive, got {block_size}")
+        self.block_size = block_size
+        self.blocks: List[TokenBlock] = []
+        self._partial: List[TokenId] = []
+        if tokens is not None:
+            self.extend(tokens)
+
+    # -- mutation ---------------------------------------------------------
+    def append(self, token: TokenId) -> Optional[TokenBlock]:
+        """Append one token; returns the newly completed block, if any."""
+        token = int(token)
+        if not 0 <= token <= 0xFFFFFFFF:
+            # Validate before mutating so a bad token cannot leave _partial
+            # oversized and wedge block sealing.
+            raise ValueError(f"token id must fit in uint32, got {token}")
+        self._partial.append(token)
+        if len(self._partial) >= self.block_size:
+            return self._seal()
+        return None
+
+    def extend(self, tokens: Iterable[TokenId]) -> List[TokenBlock]:
+        """Append many tokens in bulk; returns all blocks completed by this
+        call.  Bulk path: validates once, seals whole blocks from numpy views
+        instead of per-token appends (prefill prompts can be 100k+ tokens).
+        """
+        arr = _as_u32(list(tokens) if not isinstance(tokens, (list, np.ndarray)) else tokens)
+        new_blocks: List[TokenBlock] = []
+        pos = 0
+        n = len(arr)
+        while pos < n:
+            take = min(self.block_size - len(self._partial), n - pos)
+            self._partial.extend(int(t) for t in arr[pos : pos + take])
+            pos += take
+            if len(self._partial) >= self.block_size:
+                new_blocks.append(self._seal())
+        return new_blocks
+
+    def truncate(self, length: int) -> None:
+        """Truncate the sequence to `length` tokens.
+
+        Chained hashes of a prefix never change, so retained full blocks are
+        kept as-is; only the partial tail is rebuilt (rollback — e.g. rejected
+        speculative tokens — must be O(dropped), not O(sequence)).
+        """
+        if length < 0 or length > len(self):
+            raise ValueError(f"cannot truncate length {len(self)} to {length}")
+        keep_blocks = length // self.block_size
+        tail_len = length - keep_blocks * self.block_size
+        if tail_len == 0:
+            tail: List[TokenId] = []
+        elif keep_blocks < len(self.blocks):
+            tail = list(self.blocks[keep_blocks].tokens[:tail_len])
+        else:
+            tail = self._partial[:tail_len]
+        self.blocks = self.blocks[:keep_blocks]
+        self._partial = tail
+
+    def _seal(self) -> TokenBlock:
+        assert len(self._partial) == self.block_size
+        parent = self.blocks[-1].block_hash if self.blocks else ROOT_PARENT_HASH
+        blk = TokenBlock(
+            tokens=tuple(self._partial),
+            block_hash=hash_block(parent, self._partial),
+            parent_hash=parent,
+            position=len(self.blocks),
+        )
+        self.blocks.append(blk)
+        self._partial = []
+        return blk
+
+    # -- views ------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.blocks) * self.block_size + len(self._partial)
+
+    @property
+    def tokens(self) -> List[TokenId]:
+        out: List[TokenId] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self._partial)
+        return out
+
+    @property
+    def partial_tokens(self) -> Tuple[TokenId, ...]:
+        return tuple(self._partial)
+
+    @property
+    def block_hashes(self) -> List[int]:
+        return [b.block_hash for b in self.blocks]
+
+    def last_hash(self) -> int:
+        return self.blocks[-1].block_hash if self.blocks else ROOT_PARENT_HASH
+
+
+@dataclass
+class SaltedBlockHasher:
+    """Per-model/per-tenant hash domain separation: mixes a salt into the
+    root parent hash so identical token streams in different domains do not
+    share cache identity (lora adapters, different models behind one router).
+    """
+
+    salt: bytes = b""
+    _root: int = field(init=False)
+
+    def __post_init__(self) -> None:
+        if self.salt:
+            h = xxhash.xxh3_64()
+            h.update(struct.pack("<Q", ROOT_PARENT_HASH))
+            h.update(self.salt)
+            self._root = h.intdigest()
+        else:
+            self._root = ROOT_PARENT_HASH
+
+    @property
+    def root(self) -> int:
+        return self._root
+
+    def block_hashes(self, tokens: Sequence[int], block_size: int) -> List[int]:
+        return compute_block_hashes(tokens, block_size, parent_hash=self._root)

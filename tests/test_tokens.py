@@ -1,0 +1,112 @@
+import pytest
+
+from dynamo_tpu.tokens import (
+    ROOT_PARENT_HASH,
+    SaltedBlockHasher,
+    TokenBlockSequence,
+    compute_block_hashes,
+    hash_block,
+)
+
+
+def test_hash_determinism():
+    assert hash_block(ROOT_PARENT_HASH, [1, 2, 3]) == hash_block(ROOT_PARENT_HASH, [1, 2, 3])
+    assert hash_block(ROOT_PARENT_HASH, [1, 2, 3]) != hash_block(ROOT_PARENT_HASH, [1, 2, 4])
+    assert hash_block(1, [1, 2, 3]) != hash_block(2, [1, 2, 3])
+
+
+def test_chained_hashes_commit_to_prefix():
+    a = compute_block_hashes(list(range(128)), 64)
+    b = compute_block_hashes(list(range(64)) + list(range(100, 164)), 64)
+    assert len(a) == len(b) == 2
+    assert a[0] == b[0]  # shared first block
+    assert a[1] != b[1]  # diverged second block
+    # same second-block *content* with different prefix hashes differently
+    c = compute_block_hashes(list(range(1, 65)) + list(range(64, 128)), 64)
+    assert c[1] != a[1]
+
+
+def test_partial_block_not_hashed():
+    assert compute_block_hashes(list(range(63)), 64) == []
+    assert len(compute_block_hashes(list(range(65)), 64)) == 1
+
+
+def test_token_block_sequence_incremental_matches_bulk():
+    toks = list(range(200))
+    seq = TokenBlockSequence(block_size=16)
+    completed = []
+    for t in toks:
+        blk = seq.append(t)
+        if blk:
+            completed.append(blk.block_hash)
+    assert completed == compute_block_hashes(toks, 16)
+    assert len(seq) == 200
+    assert seq.tokens == toks
+    assert len(seq.partial_tokens) == 200 % 16
+
+
+def test_token_block_sequence_truncate():
+    seq = TokenBlockSequence(range(100), block_size=16)
+    seq.truncate(40)
+    assert len(seq) == 40
+    assert seq.block_hashes == compute_block_hashes(list(range(40)), 16)
+
+
+def test_salted_hasher_domain_separation():
+    toks = list(range(64))
+    plain = compute_block_hashes(toks, 64)
+    salted = SaltedBlockHasher(salt=b"lora-x").block_hashes(toks, 64)
+    assert plain != salted
+    assert SaltedBlockHasher().block_hashes(toks, 64) == plain
+
+
+def test_bad_block_size():
+    with pytest.raises(ValueError):
+        compute_block_hashes([1], 0)
+
+
+def test_numpy_array_input_no_silent_wrap():
+    import numpy as np
+
+    with pytest.raises(ValueError):
+        compute_block_hashes(np.array([-1, 5, 6, 7], dtype=np.int64), 4)
+    with pytest.raises(ValueError):
+        compute_block_hashes(np.array([2**33, 5, 6, 7], dtype=np.int64), 4)
+    # valid numpy input matches list input
+    assert compute_block_hashes(np.array([1, 2, 3, 4], dtype=np.int64), 4) == compute_block_hashes(
+        [1, 2, 3, 4], 4
+    )
+
+
+def test_append_bad_token_does_not_wedge_sealing():
+    seq = TokenBlockSequence(block_size=2)
+    seq.append(1)
+    with pytest.raises(ValueError):
+        seq.append(2**33)
+    assert seq.append(2) is not None  # sealing still works
+    assert seq.block_hashes == compute_block_hashes([1, 2], 2)
+
+
+def test_bulk_extend_matches_per_token():
+    toks = list(range(1000))
+    a = TokenBlockSequence(block_size=16)
+    a.extend(toks)
+    b = TokenBlockSequence(block_size=16)
+    for t in toks:
+        b.append(t)
+    assert a.block_hashes == b.block_hashes
+    assert a.tokens == b.tokens
+
+
+def test_truncate_preserves_prefix_blocks_identity():
+    seq = TokenBlockSequence(range(100), block_size=16)
+    before = seq.blocks[:4]
+    seq.truncate(70)  # 4 full blocks + 6 tail
+    assert seq.blocks == before
+    assert seq.tokens == list(range(70))
+    seq.truncate(64)
+    assert seq.tokens == list(range(64))
+    # truncate into partial tail only
+    s2 = TokenBlockSequence(range(10), block_size=16)
+    s2.truncate(3)
+    assert s2.tokens == [0, 1, 2]
