@@ -1,0 +1,139 @@
+"""KvRouter: the routing decision engine.
+
+Role of the reference's `lib/llm/src/kv_router.rs` (KvRouterConfig :76,
+KvRouter :145): combine
+
+  - overlap scores from the (exact or approximate) indexer,
+  - router-local optimistic load (ActiveSequences),
+  - the worker selector's cost/sampling policy,
+
+into `find_best_match(request_id, tokens) -> (worker, overlap_blocks)`,
+and keep the optimistic accounting in sync with the request lifecycle
+(prefill done / token pushed / freed).
+
+Transport-agnostic: candidate workers are provided by the caller (the
+runtime's client watches instance liveness); KV events arrive via
+`apply_event`.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from dynamo_tpu.llm.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+from dynamo_tpu.llm.kv_router.protocols import RouterEvent, WorkerId
+from dynamo_tpu.llm.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KVHitRateEvent,
+    WorkerLoadSnapshot,
+)
+from dynamo_tpu.llm.kv_router.sequence import ActiveSequencesMultiWorker
+from dynamo_tpu.tokens import compute_block_hashes
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class KvRouterConfig:
+    block_size: int = 64
+    overlap_score_weight: float = 1.0
+    temperature: float = 0.0
+    # Exact indexer (engine emits KV events) vs TTL-based approximation.
+    use_kv_events: bool = True
+    approx_ttl_secs: float = 120.0
+
+
+class KvRouter:
+    def __init__(
+        self,
+        config: Optional[KvRouterConfig] = None,
+        on_hit_rate_event: Optional[Callable[[KVHitRateEvent], None]] = None,
+    ) -> None:
+        self.config = config or KvRouterConfig()
+        self.indexer: Optional[KvIndexer] = (
+            KvIndexer(self.config.block_size) if self.config.use_kv_events else None
+        )
+        self.approx: Optional[ApproxKvIndexer] = (
+            None
+            if self.config.use_kv_events
+            else ApproxKvIndexer(self.config.block_size, self.config.approx_ttl_secs)
+        )
+        self.active = ActiveSequencesMultiWorker(self.config.block_size)
+        self.selector = DefaultWorkerSelector(
+            overlap_score_weight=self.config.overlap_score_weight,
+            temperature=self.config.temperature,
+            on_hit_rate_event=on_hit_rate_event,
+        )
+
+    # -- event ingestion --------------------------------------------------
+    def apply_event(self, ev: RouterEvent) -> None:
+        if self.indexer:
+            self.indexer.apply_event(ev)
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        if self.indexer:
+            self.indexer.remove_worker(worker)
+        if self.approx:
+            self.approx.remove_worker(worker)
+        self.active.remove_worker(worker)
+
+    # -- routing ----------------------------------------------------------
+    def find_best_match(
+        self,
+        request_id: str,
+        token_ids: Sequence[int],
+        workers: Sequence[WorkerId],
+        update_states: bool = True,
+    ) -> Tuple[WorkerId, int]:
+        """Choose a worker for the request; returns (worker, overlap_blocks).
+
+        `workers` is the current live instance set.  When `update_states`
+        the decision is recorded in the optimistic accounting (callers must
+        later `free(request_id)`).
+        """
+        if not workers:
+            raise ValueError("no live workers to route to")
+        seq_hashes = compute_block_hashes(token_ids, self.config.block_size)
+        request_blocks = (len(token_ids) + self.config.block_size - 1) // self.config.block_size
+
+        if self.indexer:
+            overlaps = self.indexer.find_matches(seq_hashes)
+        elif self.approx:
+            overlaps = self.approx.find_matches(seq_hashes)
+        else:  # pragma: no cover
+            raise RuntimeError("router has neither exact nor approximate indexer")
+
+        bs = self.config.block_size
+        decode_blocks = self.active.decode_blocks()
+        prefill_tokens = self.active.prefill_tokens()
+        candidates = [
+            WorkerLoadSnapshot(
+                worker_id=w,
+                overlap_blocks=overlaps.scores.get(w, 0),
+                decode_blocks=decode_blocks.get(w, 0),
+                prefill_blocks=(prefill_tokens.get(w, 0) + bs - 1) // bs,
+            )
+            for w in workers
+        ]
+        chosen = self.selector.select(candidates, request_blocks)
+
+        if update_states:
+            self.active.add_request(
+                request_id, chosen.worker_id, len(token_ids), chosen.overlap_blocks
+            )
+            if self.approx:
+                self.approx.process_routing_decision(chosen.worker_id, seq_hashes)
+        return chosen.worker_id, chosen.overlap_blocks
+
+    # -- request lifecycle ------------------------------------------------
+    def mark_prefill_complete(self, request_id: str) -> None:
+        self.active.mark_prefill_complete(request_id)
+
+    def push_token(self, request_id: str, n: int = 1) -> None:
+        self.active.push_token(request_id, n)
+
+    def free(self, request_id: str) -> None:
+        self.active.free(request_id)

@@ -1,0 +1,137 @@
+"""Worker selection: overlap-aware cost with temperature sampling.
+
+Role of the reference's `lib/llm/src/kv_router/scheduler.rs`
+(DefaultWorkerSelector :321, cost formula :371-374, softmax_sample :248).
+
+Cost per candidate worker:
+
+    potential_prefill_blocks = request_blocks - overlap_blocks(worker)
+    cost = overlap_score_weight * (potential_prefill_blocks
+                                   + outstanding_prefill_blocks(worker))
+           + decode_blocks(worker)
+
+(outstanding_prefill_blocks = queued prefill work the router already sent to
+that worker — same units, so a worker busy prefilling someone else's long
+prompt is as unattractive as prefilling ours from scratch.)
+
+Lower is better.  With temperature 0 the lowest-cost worker wins (random
+tie-break); with temperature > 0 workers are sampled ∝ softmax(-cost / T),
+which spreads load when costs are close and avoids herding every request at
+the momentarily-cheapest worker.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, WorkerId
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class KVHitRateEvent:
+    """Emitted per routing decision for observability (reference
+    `scheduler.rs:22`): how much of the request's prefix was already
+    cached on the chosen worker."""
+
+    worker_id: WorkerId
+    isl_blocks: int
+    overlap_blocks: int
+
+
+@dataclass
+class WorkerLoadSnapshot:
+    """Candidate worker state at selection time: router-local optimistic
+    accounting merged with the worker's last published metrics."""
+
+    worker_id: WorkerId
+    overlap_blocks: int = 0
+    decode_blocks: int = 0
+    prefill_blocks: int = 0  # outstanding prefill work already routed there
+    metrics: Optional[ForwardPassMetrics] = None
+
+
+def softmax_sample(
+    costs: Dict[WorkerId, float],
+    temperature: float,
+    rng: Optional[random.Random] = None,
+) -> WorkerId:
+    """Sample a worker: argmin at T=0 (ties broken uniformly), else
+    softmax over -cost/T."""
+    if not costs:
+        raise ValueError("no candidate workers")
+    rng = rng or random
+    if temperature <= 0.0:
+        lo = min(costs.values())
+        best = [w for w, c in costs.items() if c == lo]
+        return rng.choice(best)
+    # Stabilized softmax over negated costs.
+    mx = max(-c / temperature for c in costs.values())
+    weights = {w: math.exp(-c / temperature - mx) for w, c in costs.items()}
+    total = sum(weights.values())
+    r = rng.random() * total
+    acc = 0.0
+    for w, wt in weights.items():
+        acc += wt
+        if r <= acc:
+            return w
+    return next(reversed(weights))  # numeric fallthrough
+
+
+class DefaultWorkerSelector:
+    """The stock cost function; custom selectors implement the same
+    `select(candidates, request_blocks) -> (worker, overlap)` surface
+    (the reference exposes WorkerSelector for exactly this extension,
+    `components/router/src/main.rs:27-44`)."""
+
+    def __init__(
+        self,
+        overlap_score_weight: float = 1.0,
+        temperature: float = 0.0,
+        rng: Optional[random.Random] = None,
+        on_hit_rate_event: Optional[Callable[[KVHitRateEvent], None]] = None,
+    ) -> None:
+        self.overlap_score_weight = overlap_score_weight
+        self.temperature = temperature
+        self.rng = rng or random.Random()
+        self.on_hit_rate_event = on_hit_rate_event
+
+    def select(
+        self,
+        candidates: Sequence[WorkerLoadSnapshot],
+        request_blocks: int,
+    ) -> WorkerLoadSnapshot:
+        if not candidates:
+            raise ValueError("no candidate workers")
+        costs: Dict[WorkerId, float] = {}
+        by_id: Dict[WorkerId, WorkerLoadSnapshot] = {}
+        for c in candidates:
+            potential_prefill = max(0, request_blocks - c.overlap_blocks)
+            costs[c.worker_id] = (
+                self.overlap_score_weight * (potential_prefill + c.prefill_blocks)
+                + c.decode_blocks
+            )
+            by_id[c.worker_id] = c
+        chosen_id = softmax_sample(costs, self.temperature, self.rng)
+        chosen = by_id[chosen_id]
+        logger.debug(
+            "selected worker %s cost=%.1f overlap=%d/%d blocks",
+            chosen_id,
+            costs[chosen_id],
+            chosen.overlap_blocks,
+            request_blocks,
+        )
+        if self.on_hit_rate_event:
+            self.on_hit_rate_event(
+                KVHitRateEvent(
+                    worker_id=chosen_id,
+                    isl_blocks=request_blocks,
+                    overlap_blocks=min(chosen.overlap_blocks, request_blocks),
+                )
+            )
+        return chosen

@@ -41,10 +41,15 @@ def _as_u32(tokens) -> np.ndarray:
     if arr.dtype == np.uint32:
         return arr
     if not np.issubdtype(arr.dtype, np.integer):
+        # Float/object input: truncation would alias distinct streams, so
+        # only exact integer values are accepted.
         try:
-            arr = arr.astype(np.int64)
+            as_int = arr.astype(np.int64)
         except (ValueError, OverflowError, TypeError) as e:
             raise ValueError(f"token ids must be integers: {e}") from e
+        if np.issubdtype(arr.dtype, np.floating) and not np.array_equal(as_int, arr):
+            raise ValueError("token ids must be integers, got non-integral floats")
+        arr = as_int
     if arr.size and (arr.min() < 0 or arr.max() > 0xFFFFFFFF):
         raise ValueError(
             f"token ids must fit in uint32, got range [{arr.min()}, {arr.max()}]"
@@ -71,10 +76,7 @@ def compute_block_hashes(
     """
     if block_size <= 0:
         raise ValueError(f"block_size must be positive, got {block_size}")
-    try:
-        arr = _as_u32(tokens)
-    except OverflowError as e:
-        raise ValueError(f"token ids must fit in uint32: {e}") from e
+    arr = _as_u32(tokens)
     n_full = len(arr) // block_size
     hashes: List[int] = []
     h = parent_hash
@@ -133,19 +135,35 @@ class TokenBlockSequence:
 
     def extend(self, tokens: Iterable[TokenId]) -> List[TokenBlock]:
         """Append many tokens in bulk; returns all blocks completed by this
-        call.  Bulk path: validates once, seals whole blocks from numpy views
-        instead of per-token appends (prefill prompts can be 100k+ tokens).
+        call.  Bulk path: validates once, hashes each sealed block straight
+        from the uint32 array view, and converts to Python ints once via
+        tolist() (prefill prompts can be 100k+ tokens).
         """
         arr = _as_u32(list(tokens) if not isinstance(tokens, (list, np.ndarray)) else tokens)
+        toks: List[TokenId] = arr.tolist()
         new_blocks: List[TokenBlock] = []
         pos = 0
-        n = len(arr)
+        n = len(toks)
         while pos < n:
-            take = min(self.block_size - len(self._partial), n - pos)
-            self._partial.extend(int(t) for t in arr[pos : pos + take])
-            pos += take
-            if len(self._partial) >= self.block_size:
-                new_blocks.append(self._seal())
+            if not self._partial and n - pos >= self.block_size:
+                # Whole block available: hash directly from the array view.
+                end = pos + self.block_size
+                parent = self.blocks[-1].block_hash if self.blocks else ROOT_PARENT_HASH
+                blk = TokenBlock(
+                    tokens=tuple(toks[pos:end]),
+                    block_hash=hash_block(parent, arr[pos:end]),
+                    parent_hash=parent,
+                    position=len(self.blocks),
+                )
+                self.blocks.append(blk)
+                new_blocks.append(blk)
+                pos = end
+            else:
+                take = min(self.block_size - len(self._partial), n - pos)
+                self._partial.extend(toks[pos : pos + take])
+                pos += take
+                if len(self._partial) >= self.block_size:
+                    new_blocks.append(self._seal())
         return new_blocks
 
     def truncate(self, length: int) -> None:

@@ -1,0 +1,240 @@
+import random
+
+import pytest
+
+from dynamo_tpu.llm.kv_router import (
+    ActiveSequencesMultiWorker,
+    KvIndexer,
+    KvRouter,
+    KvRouterConfig,
+    RadixTree,
+    RouterEvent,
+)
+from dynamo_tpu.llm.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.llm.kv_router.protocols import KvCacheEvent, KvCacheEventData
+from dynamo_tpu.llm.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    WorkerLoadSnapshot,
+    softmax_sample,
+)
+from dynamo_tpu.tokens import compute_block_hashes
+
+BS = 16
+
+
+def ev(worker, eid, data):
+    return RouterEvent(worker_id=worker, event=KvCacheEvent(event_id=eid, data=data))
+
+
+def hashes(tokens):
+    return compute_block_hashes(tokens, BS)
+
+
+class TestRadixTree:
+    def test_prefix_overlap_scoring(self):
+        t = RadixTree()
+        h = hashes(list(range(64)))  # 4 blocks
+        t.store("w0", h[:4])
+        t.store("w1", h[:2])
+        scores = t.find_matches(h).scores
+        assert scores == {"w0": 4, "w1": 2}
+
+    def test_contiguity_required(self):
+        t = RadixTree()
+        h = hashes(list(range(64)))
+        # w0 has blocks 0 and 2 but not 1: overlap stops at 1
+        t.store("w0", [h[0], h[2]])
+        assert t.find_matches(h).scores == {"w0": 1}
+
+    def test_remove_and_clear(self):
+        t = RadixTree()
+        h = hashes(list(range(48)))
+        t.store("w0", h)
+        t.store("w1", h)
+        t.remove("w0", [h[2]])
+        assert t.find_matches(h).scores == {"w0": 2, "w1": 3}
+        t.clear_worker("w1")
+        assert t.find_matches(h).scores == {"w0": 2}
+        assert t.workers() == ["w0"]
+
+    def test_no_match(self):
+        t = RadixTree()
+        t.store("w0", hashes(list(range(32))))
+        assert t.find_matches(hashes(list(range(100, 132)))).scores == {}
+
+
+class TestKvIndexer:
+    def test_event_application_and_staleness(self):
+        idx = KvIndexer(block_size=BS)
+        h = hashes(list(range(32)))
+        idx.apply_event(ev("w0", 1, KvCacheEventData.stored(h)))
+        assert idx.find_matches(h).scores == {"w0": 2}
+        # stale event id: dropped
+        idx.apply_event(ev("w0", 1, KvCacheEventData.cleared()))
+        assert idx.find_matches(h).scores == {"w0": 2}
+        assert idx.stale_events_dropped == 1
+        # fresh clear applies
+        idx.apply_event(ev("w0", 2, KvCacheEventData.cleared()))
+        assert idx.find_matches(h).scores == {}
+
+    def test_remove_worker_resets_cursor(self):
+        idx = KvIndexer(block_size=BS)
+        h = hashes(list(range(32)))
+        idx.apply_event(ev("w0", 5, KvCacheEventData.stored(h)))
+        idx.remove_worker("w0")
+        # restarted worker starts over at event_id 1
+        idx.apply_event(ev("w0", 1, KvCacheEventData.stored(h[:1])))
+        assert idx.find_matches(h).scores == {"w0": 1}
+
+
+class TestSelector:
+    def test_overlap_wins_when_load_equal(self):
+        sel = DefaultWorkerSelector()
+        c = [
+            WorkerLoadSnapshot("w0", overlap_blocks=3, decode_blocks=10),
+            WorkerLoadSnapshot("w1", overlap_blocks=0, decode_blocks=10),
+        ]
+        assert sel.select(c, request_blocks=4).worker_id == "w0"
+
+    def test_load_beats_small_overlap(self):
+        sel = DefaultWorkerSelector()
+        c = [
+            WorkerLoadSnapshot("w0", overlap_blocks=1, decode_blocks=100),
+            WorkerLoadSnapshot("w1", overlap_blocks=0, decode_blocks=0),
+        ]
+        assert sel.select(c, request_blocks=4).worker_id == "w1"
+
+    def test_softmax_t0_tie_break_uniformish(self):
+        rng = random.Random(0)
+        costs = {"a": 1.0, "b": 1.0, "c": 2.0}
+        picks = {softmax_sample(costs, 0.0, rng) for _ in range(50)}
+        assert picks == {"a", "b"}
+
+    def test_softmax_temperature_spreads(self):
+        rng = random.Random(0)
+        costs = {"a": 1.0, "b": 1.1}
+        picks = [softmax_sample(costs, 10.0, rng) for _ in range(200)]
+        assert 50 < picks.count("a") < 150  # both picked often
+
+    def test_empty_candidates_raises(self):
+        with pytest.raises(ValueError):
+            softmax_sample({}, 0.0)
+
+
+class TestActiveSequences:
+    def test_lifecycle_accounting(self):
+        a = ActiveSequencesMultiWorker(block_size=BS)
+        a.add_request("r1", "w0", isl_tokens=64, overlap_blocks=2)
+        # prefill cost excludes cached prefix: 64 - 2*16 = 32
+        assert a.prefill_tokens() == {"w0": 32}
+        assert a.decode_blocks() == {"w0": 4}
+        a.mark_prefill_complete("r1")
+        assert a.prefill_tokens() == {"w0": 0}
+        a.push_token("r1")  # 65 tokens -> 5 blocks
+        assert a.decode_blocks() == {"w0": 5}
+        a.free("r1")
+        assert a.decode_blocks() == {"w0": 0}
+
+    def test_remove_worker_drops_requests(self):
+        a = ActiveSequencesMultiWorker(block_size=BS)
+        a.add_request("r1", "w0", 32, 0)
+        a.remove_worker("w0")
+        a.push_token("r1")  # no crash; attribution gone
+        assert a.decode_blocks() == {}
+
+
+class TestApproxIndexer:
+    def test_ttl_assumed_residency(self):
+        ax = ApproxKvIndexer(block_size=BS, ttl_secs=1000.0)
+        h = hashes(list(range(48)))
+        ax.process_routing_decision("w0", h[:2])
+        assert ax.find_matches(h).scores == {"w0": 2}
+        ax.remove_worker("w0")
+        assert ax.find_matches(h).scores == {}
+
+    def test_expiry(self, monkeypatch):
+        ax = ApproxKvIndexer(block_size=BS, ttl_secs=10.0)
+        t = [0.0]
+        monkeypatch.setattr(ax, "_now", lambda: t[0])
+        ax.process_routing_decision("w0", hashes(list(range(16))))
+        t[0] = 5.0
+        assert ax.find_matches(hashes(list(range(16)))).scores == {"w0": 1}
+        t[0] = 11.0
+        assert ax.find_matches(hashes(list(range(16)))).scores == {}
+
+
+class TestKvRouter:
+    def test_end_to_end_routing_prefers_cached_worker(self):
+        r = KvRouter(KvRouterConfig(block_size=BS))
+        toks = list(range(64))
+        h = hashes(toks)
+        r.apply_event(ev("w0", 1, KvCacheEventData.stored(h)))
+        w, overlap = r.find_best_match("r1", toks, ["w0", "w1"])
+        assert (w, overlap) == ("w0", 4)
+        r.free("r1")
+
+    def test_load_balancing_without_cache(self):
+        r = KvRouter(KvRouterConfig(block_size=BS))
+        # Route many distinct requests; optimistic accounting should spread them.
+        counts = {"w0": 0, "w1": 0}
+        for i in range(10):
+            toks = list(range(i * 1000, i * 1000 + 64))
+            w, _ = r.find_best_match(f"r{i}", toks, ["w0", "w1"])
+            counts[w] += 1
+        assert counts["w0"] == 5 and counts["w1"] == 5
+
+    def test_approx_mode(self):
+        r = KvRouter(KvRouterConfig(block_size=BS, use_kv_events=False))
+        toks = list(range(64))
+        w1, ov1 = r.find_best_match("r1", toks, ["w0", "w1"])
+        assert ov1 == 0
+        r.free("r1")
+        # Same prefix routes back to the same worker via assumed residency.
+        w2, ov2 = r.find_best_match("r2", toks, ["w0", "w1"])
+        assert w2 == w1 and ov2 == 4
+        r.free("r2")
+
+    def test_no_workers_raises(self):
+        r = KvRouter()
+        with pytest.raises(ValueError):
+            r.find_best_match("r", [1, 2, 3], [])
+
+    def test_dead_worker_removed(self):
+        r = KvRouter(KvRouterConfig(block_size=BS))
+        toks = list(range(64))
+        r.apply_event(ev("w0", 1, KvCacheEventData.stored(hashes(toks))))
+        r.remove_worker("w0")
+        w, overlap = r.find_best_match("r1", toks, ["w1"])
+        assert (w, overlap) == ("w1", 0)
+
+
+def test_malformed_event_does_not_advance_cursor():
+    from dynamo_tpu.llm.kv_router.protocols import KvCacheEventData, KvEventKind
+
+    idx = KvIndexer(block_size=BS)
+    bad = ev("w0", 1, KvCacheEventData(KvEventKind.STORED, store=None))
+    with pytest.raises(ValueError):
+        idx.apply_event(bad)
+    # corrected redelivery under the same event_id applies
+    h = hashes(list(range(16)))
+    idx.apply_event(ev("w0", 1, KvCacheEventData.stored(h)))
+    assert idx.find_matches(h).scores == {"w0": 1}
+
+
+def test_active_sequence_expiry_sweep():
+    a = ActiveSequencesMultiWorker(block_size=BS)
+    a.add_request("r1", "w0", 32, 0)
+    assert a.expire_older_than(1e9) == 0
+    assert a.expire_older_than(-1.0) == 1  # everything is "older"
+    assert a.decode_blocks() == {"w0": 0}
+    a.push_token("r1")  # attribution cleaned too
+    assert a.decode_blocks() == {"w0": 0}
+
+
+def test_outstanding_prefill_influences_cost():
+    sel = DefaultWorkerSelector()
+    c = [
+        WorkerLoadSnapshot("busy", overlap_blocks=0, decode_blocks=0, prefill_blocks=50),
+        WorkerLoadSnapshot("idle", overlap_blocks=0, decode_blocks=0, prefill_blocks=0),
+    ]
+    assert sel.select(c, request_blocks=4).worker_id == "idle"

@@ -110,3 +110,14 @@ def test_truncate_preserves_prefix_blocks_identity():
     s2 = TokenBlockSequence(range(10), block_size=16)
     s2.truncate(3)
     assert s2.tokens == [0, 1, 2]
+
+
+def test_float_tokens_rejected():
+    import numpy as np
+
+    with pytest.raises(ValueError):
+        compute_block_hashes(np.array([1.5, 2.7, 3.0, 4.9]), 4)
+    # exact-integer floats are accepted and match int input
+    assert compute_block_hashes(np.array([1.0, 2.0, 3.0, 4.0]), 4) == compute_block_hashes(
+        [1, 2, 3, 4], 4
+    )
