@@ -1,0 +1,7 @@
+"""The JAX inference engine.
+
+Where the reference delegates to vLLM/SGLang/TRT-LLM (SURVEY.md §2.3), this
+package IS the engine: paged KV cache as preallocated sharded device arrays,
+a unified prefill/decode step compiled per (batch, chunk) bucket, continuous
+batching with fixed shapes, and on-device sampling.
+"""

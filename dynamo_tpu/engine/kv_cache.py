@@ -1,0 +1,130 @@
+"""Paged KV cache as preallocated JAX arrays.
+
+TPU re-imagining of vLLM's paged KV cache (which the reference orchestrates
+around but does not implement; its block bookkeeping lives in
+`lib/llm/src/block_manager/layout.rs` — LayoutConfig{num_blocks, num_layers,
+page_size, inner_dim, dtype}).  On TPU the cache must be a *static-shape*
+array XLA can reason about, so:
+
+- storage is `[num_layers, num_blocks * block_size, num_kv_heads, head_dim]`
+  per K and V — a flat "slot" axis rather than a blocked one, so both the
+  scatter (write new tokens) and gather (read context) are single
+  `take`/`scatter` ops with precomputed flat indices;
+- block 0 is reserved as the *null block*: padded block-table entries point
+  at it, and its contents are never read unmasked;
+- sharding: `num_kv_heads` over the `tp` mesh axis (head-sharded cache means
+  KV writes and attention reads stay device-local under tensor parallelism).
+
+The index math (block table → flat slots) runs inside jit on int32 arrays —
+no host round-trip per step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+
+# Block-table entries for never-allocated pages point at the null block.
+NULL_BLOCK = 0
+
+
+@dataclass(frozen=True)
+class KvCacheConfig:
+    """Geometry of the paged cache (reference LayoutConfig analog,
+    `block_manager/layout.rs`)."""
+
+    num_blocks: int          # includes the reserved null block 0
+    block_size: int          # tokens per block (reference default 64)
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def num_slots(self) -> int:
+        return self.num_blocks * self.block_size
+
+    @property
+    def bytes_per_block(self) -> int:
+        """K+V bytes for one block across all layers (the unit the block
+        manager and router count in)."""
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return (
+            2 * self.num_layers * self.block_size * self.num_kv_heads
+            * self.head_dim * itemsize
+        )
+
+    @staticmethod
+    def for_model(
+        config: ModelConfig,
+        num_blocks: int,
+        block_size: int = 64,
+        dtype: jnp.dtype | None = None,
+    ) -> "KvCacheConfig":
+        return KvCacheConfig(
+            num_blocks=num_blocks,
+            block_size=block_size,
+            num_layers=config.num_layers,
+            num_kv_heads=config.num_kv_heads,
+            head_dim=config.head_dim,
+            dtype=dtype if dtype is not None else config.dtype,
+        )
+
+
+def init_cache(cfg: KvCacheConfig) -> dict:
+    """Allocate the cache pytree: {'k': [L, S, H, D], 'v': [L, S, H, D]}."""
+    shape = (cfg.num_layers, cfg.num_slots, cfg.num_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def slots_for_positions(
+    block_tables: jax.Array,  # [B, P] int32 block ids
+    positions: jax.Array,     # [B, T] int32 absolute token positions
+    block_size: int,
+) -> jax.Array:
+    """Flat slot index for each (sequence, position): `bt[pos//bs]*bs + pos%bs`.
+
+    Positions past a sequence's allocated pages must be masked by the caller
+    (they resolve to whatever block id sits at that table entry — padded
+    entries are NULL_BLOCK, whose slots are junk by design).
+    """
+    block_idx = positions // block_size            # [B, T]
+    offset = positions % block_size                # [B, T]
+    block_ids = jnp.take_along_axis(block_tables, block_idx, axis=1)  # [B, T]
+    return block_ids * block_size + offset
+
+
+def write_kv(
+    cache_layer_k: jax.Array,  # [S, H, D]
+    cache_layer_v: jax.Array,
+    slots: jax.Array,          # [N] flat slot ids (may repeat NULL for pad)
+    k: jax.Array,              # [N, H, D]
+    v: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter new K/V rows into one layer's slot axis.
+
+    Padding tokens should carry slot 0 (null block) so their writes land in
+    the reserved junk block.  `mode="drop"` guards out-of-range indices.
+    """
+    k_new = cache_layer_k.at[slots].set(k.astype(cache_layer_k.dtype), mode="drop")
+    v_new = cache_layer_v.at[slots].set(v.astype(cache_layer_v.dtype), mode="drop")
+    return k_new, v_new
+
+
+def gather_kv(
+    cache_layer_k: jax.Array,  # [S, H, D]
+    cache_layer_v: jax.Array,
+    slots: jax.Array,          # [B, C] flat slot ids for each context position
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather per-sequence context K/V: returns [B, C, H, D] pairs."""
+    k = jnp.take(cache_layer_k, slots, axis=0, mode="clip")
+    v = jnp.take(cache_layer_v, slots, axis=0, mode="clip")
+    return k, v

@@ -1,0 +1,79 @@
+"""On-device token sampling.
+
+The reference passes sampling options through to vLLM
+(`lib/llm/src/protocols/common.rs` SamplingOptionsProvider); here sampling
+runs on-TPU at the end of the decode step so only sampled token ids cross
+the device boundary each step (SURVEY.md §7 "per-token latency path").
+
+Batched and branch-free: every sequence carries its own (temperature,
+top_k, top_p, seed) and the same compiled kernel serves any mix of greedy
+and stochastic requests — greedy is temperature == 0 via `jnp.where`, not a
+Python branch, so no recompiles as the batch mix changes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling config (reference: protocols/common.rs
+    SamplingOptions / StopConditions)."""
+
+    temperature: float = 0.0     # 0 → greedy
+    top_k: int = 0               # 0 → disabled
+    top_p: float = 1.0           # 1 → disabled
+    max_tokens: int = 16
+    stop_token_ids: tuple = ()
+    seed: Optional[int] = None
+    # Migration support (reference migration.rs:148-163): tokens already
+    # generated before a retry are appended to the prompt and max_tokens is
+    # decremented by the caller.
+
+
+def sample(
+    logits: jax.Array,        # [B, V] float32
+    temperature: jax.Array,   # [B]
+    top_k: jax.Array,         # [B] int32, 0 = off
+    top_p: jax.Array,         # [B] float32, 1.0 = off
+    key: jax.Array,           # PRNG key
+) -> jax.Array:
+    """Sample one token per row.  Greedy where temperature == 0."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_temp[:, None]
+
+    # top-k: mask everything below the k-th largest logit.  Vectorised over
+    # rows by ranking: rank[i] = number of logits strictly greater.
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]          # [B, V]
+    k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)
+    kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=1)
+    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+
+    # top-p (nucleus): keep the smallest prefix of the sorted distribution
+    # with cumulative prob >= top_p; implemented on sorted copy then mapped
+    # back via threshold logit.  top_p >= 1 is "off" and must bypass the
+    # cutoff entirely: float32 cumsum can round below 1.0, which would
+    # otherwise make argmax pick index 0 and collapse sampling to greedy.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+    # index of first position where cumulative >= top_p (inclusive)
+    cutoff_idx = jnp.argmax(cumprobs >= top_p[:, None], axis=-1)
+    cutoff_logit = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=1)
+    top_p_on = (top_p < 1.0)[:, None]
+    scaled = jnp.where(top_p_on & (scaled < cutoff_logit), -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def greedy(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)

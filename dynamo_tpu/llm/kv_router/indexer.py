@@ -156,6 +156,7 @@ class KvIndexer:
         self._lock = threading.Lock()
         self._last_event_id: Dict[WorkerId, int] = {}
         self.stale_events_dropped = 0
+        self.malformed_events = 0
 
     def apply_event(self, ev: RouterEvent) -> None:
         with self._lock:
@@ -195,7 +196,18 @@ class KvIndexer:
             return self.tree.find_matches(sequence_hashes)
 
     async def pump(self, queue: "asyncio.Queue[RouterEvent]") -> None:
-        """Drain RouterEvents from an asyncio queue until cancelled."""
+        """Drain RouterEvents from an asyncio queue until cancelled.
+
+        A malformed event must not kill the ingestion loop (a dead pump means
+        the index silently freezes while the router keeps consulting it), so
+        apply failures are counted and logged, never propagated.
+        """
         while True:
             ev = await queue.get()
-            self.apply_event(ev)
+            try:
+                self.apply_event(ev)
+            except Exception:
+                self.malformed_events += 1
+                logger.exception(
+                    "dropping malformed router event from worker %s", ev.worker_id
+                )

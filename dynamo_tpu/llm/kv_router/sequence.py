@@ -41,9 +41,15 @@ class ActiveSequences:
         request_id: str,
         isl_tokens: int,
         overlap_blocks: int,
-        expected_output_tokens: Optional[int] = None,
+        expected_output_tokens: int = 0,
     ) -> None:
-        total_blocks = (isl_tokens + self.block_size - 1) // self.block_size
+        """Track a routed request.  `expected_output_tokens` pre-reserves the
+        decode blocks the request is expected to grow into (the reference
+        scheduler's `potential_blocks` accounting, `kv_router/scheduler.rs`),
+        so the selector sees future occupancy, not just the prompt."""
+        total_blocks = (
+            isl_tokens + (expected_output_tokens or 0) + self.block_size - 1
+        ) // self.block_size
         self._seqs[request_id] = ActiveSeq(
             request_id=request_id,
             isl_tokens=isl_tokens,

@@ -1,0 +1,12 @@
+"""Model families served by the TPU engine.
+
+The reference delegates model execution to external engines (vLLM/SGLang/
+TRT-LLM — SURVEY.md §2.3); here the engine is ours, so model definitions
+live in-tree: pure-JAX functional transformers (params as pytrees) whose
+forward steps are jit/shard_map-friendly (static shapes, no Python control
+flow on traced values).
+"""
+
+from dynamo_tpu.models.config import ModelConfig, PRESETS
+
+__all__ = ["ModelConfig", "PRESETS"]

@@ -1,0 +1,150 @@
+"""Model architecture configs.
+
+Plays the role of the reference's `ModelDeploymentCard` model-info slice
+(`lib/llm/src/model_card.rs:90-120` — context length, vocab, etc.) plus the
+engine-side architecture hyperparameters the reference leaves to vLLM.
+
+Presets cover the BASELINE.md ladder: Llama-3-8B → Llama-3-70B →
+Mixtral-8x7B (MoE) → DeepSeek-R1-class, plus tiny configs for CPU tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture of a Llama-family (optionally MoE) decoder-only LM.
+
+    All shapes are chosen TPU-first: `head_dim` a multiple of 128 where the
+    real models allow it, activations in bfloat16, and sizes that tile onto
+    the MXU without padding.
+    """
+
+    name: str
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    max_context: int = 8192
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+    # MoE (Mixtral-style). num_experts == 0 means dense MLP.
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+    # Tie input embedding and LM head (small models).
+    tie_embeddings: bool = False
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+    def validate(self) -> None:
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError("num_heads must be a multiple of num_kv_heads (GQA)")
+        if self.is_moe and self.num_experts_per_token > self.num_experts:
+            raise ValueError("num_experts_per_token > num_experts")
+
+    def param_count(self) -> int:
+        """Approximate parameter count (for memory planning / bench labels)."""
+        h, v = self.hidden_size, self.vocab_size
+        attn = h * self.q_size + 2 * h * self.kv_size + self.q_size * h
+        if self.is_moe:
+            mlp = self.num_experts * 3 * h * self.intermediate_size + h * self.num_experts
+        else:
+            mlp = 3 * h * self.intermediate_size
+        per_layer = attn + mlp + 2 * h
+        emb = v * h * (1 if self.tie_embeddings else 2)
+        return self.num_layers * per_layer + emb + h
+
+
+# Tiny configs for CPU tests: small enough to run a full correctness check
+# on the 8-device virtual mesh in milliseconds, but with GQA + enough heads
+# to exercise every sharding axis.
+TINY = ModelConfig(
+    name="tiny-test",
+    vocab_size=256,
+    hidden_size=64,
+    num_layers=2,
+    num_heads=8,
+    num_kv_heads=4,
+    head_dim=16,
+    intermediate_size=128,
+    max_context=512,
+    rope_theta=10_000.0,
+    dtype=jnp.float32,
+    tie_embeddings=True,
+)
+
+TINY_MOE = TINY.replace(name="tiny-moe", num_experts=8, num_experts_per_token=2)
+
+LLAMA3_8B = ModelConfig(
+    name="llama-3-8b",
+    vocab_size=128_256,
+    hidden_size=4096,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    intermediate_size=14_336,
+    max_context=8192,
+)
+
+LLAMA3_70B = ModelConfig(
+    name="llama-3-70b",
+    vocab_size=128_256,
+    hidden_size=8192,
+    num_layers=80,
+    num_heads=64,
+    num_kv_heads=8,
+    head_dim=128,
+    intermediate_size=28_672,
+    max_context=8192,
+)
+
+MIXTRAL_8X7B = ModelConfig(
+    name="mixtral-8x7b",
+    vocab_size=32_000,
+    hidden_size=4096,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    intermediate_size=14_336,
+    max_context=32_768,
+    rope_theta=1_000_000.0,
+    num_experts=8,
+    num_experts_per_token=2,
+)
+
+PRESETS = {
+    c.name: c
+    for c in (TINY, TINY_MOE, LLAMA3_8B, LLAMA3_70B, MIXTRAL_8X7B)
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    try:
+        return PRESETS[name]
+    except KeyError:
+        raise KeyError(f"unknown model preset {name!r}; have {sorted(PRESETS)}") from None

@@ -1,0 +1,243 @@
+"""Llama-family decoder (dense + Mixtral-style MoE) as pure JAX functions.
+
+Params are plain pytrees (nested dicts of arrays) so sharding is a pytree of
+`NamedSharding`s (dynamo_tpu/parallel/sharding.py) and the forward step jits
+under any mesh.  The reference has no model code (it delegates to vLLM —
+SURVEY.md §2.3); this module is the TPU replacement for that delegation.
+
+Forward contract (unified prefill/decode, see dynamo_tpu/ops/attention.py):
+
+    logits, cache = forward_step(cfg, params, cache, tokens, positions,
+                                 seq_lens, block_tables)
+
+- tokens/positions: [B, T] — T is the chunk length (1 for decode).
+- seq_lens: [B] total valid context length *after* this chunk.
+- block_tables: [B, P] page ids into the paged cache.
+- The chunk's K/V are scattered into the cache first, then the chunk
+  attends to all cached context with an absolute-position causal mask, so
+  the same compiled function serves prefill, chunked prefill and decode.
+
+MoE layers use expert-sharded dense compute: every device runs its local
+experts on all tokens and combines with top-k gate weights (zero for
+non-selected experts); under an `ep` mesh axis the expert dimension shards
+and the combine is a `psum`.  (All-to-all token dispatch is the planned
+refinement — see dynamo_tpu/parallel.)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine import kv_cache as kvc
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.attention import paged_attention
+
+Params = Dict
+
+
+# ---------------------------------------------------------------------------
+# Init
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=None) -> Params:
+    """Random-init params (bench/tests); real checkpoints load via
+    dynamo_tpu.models.loader with the same pytree structure."""
+    cfg.validate()
+    dtype = dtype or cfg.dtype
+    h = cfg.hidden_size
+
+    def dense(key, fan_in, *shape):
+        std = fan_in ** -0.5
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+    # Key budget: a stride of 8 keys per layer (dense uses 7, MoE 5), plus
+    # embed + lm_head at the tail — per-layer strides keep keys unique
+    # without branch-dependent bookkeeping.
+    keys = jax.random.split(key, cfg.num_layers * 8 + 2)
+
+    layers = []
+    for li in range(cfg.num_layers):
+        ki = iter(range(li * 8, (li + 1) * 8))
+        layer = {
+            "attn": {
+                "wq": dense(keys[next(ki)], h, h, cfg.q_size),
+                "wk": dense(keys[next(ki)], h, h, cfg.kv_size),
+                "wv": dense(keys[next(ki)], h, h, cfg.kv_size),
+                "wo": dense(keys[next(ki)], cfg.q_size, cfg.q_size, h),
+            },
+            "attn_norm": jnp.ones((h,), dtype),
+            "mlp_norm": jnp.ones((h,), dtype),
+        }
+        if cfg.is_moe:
+            e, f = cfg.num_experts, cfg.intermediate_size
+            kk = jax.random.split(keys[next(ki)], 4)
+            layer["moe"] = {
+                "router": dense(kk[0], h, h, e),
+                "w_gate": dense(kk[1], h, e, h, f),
+                "w_up": dense(kk[2], h, e, h, f),
+                "w_down": dense(kk[3], f, e, f, h),
+            }
+        else:
+            f = cfg.intermediate_size
+            layer["mlp"] = {
+                "w_gate": dense(keys[next(ki)], h, h, f),
+                "w_up": dense(keys[next(ki)], h, h, f),
+                "w_down": dense(keys[next(ki)], f, f, h),
+            }
+        layers.append(layer)
+
+    params: Params = {
+        "embed": dense(keys[-2], h, cfg.vocab_size, h),
+        "final_norm": jnp.ones((h,), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(keys[-1], h, h, cfg.vocab_size)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding, interleaved-half convention.  x: [B, T, H, D]."""
+    D = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention_block(
+    cfg: ModelConfig,
+    p_attn: Params,
+    layer_idx: int,
+    x: jax.Array,            # [B, T, H]
+    positions: jax.Array,    # [B, T]
+    seq_lens: jax.Array,     # [B]
+    write_slots: jax.Array,  # [B*T] flat cache slots for this chunk
+    ctx_slots: jax.Array,    # [B, C] flat cache slots of full context
+    kv_positions: jax.Array, # [B, C]
+    cache: Dict,
+) -> Tuple[jax.Array, Dict]:
+    B, T, _ = x.shape
+    q = (x @ p_attn["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = (x @ p_attn["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = (x @ p_attn["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+
+    k_layer, v_layer = kvc.write_kv(
+        cache["k"][layer_idx],
+        cache["v"][layer_idx],
+        write_slots,
+        k.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
+        v.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
+    )
+    cache = {
+        "k": cache["k"].at[layer_idx].set(k_layer),
+        "v": cache["v"].at[layer_idx].set(v_layer),
+    }
+
+    k_ctx, v_ctx = kvc.gather_kv(k_layer, v_layer, ctx_slots)
+    out = paged_attention(q, k_ctx, v_ctx, positions, kv_positions, seq_lens)
+    out = out.reshape(B, T, cfg.q_size) @ p_attn["wo"]
+    return out, cache
+
+
+def _dense_mlp(p: Params, x: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ p["w_gate"]) * (x @ p["w_up"])) @ p["w_down"]
+
+
+def _moe_mlp(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
+    """Top-k gated MoE, expert-sharded dense compute.
+
+    gates: [B, T, E] with zeros outside the top-k, renormalised over the
+    selected experts (Mixtral convention).  Expert matmuls carry an explicit
+    E axis so an `ep` mesh axis shards them; the final einsum contracts E
+    (→ psum under shard_map).
+    """
+    B, T, H = x.shape
+    logits = (x @ p["router"]).astype(jnp.float32)          # [B, T, E]
+    k = cfg.num_experts_per_token
+    top_vals, _ = jax.lax.top_k(logits, k)
+    kth = top_vals[..., -1:]
+    masked = jnp.where(logits >= kth, logits, -jnp.inf)
+    gates = jax.nn.softmax(masked, axis=-1).astype(x.dtype)  # [B, T, E]
+
+    hidden = jax.nn.silu(jnp.einsum("bth,ehf->betf", x, p["w_gate"]))
+    hidden = hidden * jnp.einsum("bth,ehf->betf", x, p["w_up"])
+    expert_out = jnp.einsum("betf,efh->beth", hidden, p["w_down"])
+    return jnp.einsum("beth,bte->bth", expert_out, gates)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+
+
+def make_forward_step(cfg: ModelConfig, block_size: int):
+    """Build the jitted unified step for a given cache geometry.
+
+    Separate factory (rather than passing block_size as a traced value)
+    because slot math needs the block size statically for XLA to fold the
+    index arithmetic.
+    """
+    cfg.validate()
+
+    def step(
+        params: Params,
+        cache: Dict,
+        tokens: jax.Array,        # [B, T]
+        positions: jax.Array,     # [B, T]
+        seq_lens: jax.Array,      # [B]
+        block_tables: jax.Array,  # [B, P]
+    ) -> Tuple[jax.Array, Dict]:
+        B, T = tokens.shape
+        P = block_tables.shape[1]
+        C = P * block_size  # max context representable by the table
+
+        write_slots = kvc.slots_for_positions(block_tables, positions, block_size)
+        write_slots = write_slots.reshape(B * T)
+
+        ctx_positions = jnp.broadcast_to(
+            jnp.arange(C, dtype=jnp.int32), (B, C)
+        )
+        ctx_slots = kvc.slots_for_positions(block_tables, ctx_positions, block_size)
+
+        x = jnp.take(params["embed"], tokens, axis=0)
+        for i, layer in enumerate(params["layers"]):
+            attn_out, cache = _attention_block(
+                cfg, layer["attn"], i,
+                rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps),
+                positions, seq_lens, write_slots, ctx_slots, ctx_positions,
+                cache,
+            )
+            x = x + attn_out
+            h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+            if cfg.is_moe:
+                x = x + _moe_mlp(cfg, layer["moe"], h)
+            else:
+                x = x + _dense_mlp(layer["mlp"], h)
+
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T
+        logits = (x @ head).astype(jnp.float32)
+        return logits, cache
+
+    return step

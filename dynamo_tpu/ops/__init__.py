@@ -1,0 +1,8 @@
+"""TPU compute ops: attention over the paged cache, Pallas kernels.
+
+Each op ships two implementations with identical semantics:
+- a pure-`jnp` reference (runs anywhere, used by CPU-mesh tests), and
+- a Pallas TPU kernel for the hot path (the analog of the reference's only
+  CUDA kernel, `lib/llm/src/kernels/block_copy.cu`, plus the paged-attention
+  kernels vLLM supplies on GPU).
+"""

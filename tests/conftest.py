@@ -7,7 +7,23 @@ CPU devices, so CI needs no TPU.  Must run before any `import jax`.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# The ambient environment may pin JAX to the real TPU (e.g. the "axon"
+# plugin, which ignores JAX_PLATFORMS=cpu), but the test suite must stay on
+# the virtual CPU mesh — single-chip hardware can't host the 8-way sharding
+# tests and TPU compiles would dominate test wall-time.  XLA_FLAGS must be
+# set before jax import; jax_platforms must be forced via jax.config (the
+# env var alone loses to the TPU plugin).
+import re
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", ""),
+)
+os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()
