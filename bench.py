@@ -1,0 +1,88 @@
+"""Headline bench: steady-state decode throughput on the real TPU chip.
+
+Measures tokens/sec of the paged-cache decode step for the flagship
+single-chip model (Llama-3-1B geometry, bf16, batch 64, 512-token
+contexts) — the TPU analog of the reference's decode profiling row
+(`docs/architecture/pre_deployment_profiling.md:38` — 51.22 tok/s/GPU,
+ITL 4.83 ms, Llama-70B TP=4 on H100-class).  `vs_baseline` is the ratio
+of our per-chip tok/s to that reference number; the models differ in size
+(1B on one 16GB v5e chip vs 70B over 4 H100s), so treat it as a tracking
+number, not an apples-to-apples comparison — the honest cross-check
+arrives with the multi-chip 70B config (BASELINE.md ladder #3).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine import kv_cache as kvc
+from dynamo_tpu.engine.sampling import greedy
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.models.llama import init_params, make_forward_step
+
+REFERENCE_DECODE_TOK_S_PER_DEVICE = 51.22  # pre_deployment_profiling.md:38
+
+BATCH = 64
+CTX = 512
+BLOCK = 64
+DECODE_STEPS = 64
+WARMUP = 8
+
+
+def main():
+    cfg = mcfg.get_config("llama-3-1b")
+    pages = CTX // BLOCK + 1
+    num_blocks = 1 + BATCH * pages
+    cache = kvc.init_cache(kvc.KvCacheConfig.for_model(
+        cfg, num_blocks=num_blocks, block_size=BLOCK))
+    params = init_params(cfg, jax.random.key(0))
+    step = jax.jit(make_forward_step(cfg, BLOCK), donate_argnums=(1,))
+
+    bt = np.zeros((BATCH, pages), np.int32)
+    for i in range(BATCH):
+        bt[i] = np.arange(1 + i * pages, 1 + (i + 1) * pages)
+    bt = jnp.asarray(bt)
+
+    # Throughput measurement doesn't need semantically meaningful cache
+    # contents: block tables and seq_lens drive the exact same gathers and
+    # FLOPs as a real 512-token context.
+    tokens = jnp.ones((BATCH, 1), jnp.int32)
+
+    def decode_step(cache, tokens, t):
+        positions = jnp.full((BATCH, 1), t, jnp.int32)
+        seq_lens = jnp.full((BATCH,), t + 1, jnp.int32)
+        logits, cache = step(params, cache, tokens, positions, seq_lens, bt)
+        return cache, greedy(logits[:, -1])[:, None]
+
+    t0 = time.perf_counter()
+    for i in range(WARMUP):
+        cache, tokens = decode_step(cache, tokens, CTX + i)
+    tokens.block_until_ready()
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for i in range(DECODE_STEPS):
+        cache, tokens = decode_step(cache, tokens, CTX + WARMUP + i)
+    tokens.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    tok_per_s = BATCH * DECODE_STEPS / elapsed
+    itl_ms = 1000.0 * elapsed / DECODE_STEPS
+    print(json.dumps({
+        "metric": "decode_throughput_llama1b_b64_ctx512",
+        "value": round(tok_per_s, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_per_s / REFERENCE_DECODE_TOK_S_PER_DEVICE, 3),
+        "itl_ms": round(itl_ms, 3),
+        "warmup_s": round(compile_s, 1),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,479 @@
+"""The inference engine: device state + step loop + async streaming.
+
+Replaces the reference's delegated engines (vLLM `AsyncLLM` wrapped at
+`components/backends/vllm/src/dynamo/vllm/main.py:116`) with our own:
+
+- `EngineCore` — synchronous: owns params, the paged cache, the compiled
+  step per (batch/chunk) bucket, and the scheduler; `step()` runs one
+  engine iteration and returns per-request deltas.  Deviceless tests can
+  drive it directly on CPU.
+- `InferenceEngine` — the async facade workers serve: `generate()` yields
+  token deltas as an async stream (the `AsyncEngine.generate →
+  ManyOut<Resp>` contract, reference `lib/runtime/src/engine.rs:207`),
+  running the core loop in a dedicated thread so device blocking never
+  stalls the event loop.
+
+KV events: page completions emit chained-hash STORED events and frees emit
+REMOVED events through a pluggable publisher — the same event stream the
+reference's vLLM worker bridges over ZMQ (`kv_router/publisher.rs:222`),
+here born native.
+
+Padding discipline (see scheduler.py): block tables are `max_pages + 1`
+wide with the last column permanently null, and all padding writes target
+position `max_pages * block_size`, which lands in the null block — padded
+lanes can never corrupt live cache pages.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine import kv_cache as kvc
+from dynamo_tpu.engine.sampling import SamplingParams, sample
+from dynamo_tpu.engine.scheduler import (
+    BlockAllocator,
+    DecodeWork,
+    FinishReason,
+    PrefillWork,
+    Request,
+    RequestState,
+    Scheduler,
+    SchedulerConfig,
+)
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvCacheEventData,
+    KvStats,
+    WorkerStats,
+)
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.llama import Params, init_params, make_forward_step
+from dynamo_tpu.tokens import TokenBlockSequence
+from dynamo_tpu.parallel.sharding import (
+    cache_pspecs,
+    make_sharded_step,
+    param_pspecs,
+    shard_pytree,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TokenDelta:
+    """One engine-step output for one request."""
+
+    request_id: str
+    token_ids: List[int]
+    finished: bool = False
+    finish_reason: Optional[FinishReason] = None
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    model: ModelConfig
+    num_blocks: int = 512
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+    cache_dtype: Optional[jnp.dtype] = None
+    mesh: Optional[object] = None          # jax.sharding.Mesh for tp/ep
+    seed: int = 0
+    enable_kv_events: bool = True
+
+
+class EngineCore:
+    """Synchronous engine: one `step()` = one scheduler plan executed."""
+
+    def __init__(
+        self,
+        config: EngineConfig,
+        params: Optional[Params] = None,
+        kv_event_sink: Optional[Callable[[KvCacheEvent], None]] = None,
+    ) -> None:
+        self.config = config
+        cfg = config.model
+        sched_cfg = config.scheduler
+        self.block_size = sched_cfg.block_size
+        self.cache_cfg = kvc.KvCacheConfig.for_model(
+            cfg, num_blocks=config.num_blocks, block_size=self.block_size,
+            dtype=config.cache_dtype,
+        )
+        self.allocator = BlockAllocator(config.num_blocks)
+        self.scheduler = Scheduler(sched_cfg, self.allocator)
+        self.mesh = config.mesh
+
+        if params is None:
+            params = init_params(cfg, jax.random.key(config.seed))
+        if self.mesh is not None:
+            params = shard_pytree(params, param_pspecs(cfg), self.mesh)
+            self._step = make_sharded_step(cfg, self.block_size, self.mesh)
+            cache = shard_pytree(
+                kvc.init_cache(self.cache_cfg), cache_pspecs(), self.mesh)
+        else:
+            self._step = jax.jit(
+                make_forward_step(cfg, self.block_size), donate_argnums=(1,))
+            cache = kvc.init_cache(self.cache_cfg)
+        self.params = params
+        self.cache = cache
+
+        self._table_width = sched_cfg.max_pages_per_seq + 1  # last col null
+        self._pad_position = sched_cfg.max_pages_per_seq * self.block_size
+        self._requests: Dict[str, Request] = {}
+        self._hash_seqs: Dict[str, TokenBlockSequence] = {}
+        self._published_blocks: Dict[str, int] = {}  # req -> #blocks published
+        self._kv_event_sink = kv_event_sink
+        self._event_id = 0
+        self._rng = jax.random.key(config.seed + 1)
+        self.step_count = 0
+        self.metrics = ForwardPassMetrics(
+            worker_stats=WorkerStats(
+                request_total_slots=config.scheduler.max_seqs),
+            kv_stats=KvStats(kv_total_blocks=config.num_blocks - 1),
+        )
+
+    # -- request lifecycle ------------------------------------------------
+
+    def add_request(
+        self,
+        request_id: str,
+        prompt_tokens: List[int],
+        sampling: SamplingParams,
+    ) -> None:
+        if request_id in self._requests:
+            raise ValueError(f"duplicate request id {request_id}")
+        if not prompt_tokens:
+            raise ValueError("empty prompt")
+        req = Request(request_id=request_id,
+                      prompt_tokens=list(prompt_tokens), sampling=sampling)
+        self._requests[request_id] = req
+        self.scheduler.add_request(req)
+
+    def cancel(self, request_id: str) -> None:
+        req = self._requests.get(request_id)
+        if req and req.state is not RequestState.FINISHED:
+            self._finish(req, FinishReason.CANCELLED)
+
+    def has_request(self, request_id: str) -> bool:
+        return request_id in self._requests
+
+    @property
+    def has_work(self) -> bool:
+        """True while any request needs a step() — including finished ones
+        whose terminal delta hasn't been collected yet (admission-rejected
+        and cancelled requests only surface through _collect_dead)."""
+        return bool(self._requests)
+
+    # -- stepping ---------------------------------------------------------
+
+    def step(self) -> List[TokenDelta]:
+        """Run one engine iteration; returns token deltas (may be empty)."""
+        plan = self.scheduler.plan()
+        deltas: List[TokenDelta] = []
+        if plan.empty:
+            # Surface requests admission-rejected into FINISHED (too long).
+            self._collect_dead(deltas)
+            return deltas
+
+        for work in plan.prefills:
+            delta = self._run_prefill(work)
+            if delta:
+                deltas.append(delta)
+        if plan.decode:
+            deltas.extend(self._run_decode(plan.decode))
+
+        self._collect_dead(deltas)
+        self.step_count += 1
+        self._refresh_metrics()
+        return deltas
+
+    def _collect_dead(self, deltas: List[TokenDelta]) -> None:
+        for rid, req in list(self._requests.items()):
+            if req.state is RequestState.FINISHED and req.finish_reason is not None:
+                deltas.append(TokenDelta(
+                    request_id=rid, token_ids=[], finished=True,
+                    finish_reason=req.finish_reason))
+                self._drop(req)
+
+    def _refresh_metrics(self) -> None:
+        ws = self.metrics.worker_stats
+        ws.request_active_slots = len(self.scheduler.running)
+        ws.num_requests_waiting = len(self.scheduler.waiting)
+        ks = self.metrics.kv_stats
+        ks.kv_active_blocks = (self.allocator.num_blocks - 1
+                               - self.allocator.free_blocks)
+        ks.gpu_cache_usage_perc = self.allocator.usage
+
+    # -- internals --------------------------------------------------------
+
+    def _block_table(self, req: Request) -> np.ndarray:
+        bt = np.zeros((self._table_width,), np.int32)
+        bt[: len(req.pages)] = req.pages
+        return bt
+
+    def _run_prefill(self, work: PrefillWork) -> Optional[TokenDelta]:
+        req = work.request
+        bucket = work.bucket
+        tokens = np.zeros((1, bucket), np.int32)
+        positions = np.full((1, bucket), self._pad_position, np.int32)
+        chunk = req.prompt_tokens[work.start: work.start + work.length]
+        tokens[0, : work.length] = chunk
+        positions[0, : work.length] = np.arange(work.start,
+                                                work.start + work.length)
+        seq_lens = np.asarray([work.start + work.length], np.int32)
+        bt = self._block_table(req)[None, :]
+
+        logits, self.cache = self._step(
+            self.params, self.cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(seq_lens), jnp.asarray(bt))
+
+        self.scheduler.prefill_done(work)
+        self._publish_completed_blocks(req)
+        if req.state is not RequestState.DECODE:
+            return None  # more prompt chunks to go
+
+        # Prompt complete: sample the first output token from the last
+        # real position of this chunk (this is TTFT).
+        token = self._sample_rows(
+            logits[:, work.length - 1], [req])[0]
+        return self._append_token(req, int(token))
+
+    def _run_decode(self, work: DecodeWork) -> List[TokenDelta]:
+        reqs = work.requests
+        bucket = work.bucket
+        n = len(reqs)
+
+        tokens = np.zeros((bucket, 1), np.int32)
+        positions = np.full((bucket, 1), self._pad_position, np.int32)
+        seq_lens = np.zeros((bucket,), np.int32)
+        bts = np.zeros((bucket, self._table_width), np.int32)
+
+        live: List[Request] = []
+        for i, req in enumerate(reqs):
+            # The token being fed is the last sampled one; its KV lands at
+            # position context_len and seq becomes context_len + 1.
+            pos = req.context_len
+            if not self.scheduler.ensure_capacity(req, pos + 1):
+                self._finish(req, FinishReason.LENGTH)
+                continue
+            tokens[i, 0] = (req.output_tokens[-1] if req.output_tokens
+                            else req.prompt_tokens[-1])
+            positions[i, 0] = pos
+            seq_lens[i] = pos + 1
+            bts[i, : len(req.pages)] = req.pages
+            live.append(req)
+
+        if not live:
+            return []
+
+        logits, self.cache = self._step(
+            self.params, self.cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(seq_lens), jnp.asarray(bts))
+
+        sampled = self._sample_rows(logits[: len(reqs), -1], reqs)
+        deltas = []
+        for i, req in enumerate(reqs):
+            if req not in live:
+                continue
+            # Publish blocks sealed by *previous* tokens before appending:
+            # if this token finishes the request, its state is dropped and a
+            # late publish would re-emit the whole sequence from scratch.
+            self._publish_completed_blocks(req)
+            deltas.append(self._append_token(req, int(sampled[i])))
+        return deltas
+
+    def _sample_rows(self, logits: jax.Array, reqs: List[Request]) -> np.ndarray:
+        n = logits.shape[0]
+        temp = np.asarray([r.sampling.temperature for r in reqs[:n]]
+                          + [0.0] * (n - len(reqs)), np.float32)
+        top_k = np.asarray([r.sampling.top_k for r in reqs[:n]]
+                           + [0] * (n - len(reqs)), np.int32)
+        top_p = np.asarray([r.sampling.top_p for r in reqs[:n]]
+                           + [1.0] * (n - len(reqs)), np.float32)
+        # Per-row keys: a seeded request's stream depends only on
+        # (seed, token index) — reproducible regardless of batch mix.
+        keys = []
+        for r in reqs[:n]:
+            if r.sampling.seed is not None:
+                keys.append(jax.random.fold_in(
+                    jax.random.key(r.sampling.seed), len(r.output_tokens)))
+            else:
+                self._rng, k = jax.random.split(self._rng)
+                keys.append(k)
+        keys.extend(jax.random.key(0) for _ in range(n - len(reqs)))
+        out = sample(logits, jnp.asarray(temp), jnp.asarray(top_k),
+                     jnp.asarray(top_p), jnp.stack(keys))
+        return np.asarray(jax.device_get(out))
+
+    def _append_token(self, req: Request, token: int) -> TokenDelta:
+        if req.first_token_ts is None:
+            req.first_token_ts = time.monotonic()
+        req.output_tokens.append(token)
+        stop = token in req.sampling.stop_token_ids
+        length = len(req.output_tokens) >= req.sampling.max_tokens
+        if stop or length:
+            self._finish(req, FinishReason.STOP if stop else FinishReason.LENGTH)
+            delta = TokenDelta(req.request_id, [token], finished=True,
+                               finish_reason=req.finish_reason)
+            self._drop(req)
+            return delta
+        return TokenDelta(req.request_id, [token])
+
+    def _finish(self, req: Request, reason: FinishReason) -> None:
+        self._publish_removed_blocks(req)
+        self.scheduler.finish(req, reason)
+
+    def _drop(self, req: Request) -> None:
+        self._requests.pop(req.request_id, None)
+        self._hash_seqs.pop(req.request_id, None)
+        self._published_blocks.pop(req.request_id, None)
+
+    # -- KV events --------------------------------------------------------
+
+    def _publish_completed_blocks(self, req: Request) -> None:
+        """Emit STORED events for pages newly filled by this request."""
+        if not self._kv_event_sink or not self.config.enable_kv_events:
+            return
+        if req.request_id not in self._requests:
+            return  # already finished and dropped
+        seq = self._hash_seqs.get(req.request_id)
+        if seq is None:
+            seq = TokenBlockSequence(block_size=self.block_size)
+            self._hash_seqs[req.request_id] = seq
+        all_tokens = req.prompt_tokens[: req.prefilled] + req.output_tokens
+        seq.extend(all_tokens[len(seq):])
+        done = self._published_blocks.get(req.request_id, 0)
+        complete = seq.blocks  # sealed blocks only
+        if len(complete) <= done:
+            return
+        new = complete[done:]
+        parent = complete[done - 1].block_hash if done else None
+        self._emit(KvCacheEventData.stored(
+            [b.block_hash for b in new], parent_hash=parent))
+        self._published_blocks[req.request_id] = len(complete)
+
+    def _publish_removed_blocks(self, req: Request) -> None:
+        if not self._kv_event_sink or not self.config.enable_kv_events:
+            return
+        seq = self._hash_seqs.get(req.request_id)
+        done = self._published_blocks.get(req.request_id, 0)
+        if not seq or not done:
+            return
+        hashes = [b.block_hash for b in seq.blocks[:done]]
+        self._emit(KvCacheEventData.removed(hashes))
+
+    def _emit(self, data: KvCacheEventData) -> None:
+        self._event_id += 1
+        self._kv_event_sink(KvCacheEvent(event_id=self._event_id, data=data))
+
+
+class InferenceEngine:
+    """Async facade: background step-loop thread + per-request streams.
+
+    The event loop never touches the core directly: submissions and
+    cancellations are enqueued under a micro-lock (never held across device
+    work) and drained by the engine thread before each step, so a
+    multi-second XLA compile inside step() cannot stall the event loop.
+    """
+
+    def __init__(self, core: EngineCore) -> None:
+        self.core = core
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._cmd_lock = threading.Lock()
+        self._pending_adds: List[tuple] = []
+        self._pending_cancels: List[str] = []
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._thread = threading.Thread(
+            target=self._run_loop, name="engine-step-loop", daemon=True)
+        self._thread.start()
+
+    async def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread:
+            await asyncio.to_thread(self._thread.join, 10.0)
+
+    def _run_loop(self) -> None:
+        while not self._stop.is_set():
+            self._drain_commands()
+            busy = self.core.has_work
+            deltas = self.core.step() if busy else []
+            for d in deltas:
+                self._dispatch(d)
+            if not busy:
+                self._wake.wait(timeout=0.005)
+                self._wake.clear()
+
+    def _drain_commands(self) -> None:
+        with self._cmd_lock:
+            adds, self._pending_adds = self._pending_adds, []
+            cancels, self._pending_cancels = self._pending_cancels, []
+        for rid, prompt, sampling in adds:
+            try:
+                self.core.add_request(rid, prompt, sampling)
+            except ValueError as e:
+                self._dispatch(TokenDelta(
+                    request_id=rid, token_ids=[], finished=True,
+                    finish_reason=FinishReason.ERROR))
+                logger.warning("rejecting request %s: %s", rid, e)
+        for rid in cancels:
+            self.core.cancel(rid)
+
+    def _dispatch(self, delta: TokenDelta) -> None:
+        q = self._queues.get(delta.request_id)
+        if q is None:
+            return
+        assert self._loop is not None
+        self._loop.call_soon_threadsafe(q.put_nowait, delta)
+
+    # -- serving API ------------------------------------------------------
+
+    async def generate(
+        self,
+        request_id: str,
+        prompt_tokens: List[int],
+        sampling: SamplingParams,
+    ) -> AsyncIterator[TokenDelta]:
+        """Submit and stream deltas until the request finishes.
+
+        Cancellation: breaking out of / closing this generator cancels the
+        request on the engine (reference disconnect semantics,
+        `http/service/disconnect.rs`)."""
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[request_id] = q
+        with self._cmd_lock:
+            self._pending_adds.append((request_id, prompt_tokens, sampling))
+        self._wake.set()
+        try:
+            while True:
+                delta = await q.get()
+                yield delta
+                if delta.finished:
+                    return
+        finally:
+            self._queues.pop(request_id, None)
+            with self._cmd_lock:
+                self._pending_cancels.append(request_id)
+            self._wake.set()
+
+    @property
+    def metrics(self) -> ForwardPassMetrics:
+        return self.core.metrics

@@ -92,13 +92,19 @@ def slots_for_positions(
 ) -> jax.Array:
     """Flat slot index for each (sequence, position): `bt[pos//bs]*bs + pos%bs`.
 
-    Positions past a sequence's allocated pages must be masked by the caller
-    (they resolve to whatever block id sits at that table entry — padded
-    entries are NULL_BLOCK, whose slots are junk by design).
+    Positions whose page index falls past the table width resolve to the
+    null block explicitly (not clip-to-last-column, which would alias a
+    *real* page and corrupt cached context); within-table entries that were
+    never allocated are NULL_BLOCK by construction, so their slots are junk
+    by design and must stay masked by the caller.
     """
     block_idx = positions // block_size            # [B, T]
     offset = positions % block_size                # [B, T]
-    block_ids = jnp.take_along_axis(block_tables, block_idx, axis=1)  # [B, T]
+    P = block_tables.shape[1]
+    in_range = block_idx < P
+    block_ids = jnp.take_along_axis(
+        block_tables, jnp.minimum(block_idx, P - 1), axis=1)  # [B, T]
+    block_ids = jnp.where(in_range, block_ids, NULL_BLOCK)
     return block_ids * block_size + offset
 
 

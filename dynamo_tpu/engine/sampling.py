@@ -41,37 +41,49 @@ def sample(
     temperature: jax.Array,   # [B]
     top_k: jax.Array,         # [B] int32, 0 = off
     top_p: jax.Array,         # [B] float32, 1.0 = off
-    key: jax.Array,           # PRNG key
+    key: jax.Array,           # PRNG key, single or [B] batch of keys
 ) -> jax.Array:
-    """Sample one token per row.  Greedy where temperature == 0."""
+    """Sample one token per row.  Greedy where temperature == 0.
+
+    `key` may be a batch of per-row keys (shape [B] of typed keys): seeded
+    requests get reproducible streams independent of which other requests
+    share the batch (the engine folds request seed + step index per row).
+    """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
     safe_temp = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_temp[:, None]
 
-    # top-k: mask everything below the k-th largest logit.  Vectorised over
-    # rows by ranking: rank[i] = number of logits strictly greater.
+    # One descending sort serves both filters (this is the ITL-critical
+    # sampling path; a second O(V log V) sort would be pure waste).
     sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]          # [B, V]
+
+    # top-k: mask everything below the k-th largest logit.
     k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)
     kth = jnp.take_along_axis(sorted_desc, (k_eff - 1)[:, None], axis=1)
     scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
 
-    # top-p (nucleus): keep the smallest prefix of the sorted distribution
-    # with cumulative prob >= top_p; implemented on sorted copy then mapped
-    # back via threshold logit.  top_p >= 1 is "off" and must bypass the
-    # cutoff entirely: float32 cumsum can round below 1.0, which would
-    # otherwise make argmax pick index 0 and collapse sampling to greedy.
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    # top-p (nucleus) on the top-k-masked distribution: in sorted space the
+    # top-k survivors are exactly the first k_eff columns, so mask the rest
+    # and take the smallest prefix with cumulative prob >= top_p.  top_p >=
+    # 1 is "off" and must bypass the cutoff entirely: float32 cumsum can
+    # round below 1.0, which would otherwise make argmax pick index 0 and
+    # collapse sampling to greedy.
+    col = jnp.arange(V)[None, :]
+    sorted_masked = jnp.where(col < k_eff[:, None], sorted_desc, -jnp.inf)
+    probs_sorted = jax.nn.softmax(sorted_masked, axis=-1)
     cumprobs = jnp.cumsum(probs_sorted, axis=-1)
     # index of first position where cumulative >= top_p (inclusive)
     cutoff_idx = jnp.argmax(cumprobs >= top_p[:, None], axis=-1)
-    cutoff_logit = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None], axis=1)
+    cutoff_logit = jnp.take_along_axis(sorted_masked, cutoff_idx[:, None], axis=1)
     top_p_on = (top_p < 1.0)[:, None]
     scaled = jnp.where(top_p_on & (scaled < cutoff_logit), -jnp.inf, scaled)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1)
+    if key.ndim > 0:
+        sampled = jax.vmap(jax.random.categorical)(key, scaled)
+    else:
+        sampled = jax.random.categorical(key, scaled, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
 

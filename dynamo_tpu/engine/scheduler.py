@@ -1,0 +1,293 @@
+"""Continuous-batching scheduler with XLA-friendly fixed shapes.
+
+Semantics mirror what the reference's mocker models of vLLM
+(`lib/llm/src/mocker/scheduler.rs` — watermark admission, chunked-prefill
+token budget, block-per-page accounting) but drive a *real* engine; the
+XLA twist is that every device step must hit a previously-compiled shape:
+
+- decode runs at batch buckets (1, 2, 4, ... max_seqs), padding with null
+  rows (seq_len 0, null block table) — one compiled program per bucket;
+- prefill runs one sequence per step at chunk-length buckets (powers of
+  two up to `max_prefill_chunk`), so a prompt of 1234 tokens costs
+  ceil(1234/512) chunk steps of static shape;
+- block tables have static width `max_pages` (covers `max_context`).
+
+The scheduler itself is synchronous and deviceless — it only decides what
+to run; the engine owns device arrays.  That makes admission/eviction
+logic unit-testable at full speed (reference test strategy, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from dynamo_tpu.engine.sampling import SamplingParams
+
+logger = logging.getLogger(__name__)
+
+
+class FinishReason(str, enum.Enum):
+    STOP = "stop"            # stop token / stop string hit
+    LENGTH = "length"        # max_tokens or context limit
+    CANCELLED = "cancelled"  # client disconnected / cancelled
+    ERROR = "error"
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    """One in-flight generation."""
+
+    request_id: str
+    prompt_tokens: List[int]
+    sampling: SamplingParams
+    state: RequestState = RequestState.WAITING
+    # progress
+    prefilled: int = 0                    # prompt tokens already processed
+    output_tokens: List[int] = field(default_factory=list)
+    pages: List[int] = field(default_factory=list)
+    slot: Optional[int] = None            # decode slot index while active
+    finish_reason: Optional[FinishReason] = None
+    arrival_ts: float = field(default_factory=time.monotonic)
+    first_token_ts: Optional[float] = None
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt_tokens) + len(self.output_tokens)
+
+    @property
+    def context_len(self) -> int:
+        """Tokens whose KV is in cache."""
+        return self.prefilled + len(self.output_tokens)
+
+
+class BlockAllocator:
+    """Free-list page allocator over the paged cache (block 0 reserved null).
+
+    Prefix-cache reuse lives in the block manager (dynamo_tpu/llm/
+    block_manager); this allocator only tracks ownership, and reports the
+    watermark the admission check uses (reference mocker `KvManager`
+    watermark semantics)."""
+
+    def __init__(self, num_blocks: int) -> None:
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is the null block)")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def usage(self) -> float:
+        usable = self.num_blocks - 1
+        return 1.0 - len(self._free) / usable
+
+    def allocate(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"out of KV blocks: want {n}, free {len(self._free)}")
+        return [self._free.pop() for _ in range(n)]
+
+    def release(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            if p == 0:
+                raise ValueError("attempt to free the null block")
+            self._free.append(p)
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Knobs, defaults sized like the reference mocker's
+    (`mocker/protocols.rs:79-108`: 16384 blocks, block 64, 256 seqs,
+    8192 batched tokens, watermark 0.01)."""
+
+    max_seqs: int = 64
+    max_prefill_chunk: int = 512
+    max_batched_tokens: int = 8192
+    block_size: int = 64
+    max_pages_per_seq: int = 128          # static block-table width
+    watermark: float = 0.01               # min free-block fraction to admit
+    decode_buckets: tuple = (1, 2, 4, 8, 16, 32, 64)
+    prefill_buckets: tuple = (16, 32, 64, 128, 256, 512)
+
+    def __post_init__(self):
+        if self.max_seqs > max(self.decode_buckets):
+            raise ValueError(
+                f"max_seqs={self.max_seqs} exceeds largest decode bucket "
+                f"{max(self.decode_buckets)}; padded arrays would overflow")
+        if self.max_prefill_chunk > max(self.prefill_buckets):
+            raise ValueError(
+                f"max_prefill_chunk={self.max_prefill_chunk} exceeds largest "
+                f"prefill bucket {max(self.prefill_buckets)}")
+
+    def bucket_for_decode(self, n: int) -> int:
+        for b in self.decode_buckets:
+            if n <= b:
+                return b
+        return self.decode_buckets[-1]
+
+    def bucket_for_prefill(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+
+@dataclass
+class PrefillWork:
+    """One prefill chunk for one sequence (static chunk-length bucket)."""
+
+    request: Request
+    start: int        # absolute position of chunk start
+    length: int       # real tokens in chunk
+    bucket: int       # padded chunk length to run
+
+
+@dataclass
+class DecodeWork:
+    """One decode step over all decoding sequences (padded to bucket)."""
+
+    requests: List[Request]
+    bucket: int
+
+
+@dataclass
+class StepPlan:
+    prefills: List[PrefillWork]
+    decode: Optional[DecodeWork]
+
+    @property
+    def empty(self) -> bool:
+        return not self.prefills and self.decode is None
+
+
+class Scheduler:
+    """Decides, each engine iteration, which chunks to run."""
+
+    def __init__(self, config: SchedulerConfig, allocator: BlockAllocator) -> None:
+        self.config = config
+        self.allocator = allocator
+        self.waiting: List[Request] = []
+        self.running: List[Request] = []       # PREFILL or DECODE
+        self._slots: List[Optional[Request]] = [None] * config.max_seqs
+
+    # -- admission --------------------------------------------------------
+
+    def add_request(self, req: Request) -> None:
+        max_ctx = self.config.max_pages_per_seq * self.config.block_size
+        if len(req.prompt_tokens) + req.sampling.max_tokens > max_ctx:
+            req.state = RequestState.FINISHED
+            req.finish_reason = FinishReason.LENGTH
+            return
+        self.waiting.append(req)
+
+    def _pages_needed(self, tokens: int) -> int:
+        return (tokens + self.config.block_size - 1) // self.config.block_size
+
+    def _try_admit(self) -> None:
+        usable = self.allocator.num_blocks - 1
+        while self.waiting and len(self.running) < self.config.max_seqs:
+            req = self.waiting[0]
+            # Admit only if the prompt's pages fit and leave the watermark.
+            need = self._pages_needed(len(req.prompt_tokens) + 1)
+            if self.allocator.free_blocks - need < self.config.watermark * usable:
+                break
+            slot = next(
+                (i for i, s in enumerate(self._slots) if s is None), None)
+            if slot is None:
+                break
+            self.waiting.pop(0)
+            req.pages = self.allocator.allocate(need)
+            req.slot = slot
+            self._slots[slot] = req
+            req.state = RequestState.PREFILL
+            self.running.append(req)
+
+    # -- page growth ------------------------------------------------------
+
+    def ensure_capacity(self, req: Request, new_len: int) -> bool:
+        """Grow req's page list to cover new_len tokens; False if OOM."""
+        need = self._pages_needed(new_len)
+        if need > self.config.max_pages_per_seq:
+            return False
+        while len(req.pages) < need:
+            if self.allocator.free_blocks == 0:
+                return False
+            req.pages.extend(self.allocator.allocate(1))
+        return True
+
+    # -- planning ---------------------------------------------------------
+
+    def plan(self) -> StepPlan:
+        """Build this iteration's work under the batched-token budget.
+
+        Decode-first (latency): all DECODE sequences take one step; the
+        remaining token budget goes to prefill chunks, longest-waiting
+        first (FCFS, like the reference mocker)."""
+        self._try_admit()
+
+        budget = self.config.max_batched_tokens
+        decoding = [r for r in self.running if r.state is RequestState.DECODE]
+        decode = None
+        if decoding:
+            decode = DecodeWork(
+                requests=decoding,
+                bucket=self.config.bucket_for_decode(len(decoding)),
+            )
+            budget -= len(decoding)
+
+        prefills: List[PrefillWork] = []
+        for req in self.running:
+            if req.state is not RequestState.PREFILL:
+                continue
+            if budget <= 0:
+                break
+            remaining = len(req.prompt_tokens) - req.prefilled
+            chunk = min(remaining, self.config.max_prefill_chunk, budget)
+            if chunk <= 0:
+                continue
+            prefills.append(PrefillWork(
+                request=req,
+                start=req.prefilled,
+                length=chunk,
+                bucket=self.config.bucket_for_prefill(chunk),
+            ))
+            budget -= chunk
+        return StepPlan(prefills=prefills, decode=decode)
+
+    # -- completion callbacks --------------------------------------------
+
+    def prefill_done(self, work: PrefillWork) -> None:
+        req = work.request
+        req.prefilled += work.length
+        if req.prefilled >= len(req.prompt_tokens):
+            req.state = RequestState.DECODE
+
+    def finish(self, req: Request, reason: FinishReason) -> None:
+        req.state = RequestState.FINISHED
+        req.finish_reason = reason
+        if req in self.running:
+            self.running.remove(req)
+        if req in self.waiting:
+            self.waiting.remove(req)
+        if req.slot is not None:
+            self._slots[req.slot] = None
+            req.slot = None
+        if req.pages:
+            self.allocator.release(req.pages)
+            req.pages = []
+
+    @property
+    def num_active(self) -> int:
+        return len(self.running) + len(self.waiting)

@@ -1,0 +1,2 @@
+"""OpenAI HTTP frontend entrypoint (reference `dynamo.frontend`,
+`components/frontend/src/dynamo/frontend/main.py`)."""

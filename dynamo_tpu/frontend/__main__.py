@@ -1,0 +1,3 @@
+from dynamo_tpu.frontend.main import main
+
+main()

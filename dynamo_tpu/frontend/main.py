@@ -1,0 +1,108 @@
+"""`python -m dynamo_tpu.frontend` — OpenAI ingress + engine in one process.
+
+Mirrors the reference frontend flags surface (`components/frontend/.../
+main.py`: --http-port, --router-mode, ...) for the aggregated single-process
+case; distributed modes (remote workers over the runtime's transports,
+KV-aware routing across replicas) attach through the same ModelManager as
+they land.
+
+Engines:
+  --mocker            mock engine (no device, KV-authentic; CI/demo)
+  --model PRESET      real JAX engine on a model preset (random weights
+                      unless --checkpoint)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.service import LocalEngineClient, ModelHandle, ModelManager
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, HFTokenizer
+
+logger = logging.getLogger("dynamo_tpu.frontend")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.frontend")
+    p.add_argument("--http-host", default="127.0.0.1")
+    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--model-name", default="dynamo-tpu")
+    p.add_argument("--mocker", action="store_true",
+                   help="serve the mock engine (no accelerator)")
+    p.add_argument("--model", default=None,
+                   help="model preset name for the JAX engine "
+                        "(e.g. llama-3-1b, tiny-test)")
+    p.add_argument("--tokenizer", default=None,
+                   help="path to a tokenizer.json (default: byte tokenizer)")
+    p.add_argument("--num-blocks", type=int, default=512)
+    p.add_argument("--block-size", type=int, default=64)
+    p.add_argument("--max-tokens-default", type=int, default=512)
+    p.add_argument("--speedup-ratio", type=float, default=10.0,
+                   help="mocker simulated-time compression")
+    return p.parse_args(argv)
+
+
+async def build_model_handle(args) -> tuple:
+    """Returns (handle, shutdown coroutine)."""
+    tokenizer = (HFTokenizer(args.tokenizer) if args.tokenizer
+                 else ByteTokenizer())
+    pre = OpenAIPreprocessor(tokenizer,
+                             default_max_tokens=args.max_tokens_default)
+
+    if args.mocker:
+        from dynamo_tpu.llm.mocker import MockEngine, MockEngineArgs
+
+        engine = MockEngine(MockEngineArgs(
+            block_size=args.block_size,
+            speedup_ratio=args.speedup_ratio))
+        await engine.start()
+        handle = ModelHandle(name=args.model_name, tokenizer=tokenizer,
+                             preprocessor=pre, client=engine)
+        return handle, engine.stop
+
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore, InferenceEngine
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models.config import get_config
+
+    cfg = get_config(args.model or "llama-3-1b")
+    core = EngineCore(EngineConfig(
+        model=cfg, num_blocks=args.num_blocks,
+        scheduler=SchedulerConfig(block_size=args.block_size)))
+    engine = InferenceEngine(core)
+    await engine.start()
+    handle = ModelHandle(name=args.model_name, tokenizer=tokenizer,
+                         preprocessor=pre,
+                         client=LocalEngineClient(engine))
+    return handle, engine.stop
+
+
+async def run(args) -> None:
+    handle, shutdown = await build_model_handle(args)
+    models = ModelManager()
+    models.register(handle)
+    svc = HttpService(models)
+    port = await svc.start(args.http_host, args.http_port)
+    print(f"dynamo_tpu frontend serving {handle.name!r} "
+          f"on http://{args.http_host}:{port}", flush=True)
+
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop_ev.set)
+    await stop_ev.wait()
+    await svc.stop()
+    await shutdown()
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

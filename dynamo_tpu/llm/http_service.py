@@ -1,0 +1,259 @@
+"""OpenAI-compatible HTTP service (aiohttp).
+
+Role of the reference's axum server (`lib/llm/src/http/service/openai.rs`):
+/v1/chat/completions, /v1/completions, /v1/models with SSE streaming,
+client-disconnect cancellation (`disconnect.rs` — here: the request
+generator is closed when aiohttp detects the peer went away, which
+cancels the engine request), request metrics incl. TTFT/ITL histograms
+(`metrics.rs`), /metrics exposition, and /health & /live endpoints
+(reference `system_status_server.rs`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.engine.scheduler import FinishReason
+from dynamo_tpu.llm.backend import StreamDetokenizer, wire_finish_reason
+from dynamo_tpu.llm.protocols import openai as oai
+from dynamo_tpu.llm.service import ModelHandle, ModelManager
+from dynamo_tpu.runtime.metrics import FrontendMetrics, MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class HttpService:
+    def __init__(
+        self,
+        models: ModelManager,
+        registry: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self.models = models
+        self.registry = registry or MetricsRegistry()
+        self.metrics = FrontendMetrics(self.registry)
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self.chat_completions)
+        self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_get("/v1/models", self.list_models)
+        self.app.router.add_get("/metrics", self.prometheus)
+        self.app.router.add_get("/health", self.health)
+        self.app.router.add_get("/live", self.live)
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Bind and serve; returns the bound port (0 → ephemeral)."""
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        logger.info("HTTP service on %s:%s", host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- helpers ----------------------------------------------------------
+
+    @staticmethod
+    def _error(status: int, message: str, type_: str = "invalid_request_error"):
+        body = oai.ErrorResponse(
+            error=oai.ErrorDetail(message=message, type=type_))
+        return web.json_response(body.model_dump(exclude_none=True),
+                                 status=status)
+
+    def _lookup(self, model: str) -> Optional[ModelHandle]:
+        return self.models.get(model)
+
+    # -- routes -----------------------------------------------------------
+
+    async def health(self, _req: web.Request) -> web.Response:
+        ready = len(self.models) > 0
+        return web.json_response(
+            {"status": "ready" if ready else "starting",
+             "models": self.models.names()},
+            status=200 if ready else 503)
+
+    async def live(self, _req: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def prometheus(self, _req: web.Request) -> web.Response:
+        return web.Response(text=self.registry.expose(),
+                            content_type="text/plain")
+
+    async def list_models(self, _req: web.Request) -> web.Response:
+        listing = oai.ModelList(
+            data=[oai.ModelInfo(id=n) for n in self.models.names()])
+        return web.json_response(listing.model_dump())
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = oai.ChatCompletionRequest.model_validate(await request.json())
+        except Exception as e:
+            return self._error(400, f"invalid request: {e}")
+        handle = self._lookup(body.model)
+        if handle is None:
+            return self._error(404, f"model {body.model!r} not found",
+                               "model_not_found")
+        rid = oai.request_id("chatcmpl")
+        try:
+            pre = handle.preprocessor.preprocess_chat(body, rid)
+        except ValueError as e:
+            return self._error(400, str(e))
+        if body.stream:
+            return await self._stream_chat(request, handle, body, pre, rid)
+        return await self._unary_chat(handle, body, pre, rid)
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = oai.CompletionRequest.model_validate(await request.json())
+        except Exception as e:
+            return self._error(400, f"invalid request: {e}")
+        handle = self._lookup(body.model)
+        if handle is None:
+            return self._error(404, f"model {body.model!r} not found",
+                               "model_not_found")
+        rid = oai.request_id("cmpl")
+        try:
+            pre = handle.preprocessor.preprocess_completion(body, rid)
+        except ValueError as e:
+            return self._error(400, str(e))
+
+        start = time.monotonic()
+        self.metrics.requests_total.inc(labels={"model": body.model})
+        self.metrics.requests_in_flight.add(1, labels={"model": body.model})
+        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
+        text_parts = []
+        reason = None
+        try:
+            async for out in self._token_stream(handle, pre, det, body.model,
+                                                start):
+                text_parts.append(out.text)
+                if out.finished:
+                    reason = out.finish_reason
+        finally:
+            self.metrics.requests_in_flight.add(-1, labels={"model": body.model})
+        self._observe_done(body.model, start, len(pre.token_ids),
+                           det.completion_tokens)
+        resp = oai.CompletionResponse(
+            id=rid, model=body.model,
+            choices=[oai.CompletionChoice(
+                text="".join(text_parts), finish_reason=reason)],
+            usage=oai.Usage(
+                prompt_tokens=len(pre.token_ids),
+                completion_tokens=det.completion_tokens,
+                total_tokens=len(pre.token_ids) + det.completion_tokens))
+        return web.json_response(resp.model_dump(exclude_none=True))
+
+    # -- chat serving internals -------------------------------------------
+
+    async def _token_stream(self, handle, pre, det, model, start_ts):
+        """Engine deltas → TextDeltas, with TTFT/ITL observation."""
+        first = True
+        last_t = None
+        async for delta in handle.client.generate(pre):
+            now = time.monotonic()
+            if delta.token_ids:
+                if first:
+                    self.metrics.ttft.observe(now - start_ts,
+                                              labels={"model": model})
+                    first = False
+                elif last_t is not None:
+                    self.metrics.itl.observe(now - last_t,
+                                             labels={"model": model})
+                last_t = now
+                out = det.push_tokens(delta.token_ids)
+                if out.finished:      # stop string hit mid-stream
+                    yield out
+                    return
+                if out.text:
+                    yield out
+            if delta.finished:
+                yield det.finish(delta.finish_reason)
+                return
+        # Engine stream ended without a finished marker (worker died):
+        yield det.finish(FinishReason.ERROR)
+
+    async def _unary_chat(self, handle, body, pre, rid):
+        start = time.monotonic()
+        self.metrics.requests_total.inc(labels={"model": body.model})
+        self.metrics.requests_in_flight.add(1, labels={"model": body.model})
+        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
+        parts, reason = [], None
+        try:
+            async for out in self._token_stream(handle, pre, det,
+                                                body.model, start):
+                parts.append(out.text)
+                if out.finished:
+                    reason = out.finish_reason
+        finally:
+            self.metrics.requests_in_flight.add(-1, labels={"model": body.model})
+        self._observe_done(body.model, start, len(pre.token_ids),
+                           det.completion_tokens)
+        resp = oai.ChatCompletionResponse(
+            id=rid, model=body.model,
+            choices=[oai.ChatChoice(
+                message=oai.ChatMessage(role="assistant",
+                                        content="".join(parts)),
+                finish_reason=reason)],
+            usage=oai.Usage(
+                prompt_tokens=len(pre.token_ids),
+                completion_tokens=det.completion_tokens,
+                total_tokens=len(pre.token_ids) + det.completion_tokens))
+        return web.json_response(resp.model_dump(exclude_none=True))
+
+    async def _stream_chat(self, request, handle, body, pre, rid):
+        start = time.monotonic()
+        self.metrics.requests_total.inc(labels={"model": body.model})
+        self.metrics.requests_in_flight.add(1, labels={"model": body.model})
+        response = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await response.prepare(request)
+
+        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
+        # Leading chunk with the assistant role (OpenAI convention).
+        head = oai.ChatCompletionChunk(
+            id=rid, model=body.model,
+            choices=[oai.ChatStreamChoice(
+                delta=oai.ChatChoiceDelta(role="assistant", content=""))])
+        await response.write(oai.sse_encode(head).encode())
+        try:
+            async for out in self._token_stream(handle, pre, det,
+                                                body.model, start):
+                chunk = oai.ChatCompletionChunk(
+                    id=rid, model=body.model,
+                    choices=[oai.ChatStreamChoice(
+                        delta=oai.ChatChoiceDelta(content=out.text or None),
+                        finish_reason=out.finish_reason)])
+                await response.write(oai.sse_encode(chunk).encode())
+                if out.finished:
+                    break
+            await response.write(oai.SSE_DONE.encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away: closing the generator cancels the engine
+            # request (reference disconnect.rs semantics).
+            logger.info("client disconnected: %s", rid)
+            raise
+        finally:
+            self.metrics.requests_in_flight.add(-1, labels={"model": body.model})
+            self._observe_done(body.model, start, len(pre.token_ids),
+                               det.completion_tokens)
+        await response.write_eof()
+        return response
+
+    def _observe_done(self, model, start_ts, in_tokens, out_tokens):
+        labels = {"model": model}
+        self.metrics.request_duration.observe(
+            time.monotonic() - start_ts, labels=labels)
+        self.metrics.input_tokens.observe(in_tokens, labels=labels)
+        self.metrics.output_tokens.observe(out_tokens, labels=labels)

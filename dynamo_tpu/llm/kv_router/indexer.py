@@ -208,6 +208,10 @@ class KvIndexer:
                 self.apply_event(ev)
             except Exception:
                 self.malformed_events += 1
+                # getattr: the event may be malformed at the object level
+                # (wrong type entirely); touching .worker_id here must not
+                # re-raise and kill the pump.
                 logger.exception(
-                    "dropping malformed router event from worker %s", ev.worker_id
+                    "dropping malformed router event from worker %s",
+                    getattr(ev, "worker_id", repr(ev)),
                 )

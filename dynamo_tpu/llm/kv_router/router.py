@@ -87,12 +87,15 @@ class KvRouter:
         token_ids: Sequence[int],
         workers: Sequence[WorkerId],
         update_states: bool = True,
+        expected_output_tokens: int = 0,
     ) -> Tuple[WorkerId, int]:
         """Choose a worker for the request; returns (worker, overlap_blocks).
 
         `workers` is the current live instance set.  When `update_states`
         the decision is recorded in the optimistic accounting (callers must
-        later `free(request_id)`).
+        later `free(request_id)`).  `expected_output_tokens` (e.g. the
+        request's max_tokens) pre-reserves decode-growth blocks in that
+        accounting so the selector sees future occupancy.
         """
         if not workers:
             raise ValueError("no live workers to route to")
@@ -122,7 +125,9 @@ class KvRouter:
 
         if update_states:
             self.active.add_request(
-                request_id, chosen.worker_id, len(token_ids), chosen.overlap_blocks
+                request_id, chosen.worker_id, len(token_ids),
+                chosen.overlap_blocks,
+                expected_output_tokens=expected_output_tokens,
             )
             if self.approx:
                 self.approx.process_routing_decision(chosen.worker_id, seq_hashes)

@@ -24,6 +24,7 @@ class ActiveSeq:
     isl_tokens: int          # input sequence length
     overlap_blocks: int      # cached prefix blocks at admission
     total_blocks: int        # blocks the sequence occupies (grows with decode)
+    reserved_blocks: int = 0  # pre-reserved for expected decode growth
     prefilling: bool = True
     created_at: float = 0.0
 
@@ -55,6 +56,7 @@ class ActiveSequences:
             isl_tokens=isl_tokens,
             overlap_blocks=overlap_blocks,
             total_blocks=total_blocks,
+            reserved_blocks=total_blocks,
             created_at=time.monotonic(),
         )
 
@@ -70,7 +72,13 @@ class ActiveSequences:
             return
         seq.prefilling = False
         seq.isl_tokens += n
-        seq.total_blocks = (seq.isl_tokens + self.block_size - 1) // self.block_size
+        # Occupancy never drops below the admission-time reservation: the
+        # pre-reserved decode growth stays visible to the selector until the
+        # sequence actually outgrows it.
+        seq.total_blocks = max(
+            (seq.isl_tokens + self.block_size - 1) // self.block_size,
+            seq.reserved_blocks,
+        )
 
     def free(self, request_id: str) -> None:
         self._seqs.pop(request_id, None)
@@ -127,10 +135,13 @@ class ActiveSequencesMultiWorker:
         worker: WorkerId,
         isl_tokens: int,
         overlap_blocks: int,
+        expected_output_tokens: int = 0,
     ) -> None:
         with self._lock:
             self._request_worker[request_id] = worker
-            self._worker(worker).add_request(request_id, isl_tokens, overlap_blocks)
+            self._worker(worker).add_request(
+                request_id, isl_tokens, overlap_blocks,
+                expected_output_tokens=expected_output_tokens)
 
     def mark_prefill_complete(self, request_id: str) -> None:
         with self._lock:

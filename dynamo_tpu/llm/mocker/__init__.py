@@ -1,0 +1,12 @@
+"""Mock engine: a complete fake engine with authentic KV semantics.
+
+Role of the reference's `lib/llm/src/mocker/` (SURVEY.md §2.2 and §4): a
+vLLM-semantics engine — block-level prefix caching with LRU eviction,
+watermark admission, chunked prefill, simulated step timing — that emits
+*real* KV events and load metrics, so routing / frontend / disaggregation /
+planner tests run with zero accelerator time.  The CI workhorse.
+"""
+
+from dynamo_tpu.llm.mocker.engine import MockEngine, MockEngineArgs
+
+__all__ = ["MockEngine", "MockEngineArgs"]

@@ -1,0 +1,2 @@
+"""Wire protocols: OpenAI-compatible API types, SSE codec, internal request
+forms (reference `lib/llm/src/protocols/` — SURVEY.md §2.2)."""

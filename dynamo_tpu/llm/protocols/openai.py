@@ -1,0 +1,215 @@
+"""OpenAI-compatible request/response types + SSE codec.
+
+Covers the surface the reference serves (`lib/llm/src/http/service/
+openai.rs` routes: /v1/chat/completions, /v1/completions, /v1/models) with
+pydantic models — validation at the HTTP boundary like the reference's
+`protocols/openai/validate.rs`.
+
+Streaming: `sse_encode` produces the `data: {json}\n\n` framing with the
+terminal `data: [DONE]` sentinel (reference `protocols/codec.rs`).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import BaseModel, Field, field_validator
+
+
+# ---------------------------------------------------------------------------
+# Shared
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ErrorDetail(BaseModel):
+    message: str
+    type: str = "invalid_request_error"
+    code: Optional[str] = None
+
+
+class ErrorResponse(BaseModel):
+    error: ErrorDetail
+
+
+def request_id(prefix: str = "cmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def now_ts() -> int:
+    return int(time.time())
+
+
+# ---------------------------------------------------------------------------
+# Chat completions
+
+
+class ChatMessage(BaseModel):
+    role: Literal["system", "user", "assistant", "tool"]
+    content: Optional[Union[str, List[Dict[str, Any]]]] = None
+    name: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+
+    def text(self) -> str:
+        if isinstance(self.content, str):
+            return self.content
+        if self.content is None:
+            return ""
+        # Multi-part content: concatenate text parts (image parts are the
+        # multimodal pipeline's job).
+        return "".join(
+            p.get("text", "") for p in self.content if p.get("type") == "text")
+
+
+class SamplingFields(BaseModel):
+    """Sampling knobs shared by chat + text completions."""
+
+    max_tokens: Optional[int] = Field(default=None, ge=1)
+    max_completion_tokens: Optional[int] = Field(default=None, ge=1)
+    temperature: Optional[float] = Field(default=None, ge=0.0, le=2.0)
+    top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
+    top_k: Optional[int] = Field(default=None, ge=0)
+    stop: Optional[Union[str, List[str]]] = None
+    seed: Optional[int] = None
+    frequency_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
+    presence_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
+    n: int = 1
+    logprobs: Optional[Union[bool, int]] = None
+    stream: bool = False
+    stream_options: Optional[Dict[str, Any]] = None
+    user: Optional[str] = None
+    # Reference NVext extension escape hatch (protocols/openai NVext).
+    nvext: Optional[Dict[str, Any]] = None
+
+    @field_validator("n")
+    @classmethod
+    def _n_is_one(cls, v):
+        if v != 1:
+            raise ValueError("n > 1 is not supported")
+        return v
+
+    def stop_list(self) -> List[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+    def effective_max_tokens(self, default: int = 512) -> int:
+        return self.max_completion_tokens or self.max_tokens or default
+
+
+class ChatCompletionRequest(SamplingFields):
+    model: str
+    messages: List[ChatMessage]
+
+    @field_validator("messages")
+    @classmethod
+    def _nonempty(cls, v):
+        if not v:
+            raise ValueError("messages must be non-empty")
+        return v
+
+
+class ChatChoiceDelta(BaseModel):
+    role: Optional[str] = None
+    content: Optional[str] = None
+
+
+class ChatStreamChoice(BaseModel):
+    index: int = 0
+    delta: ChatChoiceDelta
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionChunk(BaseModel):
+    id: str
+    object: Literal["chat.completion.chunk"] = "chat.completion.chunk"
+    created: int = Field(default_factory=now_ts)
+    model: str
+    choices: List[ChatStreamChoice]
+    usage: Optional[Usage] = None
+
+
+class ChatChoice(BaseModel):
+    index: int = 0
+    message: ChatMessage
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: Literal["chat.completion"] = "chat.completion"
+    created: int = Field(default_factory=now_ts)
+    model: str
+    choices: List[ChatChoice]
+    usage: Usage = Field(default_factory=Usage)
+
+
+# ---------------------------------------------------------------------------
+# Text completions
+
+
+class CompletionRequest(SamplingFields):
+    model: str
+    prompt: Union[str, List[str], List[int], List[List[int]]]
+    echo: bool = False
+
+
+class CompletionChoice(BaseModel):
+    index: int = 0
+    text: str
+    finish_reason: Optional[str] = None
+    logprobs: Optional[Dict[str, Any]] = None
+
+
+class CompletionResponse(BaseModel):
+    id: str
+    object: Literal["text_completion"] = "text_completion"
+    created: int = Field(default_factory=now_ts)
+    model: str
+    choices: List[CompletionChoice]
+    usage: Usage = Field(default_factory=Usage)
+
+
+# ---------------------------------------------------------------------------
+# Models listing
+
+
+class ModelInfo(BaseModel):
+    id: str
+    object: Literal["model"] = "model"
+    created: int = Field(default_factory=now_ts)
+    owned_by: str = "dynamo-tpu"
+
+
+class ModelList(BaseModel):
+    object: Literal["list"] = "list"
+    data: List[ModelInfo] = Field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# SSE codec
+
+
+SSE_DONE = "data: [DONE]\n\n"
+
+
+def sse_encode(payload: BaseModel) -> str:
+    return f"data: {payload.model_dump_json(exclude_none=True)}\n\n"
+
+
+def sse_decode_line(line: str) -> Optional[dict]:
+    """Parse one `data: ...` line; None for comments/blank/[DONE]."""
+    line = line.strip()
+    if not line.startswith("data:"):
+        return None
+    body = line[5:].strip()
+    if body == "[DONE]":
+        return None
+    return json.loads(body)

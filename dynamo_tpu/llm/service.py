@@ -1,0 +1,74 @@
+"""Model manager + the engine-client seam.
+
+`EngineClient` is the streaming contract everything composes through — the
+analog of the reference's `AsyncEngine` trait (`lib/runtime/src/engine.rs:
+207`: `generate(SingleIn<Req>) -> ManyOut<Resp>`).  A local engine, a
+KV-routed remote pool, and a mock engine all implement it, so the HTTP
+frontend doesn't know which it's talking to (reference EngineConfig
+{StaticFull, Dynamic} assembly, `entrypoint/input/common.rs:183`).
+
+`ModelManager` is the frontend's model registry (reference
+`discovery/model_manager.rs:33`): models appear/disappear at runtime as
+workers register/deregister.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import AsyncIterator, Dict, List, Optional, Protocol
+
+from dynamo_tpu.engine.engine import InferenceEngine, TokenDelta
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor, PreprocessedRequest
+from dynamo_tpu.llm.tokenizer import Tokenizer
+
+
+class EngineClient(Protocol):
+    """Streaming generate contract (AsyncEngine analog)."""
+
+    def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[TokenDelta]: ...
+
+
+class LocalEngineClient:
+    """EngineClient over an in-process InferenceEngine."""
+
+    def __init__(self, engine: InferenceEngine) -> None:
+        self._engine = engine
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[TokenDelta]:
+        async for delta in self._engine.generate(
+                request.request_id, request.token_ids, request.sampling):
+            yield delta
+
+
+@dataclass
+class ModelHandle:
+    """Everything the frontend needs to serve one model."""
+
+    name: str
+    tokenizer: Tokenizer
+    preprocessor: OpenAIPreprocessor
+    client: EngineClient
+
+
+class ModelManager:
+    def __init__(self) -> None:
+        self._models: Dict[str, ModelHandle] = {}
+
+    def register(self, handle: ModelHandle) -> None:
+        self._models[handle.name] = handle
+
+    def remove(self, name: str) -> Optional[ModelHandle]:
+        return self._models.pop(name, None)
+
+    def get(self, name: str) -> Optional[ModelHandle]:
+        return self._models.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._models)
+
+    def __len__(self) -> int:
+        return len(self._models)

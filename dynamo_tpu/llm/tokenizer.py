@@ -1,0 +1,143 @@
+"""Tokenizer abstraction + incremental detokenization.
+
+Role of the reference's `lib/llm/src/tokenizers.rs` (Encoding, DecodeStream):
+a thin trait over concrete tokenizers plus the *incremental* decode stream
+the per-token hot loop needs — UTF-8 multi-byte sequences and BPE merge
+boundaries mean you cannot just decode tokens one at a time and concatenate.
+
+Backends:
+- `HFTokenizer` — HuggingFace `tokenizers` (same Rust core the reference
+  binds) loaded from a local `tokenizer.json`; no hub download here (the
+  hub fetch lives in model_card/local_model resolution).
+- `ByteTokenizer` — 1 byte = 1 token (+ specials), dependency-free; the
+  test-fixture tokenizer (reference uses checked-in fixture models,
+  `lib/llm/tests/data/sample-models/`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    """What the preprocessor/backend need from any tokenizer."""
+
+    def encode(self, text: str) -> List[int]: ...
+    def decode(self, token_ids: Sequence[int]) -> str: ...
+    @property
+    def eos_token_ids(self) -> tuple: ...
+    @property
+    def vocab_size(self) -> int: ...
+
+
+class DecodeStream:
+    """Incremental detokenizer (reference `tokenizers.rs` DecodeStream).
+
+    Holds back output while the byte sequence at the tail is an incomplete
+    UTF-8 character or the tokenizer would merge differently: we decode the
+    window of all unflushed tokens and emit only the stable prefix (text
+    whose bytes can no longer change when more tokens arrive).
+    """
+
+    REPLACEMENT = "�"
+
+    def __init__(self, tokenizer: "Tokenizer") -> None:
+        self._tok = tokenizer
+        self._pending: List[int] = []
+        self._emitted = ""  # text already flushed for the pending window
+
+    def push(self, token_id: int) -> str:
+        """Feed one token; returns newly-stable text (possibly "")."""
+        self._pending.append(token_id)
+        text = self._tok.decode(self._pending)
+        if text.endswith(self.REPLACEMENT):
+            # Tail is an incomplete multi-byte sequence — hold everything
+            # after the already-emitted prefix.
+            return ""
+        if not text.startswith(self._emitted):
+            # Tokenizer re-merged the window so the already-flushed prefix
+            # changed.  We cannot retract flushed text; emit only the part
+            # past the longest common prefix (minimises duplication).
+            common = 0
+            for a, b in zip(self._emitted, text):
+                if a != b:
+                    break
+                common += 1
+            out = text[common:]
+            self._pending = []
+            self._emitted = ""
+            return out
+        out = text[len(self._emitted):]
+        # Window can be reset at a clean boundary to bound decode cost.
+        if len(self._pending) >= 16:
+            self._pending = []
+            self._emitted = ""
+        else:
+            self._emitted = text
+        return out
+
+    def flush(self) -> str:
+        """Emit whatever is still held back (end of stream)."""
+        text = self._tok.decode(self._pending)
+        out = text[len(self._emitted):] if text.startswith(self._emitted) else text
+        self._pending = []
+        self._emitted = ""
+        return out.replace(self.REPLACEMENT, "")
+
+
+@dataclass
+class ByteTokenizer:
+    """Byte-level tokenizer: token = byte value; specials above 255.
+
+    Deterministic, zero-dependency, exercises real UTF-8 boundary handling
+    in DecodeStream (multi-byte chars span multiple tokens).
+    """
+
+    bos_id: int = 256
+    eos_id: int = 257
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, token_ids: Sequence[int]) -> str:
+        data = bytes(t for t in token_ids if 0 <= t <= 255)
+        return data.decode("utf-8", errors="replace")
+
+    @property
+    def eos_token_ids(self) -> tuple:
+        return (self.eos_id,)
+
+    @property
+    def vocab_size(self) -> int:
+        return 258
+
+
+class HFTokenizer:
+    """HuggingFace `tokenizers` wrapper loaded from a local tokenizer.json."""
+
+    def __init__(self, path: str, eos_token_ids: Optional[Sequence[int]] = None):
+        from tokenizers import Tokenizer as _HFTok
+
+        self._tok = _HFTok.from_file(path)
+        self._eos = tuple(eos_token_ids or ())
+        if not self._eos:
+            # Common convention: try the standard special tokens.
+            for name in ("</s>", "<|endoftext|>", "<|eot_id|>", "<|end_of_text|>"):
+                tid = self._tok.token_to_id(name)
+                if tid is not None:
+                    self._eos += (tid,)
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False).ids
+
+    def decode(self, token_ids: Sequence[int]) -> str:
+        return self._tok.decode(list(token_ids), skip_special_tokens=True)
+
+    @property
+    def eos_token_ids(self) -> tuple:
+        return self._eos
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()

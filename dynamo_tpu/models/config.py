@@ -98,6 +98,19 @@ TINY = ModelConfig(
 
 TINY_MOE = TINY.replace(name="tiny-moe", num_experts=8, num_experts_per_token=2)
 
+LLAMA3_1B = ModelConfig(
+    name="llama-3-1b",
+    vocab_size=128_256,
+    hidden_size=2048,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=64,
+    intermediate_size=8192,
+    max_context=8192,
+    tie_embeddings=True,
+)
+
 LLAMA3_8B = ModelConfig(
     name="llama-3-8b",
     vocab_size=128_256,
@@ -139,7 +152,7 @@ MIXTRAL_8X7B = ModelConfig(
 
 PRESETS = {
     c.name: c
-    for c in (TINY, TINY_MOE, LLAMA3_8B, LLAMA3_70B, MIXTRAL_8X7B)
+    for c in (TINY, TINY_MOE, LLAMA3_1B, LLAMA3_8B, LLAMA3_70B, MIXTRAL_8X7B)
 }
 
 

@@ -1,0 +1,34 @@
+"""Parallelism over TPU device meshes.
+
+The reference passes parallelism flags through to its engines (SURVEY.md
+§2.5 — TP/PP/EP are vLLM's problem); here they are first-class: a named
+`jax.sharding.Mesh` with axes
+
+    dp — data (replica) parallel: batch dimension
+    sp — sequence/context parallel: ring attention over long prompts
+    ep — expert parallel: MoE expert dimension
+    tp — tensor parallel: heads / hidden features, over ICI
+
+and GSPMD sharding rules (PartitionSpecs per parameter/cache/activation)
+that let XLA insert the collectives (psum over ICI for row-parallel
+matmuls, all-to-all for experts, ppermute rings for sequence shards).
+"""
+
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.parallel.sharding import (
+    cache_pspecs,
+    data_pspecs,
+    make_sharded_step,
+    param_pspecs,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "param_pspecs",
+    "cache_pspecs",
+    "data_pspecs",
+    "shard_pytree",
+    "make_sharded_step",
+]

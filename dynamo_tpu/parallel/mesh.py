@@ -1,0 +1,65 @@
+"""Device mesh construction.
+
+Axis order is (dp, sp, ep, tp) with tp innermost: on real slices JAX device
+order makes the innermost axis span physically-adjacent chips, so the
+highest-traffic collectives (tensor-parallel psum every layer) ride the
+shortest ICI hops, while dp (lowest traffic) spans the slice/DCN dimension.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "sp", "ep", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Degrees per axis; product must equal the device count in use."""
+
+    dp: int = 1
+    sp: int = 1
+    ep: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> tuple:
+        return (self.dp, self.sp, self.ep, self.tp)
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.sp * self.ep * self.tp
+
+    def describe(self) -> str:
+        return "x".join(f"{a}{n}" for a, n in zip(AXES, self.shape) if n > 1) or "single"
+
+
+def make_mesh(
+    config: MeshConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the named mesh over `devices` (default: all local devices).
+
+    Raises if the axis product doesn't match the device count — a silent
+    partial mesh would leave chips idle, which on TPU is a provisioning
+    bug, not a fallback.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if config.size != len(devices):
+        raise ValueError(
+            f"mesh {config.describe()} needs {config.size} devices, "
+            f"have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(config.shape)
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    """1×1×1×1 mesh — lets the same sharded step run on one chip."""
+    device = device or jax.devices()[0]
+    return make_mesh(MeshConfig(), [device])

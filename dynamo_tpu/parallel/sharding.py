@@ -1,0 +1,144 @@
+"""GSPMD sharding rules for the Llama-family engine.
+
+Megatron-style tensor parallelism expressed as PartitionSpecs; XLA inserts
+the collectives (reference counterpart: NCCL inside vLLM — SURVEY.md §2.6
+"Collectives (in-engine)"):
+
+- attention: wq/wk/wv column-parallel (heads over tp), wo row-parallel
+  (psum on exit); the KV cache shards its head axis over tp so cache
+  reads/writes stay device-local.
+- MLP: w_gate/w_up column-parallel, w_down row-parallel.
+- MoE: expert dimension over ep, each expert's MLP additionally tp-sharded.
+- embedding / lm_head: vocab-sharded over tp (logit psum/all-gather at the
+  end of the step).
+- activations/batch: sharded over dp.
+
+GQA note: `num_kv_heads` (8 for Llama-3) bounds head-sharded tp for the
+cache; tp degrees beyond that would need head replication — rejected here
+rather than silently replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.config import ModelConfig
+
+Params = Dict
+
+
+def param_pspecs(cfg: ModelConfig) -> Params:
+    """PartitionSpec pytree matching `llama.init_params` structure."""
+    attn = {
+        "wq": P(None, "tp"),
+        "wk": P(None, "tp"),
+        "wv": P(None, "tp"),
+        "wo": P("tp", None),
+    }
+    layer = {
+        "attn": attn,
+        "attn_norm": P(None),
+        "mlp_norm": P(None),
+    }
+    if cfg.is_moe:
+        layer["moe"] = {
+            "router": P(None, "ep"),
+            "w_gate": P("ep", None, "tp"),
+            "w_up": P("ep", None, "tp"),
+            "w_down": P("ep", "tp", None),
+        }
+    else:
+        layer["mlp"] = {
+            "w_gate": P(None, "tp"),
+            "w_up": P(None, "tp"),
+            "w_down": P("tp", None),
+        }
+    specs: Params = {
+        "embed": P("tp", None),
+        "final_norm": P(None),
+        "layers": [layer] * cfg.num_layers,
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def cache_pspecs() -> Dict:
+    """KV cache [L, slots, kv_heads, head_dim]: heads over tp.
+
+    The slot axis is deliberately *not* dp-sharded: each dp replica runs its
+    own engine process with its own cache (serving-style DP, reference
+    PushRouter replicas), so within one process the cache only shards over
+    tp."""
+    spec = P(None, None, "tp", None)
+    return {"k": spec, "v": spec}
+
+
+def data_pspecs() -> Dict:
+    """Per-step input batch: batch dim over dp."""
+    return {
+        "tokens": P("dp", None),
+        "positions": P("dp", None),
+        "seq_lens": P("dp"),
+        "block_tables": P("dp", None),
+    }
+
+
+def validate(cfg: ModelConfig, mesh: Mesh) -> None:
+    tp = mesh.shape["tp"]
+    ep = mesh.shape["ep"]
+    if cfg.num_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
+            "(head-sharded KV cache; replication not supported)"
+        )
+    if cfg.intermediate_size % tp:
+        raise ValueError(f"tp={tp} must divide intermediate={cfg.intermediate_size}")
+    if cfg.vocab_size % tp:
+        raise ValueError(f"tp={tp} must divide vocab={cfg.vocab_size}")
+    if cfg.is_moe and cfg.num_experts % ep:
+        raise ValueError(f"ep={ep} must divide num_experts={cfg.num_experts}")
+    if not cfg.is_moe and ep > 1:
+        raise ValueError("ep > 1 on a dense model wastes chips; use tp/dp")
+
+
+def shard_pytree(tree, pspecs, mesh: Mesh):
+    """Place a pytree on the mesh according to a matching pspec pytree."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, pspecs
+    )
+
+
+def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh):
+    """Jit the unified engine step with explicit in/out shardings.
+
+    Returns `step(params, cache, tokens, positions, seq_lens, block_tables)`
+    → (logits, cache).  Cache is donated (in-place paged-cache update);
+    logits come back replicated so the sampler/host sees full vocab.
+    """
+    from dynamo_tpu.models.llama import make_forward_step
+
+    validate(cfg, mesh)
+    step = make_forward_step(cfg, block_size)
+    d = data_pspecs()
+    in_shardings = (
+        jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg)),
+        jax.tree.map(lambda s: NamedSharding(mesh, s), cache_pspecs()),
+        NamedSharding(mesh, d["tokens"]),
+        NamedSharding(mesh, d["positions"]),
+        NamedSharding(mesh, d["seq_lens"]),
+        NamedSharding(mesh, d["block_tables"]),
+    )
+    out_shardings = (
+        NamedSharding(mesh, P("dp", None, None)),  # logits [B, T, V]
+        jax.tree.map(lambda s: NamedSharding(mesh, s), cache_pspecs()),
+    )
+    return jax.jit(
+        step,
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+        donate_argnums=(1,),
+    )

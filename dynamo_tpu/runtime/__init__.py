@@ -1,0 +1,10 @@
+"""Core distributed runtime.
+
+Role of the reference's Rust `dynamo-runtime` crate (SURVEY.md §2.1):
+component/endpoint model with lease-based discovery, transports, the
+AsyncEngine streaming contract, cancellation, config, logging, metrics and
+the system-status server.  The reference rides etcd + NATS; this runtime
+ships its own control plane (in-process broker for single-process, TCP
+control-plane server for multi-process) since the capability — discovery,
+liveness, pub/sub, work queues — is what matters, not the binaries.
+"""

@@ -1,0 +1,209 @@
+"""Prometheus-format metrics registry.
+
+Role of the reference's `lib/runtime/src/metrics.rs` (hierarchical names
+drt→namespace→component→endpoint) and `lib/llm/src/http/service/metrics.rs`
+(the TTFT/ITL histograms the SLA planner scrapes —
+`*_time_to_first_token_seconds`, `*_inter_token_latency_seconds`).  Those
+exact series names are load-bearing: the planner's Prometheus queries key
+on them (reference `planner/utils/prometheus.py`), so our planner does too.
+
+Self-contained text-format exposition (no prometheus_client dependency);
+thread-safe; histograms use fixed buckets chosen for LLM latencies.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Buckets tuned for token-level latencies (seconds).
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> LabelKey:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(key: LabelKey, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    def __init__(self, name: str, help_: str) -> None:
+        self.name, self.help = name, help_
+        self._values: Dict[LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, labels: Optional[Dict[str, str]] = None):
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str) -> None:
+        self.name, self.help = name, help_
+        self._values: Dict[LabelKey, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def add(self, amount: float, labels: Optional[Dict[str, str]] = None):
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for k, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = LATENCY_BUCKETS) -> None:
+        self.name, self.help = name, help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[LabelKey, List[int]] = {}
+        self._sum: Dict[LabelKey, float] = {}
+        self._total: Dict[LabelKey, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None):
+        k = _label_key(labels)
+        idx = bisect_right(self.buckets, value)
+        with self._lock:
+            if k not in self._counts:
+                self._counts[k] = [0] * (len(self.buckets) + 1)
+                self._sum[k] = 0.0
+                self._total[k] = 0
+            self._counts[k][idx] += 1
+            self._sum[k] += value
+            self._total[k] += 1
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        return self._total.get(_label_key(labels), 0)
+
+    def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._sum.get(_label_key(labels), 0.0)
+
+    def mean(self, labels: Optional[Dict[str, str]] = None) -> float:
+        n = self.count(labels)
+        return self.sum(labels) / n if n else 0.0
+
+    def quantile(self, q: float, labels: Optional[Dict[str, str]] = None) -> float:
+        """Approximate quantile from bucket counts (upper bound of the
+        bucket containing the q-th observation)."""
+        k = _label_key(labels)
+        counts = self._counts.get(k)
+        if not counts:
+            return 0.0
+        target = q * self._total[k]
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= target:
+                return self.buckets[i] if i < len(self.buckets) else float("inf")
+        return float("inf")
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for k in sorted(self._counts):
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[k][i]
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(k, f'le=\"{b}\"')} {cum}")
+            cum += self._counts[k][-1]
+            out.append(f"{self.name}_bucket{_fmt_labels(k, 'le=\"+Inf\"')} {cum}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]}")
+            out.append(f"{self.name}_count{_fmt_labels(k)} {cum}")
+        return out
+
+
+class MetricsRegistry:
+    """Named registry with hierarchical prefixes (reference
+    `MetricsRegistry`, `lib/runtime/src/metrics.rs`)."""
+
+    def __init__(self, prefix: str = "dynamo") -> None:
+        self.prefix = prefix
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name: str, help_: str, **kw):
+        full = f"{self.prefix}_{name}" if self.prefix else name
+        with self._lock:
+            m = self._metrics.get(full)
+            if m is None:
+                m = cls(full, help_, **kw)
+                self._metrics[full] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {full} already registered as {type(m)}")
+            return m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(Counter, name, help_)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(Gauge, name, help_)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help_, buckets=buckets)
+
+    def expose(self) -> str:
+        lines: List[str] = []
+        with self._lock:
+            for m in self._metrics.values():
+                lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+class FrontendMetrics:
+    """The HTTP-service metric family the SLA planner consumes (reference
+    `http/service/metrics.rs:61-65,139-142`)."""
+
+    def __init__(self, registry: MetricsRegistry) -> None:
+        self.requests_total = registry.counter(
+            "frontend_requests_total", "Requests received")
+        self.requests_in_flight = registry.gauge(
+            "frontend_inflight_requests", "Requests currently being served")
+        self.queued_requests = registry.gauge(
+            "frontend_queued_requests", "Requests queued before engine entry")
+        self.ttft = registry.histogram(
+            "frontend_time_to_first_token_seconds", "Time to first token")
+        self.itl = registry.histogram(
+            "frontend_inter_token_latency_seconds", "Inter-token latency")
+        self.request_duration = registry.histogram(
+            "frontend_request_duration_seconds", "Full request duration")
+        self.input_tokens = registry.histogram(
+            "frontend_input_sequence_tokens", "Prompt tokens per request",
+            buckets=(16, 64, 256, 1024, 4096, 16384, 65536))
+        self.output_tokens = registry.histogram(
+            "frontend_output_sequence_tokens", "Output tokens per request",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096))

@@ -1,0 +1,274 @@
+"""Engine-core and scheduler tests on the tiny model (CPU devices).
+
+The load-bearing property: batching must be semantically invisible —
+greedy outputs of concurrent requests equal those of the same requests run
+alone (padding discipline, slot isolation, chunked prefill).  This is the
+engine-level analog of the reference's mocker-based routing tests
+(SURVEY.md §4).
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import EngineConfig, EngineCore, InferenceEngine
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import (
+    BlockAllocator,
+    FinishReason,
+    Request,
+    RequestState,
+    Scheduler,
+    SchedulerConfig,
+)
+from dynamo_tpu.models import config as mcfg
+
+TINY = mcfg.get_config("tiny-test")
+
+
+def small_engine(**kw) -> EngineCore:
+    defaults = dict(
+        model=TINY,
+        num_blocks=64,
+        scheduler=SchedulerConfig(
+            max_seqs=8, block_size=8, max_pages_per_seq=8,
+            max_prefill_chunk=16,
+            decode_buckets=(1, 2, 4, 8), prefill_buckets=(8, 16)),
+    )
+    defaults.update(kw)
+    return EngineCore(EngineConfig(**defaults))
+
+
+def run_to_completion(core: EngineCore, max_steps=500):
+    outputs = {}
+    finished = {}
+    for _ in range(max_steps):
+        for d in core.step():
+            outputs.setdefault(d.request_id, []).extend(d.token_ids)
+            if d.finished:
+                finished[d.request_id] = d.finish_reason
+        if core.scheduler.num_active == 0 and not core._requests:
+            break
+    return outputs, finished
+
+
+# -- scheduler unit tests ----------------------------------------------------
+
+
+def _req(rid, prompt_len, max_tokens=4):
+    return Request(request_id=rid, prompt_tokens=list(range(1, prompt_len + 1)),
+                   sampling=SamplingParams(max_tokens=max_tokens))
+
+
+def test_admission_respects_watermark():
+    alloc = BlockAllocator(num_blocks=9)  # 8 usable
+    sched = Scheduler(SchedulerConfig(
+        max_seqs=4, block_size=8, max_pages_per_seq=4, watermark=0.3), alloc)
+    # Each prompt of 15 tokens (+1) needs 2 pages; watermark = 2.4 blocks.
+    for i in range(4):
+        sched.add_request(_req(f"r{i}", 15))
+    sched.plan()
+    # 8 usable: r0 (2), r1 (2) admitted → free 4; admitting r2 would leave
+    # 2 < 2.4 → blocked.
+    admitted = [r.request_id for r in sched.running]
+    assert admitted == ["r0", "r1"]
+    assert alloc.free_blocks == 4
+
+
+def test_chunked_prefill_budget():
+    alloc = BlockAllocator(num_blocks=64)
+    sched = Scheduler(SchedulerConfig(
+        max_seqs=4, block_size=8, max_pages_per_seq=8,
+        max_prefill_chunk=16, max_batched_tokens=24), alloc)
+    sched.add_request(_req("a", 40))
+    sched.add_request(_req("b", 40))
+    plan = sched.plan()
+    # Budget 24: a gets a 16-chunk, b gets the remaining 8.
+    assert [(w.request.request_id, w.length) for w in plan.prefills] == \
+        [("a", 16), ("b", 8)]
+    for w in plan.prefills:
+        sched.prefill_done(w)
+    assert sched.running[0].prefilled == 16
+
+
+def test_finish_releases_pages():
+    alloc = BlockAllocator(num_blocks=16)
+    sched = Scheduler(SchedulerConfig(
+        max_seqs=2, block_size=8, max_pages_per_seq=8), alloc)
+    sched.add_request(_req("a", 20))
+    sched.plan()
+    assert alloc.free_blocks < 15
+    sched.finish(sched.running[0], FinishReason.STOP)
+    assert alloc.free_blocks == 15
+
+
+def test_too_long_prompt_rejected():
+    alloc = BlockAllocator(num_blocks=16)
+    sched = Scheduler(SchedulerConfig(
+        max_seqs=2, block_size=8, max_pages_per_seq=2), alloc)
+    req = _req("a", 20)  # 20 + 4 > 16 max context
+    sched.add_request(req)
+    assert req.state is RequestState.FINISHED
+    assert req.finish_reason is FinishReason.LENGTH
+
+
+# -- engine end-to-end -------------------------------------------------------
+
+
+def test_single_request_generates():
+    core = small_engine()
+    core.add_request("r1", [5, 6, 7, 8], SamplingParams(max_tokens=6))
+    outputs, finished = run_to_completion(core)
+    assert len(outputs["r1"]) == 6
+    assert finished["r1"] is FinishReason.LENGTH
+    assert core.allocator.free_blocks == 63  # everything released
+
+
+def test_batching_invisible_to_greedy_outputs():
+    prompts = {
+        "a": [1, 2, 3],
+        "b": list(range(10, 31)),       # forces chunked prefill (21 > 16)
+        "c": [9, 8, 7, 6, 5],
+    }
+    solo = {}
+    for rid, p in prompts.items():
+        core = small_engine()
+        core.add_request(rid, p, SamplingParams(max_tokens=8))
+        out, _ = run_to_completion(core)
+        solo[rid] = out[rid]
+
+    core = small_engine()
+    for rid, p in prompts.items():
+        core.add_request(rid, p, SamplingParams(max_tokens=8))
+    batched, finished = run_to_completion(core)
+
+    assert batched == solo
+    assert all(r is FinishReason.LENGTH for r in finished.values())
+
+
+def test_stop_token_finishes_early():
+    core = small_engine()
+    core.add_request("r1", [5, 6, 7, 8], SamplingParams(max_tokens=32))
+    # Find what greedy emits first, then re-run with it as a stop token.
+    outputs, _ = run_to_completion(core)
+    first = outputs["r1"][0]
+
+    core2 = small_engine()
+    core2.add_request("r1", [5, 6, 7, 8],
+                      SamplingParams(max_tokens=32, stop_token_ids=(first,)))
+    outputs2, finished2 = run_to_completion(core2)
+    assert outputs2["r1"] == [first]
+    assert finished2["r1"] is FinishReason.STOP
+
+
+def test_kv_events_emitted_with_chained_hashes():
+    from dynamo_tpu.tokens import compute_block_hashes
+
+    events = []
+    core = EngineCore(
+        EngineConfig(
+            model=TINY, num_blocks=64,
+            scheduler=SchedulerConfig(
+                max_seqs=4, block_size=8, max_pages_per_seq=8,
+                max_prefill_chunk=16,
+                decode_buckets=(1, 2, 4), prefill_buckets=(8, 16)),
+        ),
+        kv_event_sink=events.append,
+    )
+    prompt = list(range(1, 20))  # 19 tokens → 2 complete blocks of 8
+    core.add_request("r1", prompt, SamplingParams(max_tokens=6))
+    run_to_completion(core)
+
+    stored = [e for e in events if e.data.store is not None]
+    removed = [e for e in events if e.data.remove is not None]
+    assert stored and removed
+    all_stored = [h for e in stored for h in e.data.store.block_hashes]
+    # 19 prompt + 6 output = 25 tokens → 3 sealed blocks of 8.
+    # Recompute expected hashes from the actual generated tokens:
+    core2 = small_engine()
+    core2.add_request("r1", prompt, SamplingParams(max_tokens=6))
+    out, _ = run_to_completion(core2)
+    expected = compute_block_hashes(prompt + out["r1"], block_size=8)[:3]
+    assert all_stored == list(expected)
+    # Removal covers exactly what was stored.
+    assert sorted(h for e in removed for h in e.data.remove.block_hashes) == \
+        sorted(all_stored)
+    # Event ids strictly increasing.
+    ids = [e.event_id for e in events]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+
+
+def test_cancel_mid_stream():
+    core = small_engine()
+    core.add_request("r1", [1, 2, 3], SamplingParams(max_tokens=32))
+    core.step()  # prefill + first token
+    core.cancel("r1")
+    deltas = core.step()
+    assert any(d.finished and d.finish_reason is FinishReason.CANCELLED
+               for d in deltas)
+    assert core.allocator.free_blocks == 63
+
+
+def test_async_engine_streams():
+    async def main():
+        core = small_engine()
+        eng = InferenceEngine(core)
+        await eng.start()
+        try:
+            got = []
+            async for delta in eng.generate(
+                    "r1", [5, 6, 7], SamplingParams(max_tokens=5)):
+                got.extend(delta.token_ids)
+                if delta.finished:
+                    break
+            return got
+        finally:
+            await eng.stop()
+
+    got = asyncio.run(main())
+    assert len(got) == 5
+
+
+def test_async_engine_concurrent_requests():
+    async def main():
+        core = small_engine()
+        eng = InferenceEngine(core)
+        await eng.start()
+
+        async def one(rid, prompt):
+            toks = []
+            async for d in eng.generate(rid, prompt,
+                                        SamplingParams(max_tokens=4)):
+                toks.extend(d.token_ids)
+            return toks
+
+        try:
+            return await asyncio.gather(
+                one("a", [1, 2, 3]), one("b", [4, 5, 6]), one("c", [7, 8]))
+        finally:
+            await eng.stop()
+
+    a, b, c = asyncio.run(main())
+    assert len(a) == len(b) == len(c) == 4
+
+
+def test_seeded_sampling_reproducible_across_batch_mix():
+    """A seeded stochastic request must not depend on batch-mates."""
+    seeded = dict(prompt=[3, 1, 4, 1, 5],
+                  sampling=SamplingParams(temperature=0.9, seed=1234,
+                                          max_tokens=6))
+
+    core = small_engine()
+    core.add_request("s", seeded["prompt"], seeded["sampling"])
+    solo, _ = run_to_completion(core)
+
+    core2 = small_engine()
+    core2.add_request("other1", [9, 9, 9], SamplingParams(max_tokens=6))
+    core2.add_request("s", seeded["prompt"], seeded["sampling"])
+    core2.add_request("other2", [7, 7], SamplingParams(temperature=1.5,
+                                                       max_tokens=6))
+    mixed, _ = run_to_completion(core2)
+
+    assert mixed["s"] == solo["s"]

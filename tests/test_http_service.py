@@ -1,0 +1,194 @@
+"""HTTP frontend e2e over the real tiny engine (reference analog:
+`lib/llm/tests/http-service.rs` + `http_metrics.rs`)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.engine.engine import EngineConfig, EngineCore, InferenceEngine
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.llm.http_service import HttpService
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.service import LocalEngineClient, ModelHandle, ModelManager
+from dynamo_tpu.llm.tokenizer import ByteTokenizer
+from dynamo_tpu.models import config as mcfg
+
+
+async def _serve_tiny():
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=128,
+        scheduler=SchedulerConfig(
+            max_seqs=8, block_size=8, max_pages_per_seq=64,
+            max_prefill_chunk=128,
+            decode_buckets=(1, 2, 4, 8),
+            prefill_buckets=(32, 64, 128))))
+    engine = InferenceEngine(core)
+    await engine.start()
+    tok = ByteTokenizer()
+    models = ModelManager()
+    models.register(ModelHandle(
+        name="tiny", tokenizer=tok,
+        preprocessor=OpenAIPreprocessor(tok, default_max_tokens=8),
+        client=LocalEngineClient(engine)))
+    svc = HttpService(models)
+    port = await svc.start()
+    return svc, engine, port
+
+
+@pytest.fixture
+def server(event_loop=None):
+    # One server per test; aiohttp needs a running loop, so wrap fully.
+    holder = {}
+
+    async def setup():
+        holder["svc"], holder["engine"], holder["port"] = await _serve_tiny()
+
+    async def teardown():
+        await holder["svc"].stop()
+        await holder["engine"].stop()
+
+    return holder, setup, teardown
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def test_models_health_metrics_routes():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"{base}/v1/models") as r:
+                    assert r.status == 200
+                    data = await r.json()
+                    assert [m["id"] for m in data["data"]] == ["tiny"]
+                async with s.get(f"{base}/health") as r:
+                    assert r.status == 200
+                async with s.get(f"{base}/live") as r:
+                    assert r.status == 200
+                async with s.get(f"{base}/metrics") as r:
+                    assert r.status == 200
+                    text = await r.text()
+                    assert "dynamo_frontend_requests_total" in text or text
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_chat_completion_unary():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                payload = {
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hello"}],
+                    "max_tokens": 5,
+                    "temperature": 0.0,
+                }
+                async with s.post(f"{base}/v1/chat/completions",
+                                  json=payload) as r:
+                    assert r.status == 200, await r.text()
+                    data = await r.json()
+                assert data["object"] == "chat.completion"
+                assert data["usage"]["completion_tokens"] == 5
+                assert data["choices"][0]["finish_reason"] == "length"
+                assert data["choices"][0]["message"]["role"] == "assistant"
+
+                # Unknown model → 404 with OpenAI error shape.
+                payload["model"] = "nope"
+                async with s.post(f"{base}/v1/chat/completions",
+                                  json=payload) as r:
+                    assert r.status == 404
+                    err = await r.json()
+                    assert err["error"]["type"] == "model_not_found"
+
+                # Malformed body → 400.
+                async with s.post(f"{base}/v1/chat/completions",
+                                  json={"model": "tiny", "messages": []}) as r:
+                    assert r.status == 400
+
+                # Metrics recorded TTFT.
+                async with s.get(f"{base}/metrics") as r:
+                    text = await r.text()
+                assert "dynamo_frontend_time_to_first_token_seconds_count" in text
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_chat_completion_streaming():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                payload = {
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4,
+                    "temperature": 0.0,
+                    "stream": True,
+                }
+                chunks = []
+                done_seen = False
+                async with s.post(f"{base}/v1/chat/completions",
+                                  json=payload) as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Type"].startswith("text/event-stream")
+                    async for raw in r.content:
+                        line = raw.decode().strip()
+                        if not line:
+                            continue
+                        if line == "data: [DONE]":
+                            done_seen = True
+                            break
+                        chunks.append(json.loads(line[5:]))
+                assert done_seen
+                assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+                finish = [c for c in chunks
+                          if c["choices"][0].get("finish_reason")]
+                assert finish and finish[-1]["choices"][0]["finish_reason"] == "length"
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_completions_route():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/completions", json={
+                        "model": "tiny", "prompt": "abc",
+                        "max_tokens": 3, "temperature": 0.0}) as r:
+                    assert r.status == 200, await r.text()
+                    data = await r.json()
+                assert data["object"] == "text_completion"
+                assert data["usage"] == {"prompt_tokens": 3,
+                                         "completion_tokens": 3,
+                                         "total_tokens": 6}
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())

@@ -1,0 +1,176 @@
+"""Preprocessor, detokenizer (stop jail, UTF-8), OpenAI protocol codec."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.scheduler import FinishReason
+from dynamo_tpu.llm.backend import StreamDetokenizer
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChatMessage,
+    ChatStreamChoice,
+    ChatChoiceDelta,
+    CompletionRequest,
+    sse_decode_line,
+    sse_encode,
+)
+from dynamo_tpu.llm.tokenizer import ByteTokenizer, DecodeStream
+
+
+TOK = ByteTokenizer()
+
+
+# -- tokenizer / decode stream ----------------------------------------------
+
+
+def test_byte_tokenizer_roundtrip():
+    s = "héllo ☕ wörld"
+    assert TOK.decode(TOK.encode(s)) == s
+
+
+def test_decode_stream_holds_partial_utf8():
+    stream = DecodeStream(TOK)
+    data = "é☕".encode("utf-8")  # 2 + 3 bytes
+    outs = [stream.push(b) for b in data]
+    # No replacement chars ever emitted; text arrives only at char ends.
+    assert "".join(outs) == "é☕"
+    assert all("�" not in o for o in outs)
+    assert outs[0] == ""   # first byte of é is incomplete
+
+
+def test_decode_stream_flush():
+    stream = DecodeStream(TOK)
+    out = stream.push("a".encode()[0])
+    assert out == "a"
+    # Feed first byte of a 2-byte char, then flush: incomplete tail dropped.
+    stream.push("é".encode()[0])
+    assert stream.flush() == ""
+
+
+# -- preprocessor ------------------------------------------------------------
+
+
+def test_preprocess_chat_renders_template_and_defaults():
+    pre = OpenAIPreprocessor(TOK, default_max_tokens=99)
+    req = ChatCompletionRequest(
+        model="m", messages=[ChatMessage(role="user", content="hi")])
+    p = pre.preprocess_chat(req, "r1")
+    assert "hi" in p.annotations["formatted_prompt"]
+    assert "assistant" in p.annotations["formatted_prompt"]
+    assert p.sampling.max_tokens == 99
+    assert p.sampling.temperature == 1.0  # OpenAI default is stochastic
+    assert p.sampling.stop_token_ids == (TOK.eos_id,)
+
+
+def test_preprocess_completion_tokens_passthrough():
+    pre = OpenAIPreprocessor(TOK)
+    req = CompletionRequest(model="m", prompt=[1, 2, 3], max_tokens=5)
+    p = pre.preprocess_completion(req, "r2")
+    assert p.token_ids == [1, 2, 3]
+    assert p.sampling.max_tokens == 5
+
+
+def test_preprocess_stop_strings():
+    pre = OpenAIPreprocessor(TOK)
+    req = ChatCompletionRequest(
+        model="m", messages=[ChatMessage(role="user", content="x")],
+        stop=["END", "\n\n"])
+    p = pre.preprocess_chat(req, "r3")
+    assert p.stop_sequences == ["END", "\n\n"]
+
+
+def test_request_validation():
+    with pytest.raises(Exception):
+        ChatCompletionRequest(model="m", messages=[])
+    with pytest.raises(Exception):
+        ChatCompletionRequest(
+            model="m", messages=[ChatMessage(role="user", content="x")],
+            temperature=5.0)
+
+
+# -- stop-sequence jail ------------------------------------------------------
+
+
+def _push_text(det, text):
+    return det.push_tokens(TOK.encode(text))
+
+
+def test_stop_jail_truncates_at_match():
+    det = StreamDetokenizer(TOK, ["END"])
+    d1 = _push_text(det, "hello ")
+    assert d1.text == "hello "
+    d2 = _push_text(det, "world EN")       # 'EN' could grow into 'END'
+    assert d2.text == "world "             # EN held in jail
+    d3 = _push_text(det, "D more")
+    assert d3.finished and d3.finish_reason == "stop"
+    assert d3.text == ""                   # END + trailing text swallowed
+
+
+def test_stop_jail_releases_false_prefix():
+    det = StreamDetokenizer(TOK, ["END"])
+    d1 = _push_text(det, "an E")
+    assert d1.text == "an "
+    d2 = _push_text(det, "Nd?")            # 'ENd?' diverges from 'END'
+    assert d2.text == "ENd?"
+    d3 = det.finish(FinishReason.LENGTH)
+    assert d3.finish_reason == "length"
+
+
+def test_finish_flushes_jail():
+    det = StreamDetokenizer(TOK, ["XYZ"])
+    _push_text(det, "abcX")
+    d = det.finish(FinishReason.STOP)
+    assert d.text == "X"                   # jailed prefix released at end
+    assert d.finish_reason == "stop"
+
+
+# -- SSE codec ---------------------------------------------------------------
+
+
+def test_sse_roundtrip():
+    chunk = ChatCompletionChunk(
+        id="c1", model="m",
+        choices=[ChatStreamChoice(delta=ChatChoiceDelta(content="hi"))])
+    wire = sse_encode(chunk)
+    assert wire.startswith("data: ") and wire.endswith("\n\n")
+    back = sse_decode_line(wire.strip())
+    assert back["choices"][0]["delta"]["content"] == "hi"
+    assert sse_decode_line("data: [DONE]") is None
+
+
+# -- engine integration: rejected request must not hang ----------------------
+
+
+def test_generate_rejected_request_terminates():
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore, InferenceEngine
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models import config as mcfg
+
+    async def main():
+        core = EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=64,
+            scheduler=SchedulerConfig(
+                max_seqs=4, block_size=8, max_pages_per_seq=4,
+                max_prefill_chunk=16,
+                decode_buckets=(1, 2, 4), prefill_buckets=(8, 16))))
+        eng = InferenceEngine(core)
+        await eng.start()
+        try:
+            # prompt+max_tokens > 32-token max context → admission reject.
+            deltas = []
+            async for d in eng.generate("r1", list(range(30)),
+                                        SamplingParams(max_tokens=30)):
+                deltas.append(d)
+            return deltas
+        finally:
+            await eng.stop()
+
+    deltas = asyncio.wait_for(main(), timeout=15)
+    deltas = asyncio.run(deltas)
+    assert deltas[-1].finished
+    assert deltas[-1].finish_reason == FinishReason.LENGTH
+    assert deltas[-1].token_ids == []

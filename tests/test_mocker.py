@@ -1,0 +1,131 @@
+"""Mock engine: prefix caching, eviction, events, streaming (reference
+analog: mocker tests + `tests/router/test_router_e2e_with_mockers.py`
+workload generation substrate)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.llm.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.llm.mocker.kv_manager import MockKvManager
+from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+from dynamo_tpu.tokens import compute_block_hashes
+
+
+def _req(rid, tokens, max_tokens=4):
+    return PreprocessedRequest(
+        request_id=rid, model="mock", token_ids=list(tokens),
+        sampling=SamplingParams(max_tokens=max_tokens))
+
+
+FAST = MockEngineArgs(num_blocks=64, block_size=8, speedup_ratio=100.0)
+
+
+async def _collect(engine, req):
+    toks = []
+    async for d in engine.generate(req):
+        toks.extend(d.token_ids)
+        if d.finished:
+            return toks, d.finish_reason
+
+
+# -- kv manager unit ---------------------------------------------------------
+
+
+def test_kv_manager_prefix_reuse_and_lru_eviction():
+    events = []
+    kv = MockKvManager(4, 8, event_sink=events.append)
+    h = [101, 102, 103]
+    parents = [None, 101, 102]
+    assert kv.acquire(h, parents) == 0            # all new
+    kv.release(h)                                  # → inactive, resident
+    assert kv.match_prefix(h) == 3
+    assert kv.acquire(h, parents) == 3             # full reuse
+    kv.release(h)
+
+    # Now force eviction: 4-capacity, 3 resident inactive, acquire 2 new.
+    assert kv.acquire([201, 202], [None, 201]) == 0
+    assert kv.evicted_blocks >= 1
+    removed = [e for e in events if e.data.remove is not None]
+    assert removed, "eviction must emit REMOVED events"
+    # Tail-first eviction: release() enqueues a sequence's blocks deepest-
+    # first, so the leaf (103) is evicted before its ancestors — a parent
+    # block is a useful cache prefix without its children, not vice versa.
+    assert list(removed[0].data.remove.block_hashes) == [103]
+
+
+def test_kv_manager_capacity_exhausted():
+    kv = MockKvManager(2, 8)
+    kv.acquire([1, 2], [None, 1])
+    with pytest.raises(RuntimeError, match="capacity"):
+        kv.acquire([3], [None])
+
+
+# -- engine ------------------------------------------------------------------
+
+
+def test_mock_engine_generates_deterministic_stream():
+    async def main():
+        eng = MockEngine(FAST)
+        try:
+            t1, r1 = await _collect(eng, _req("a", range(20), max_tokens=5))
+            t2, r2 = await _collect(eng, _req("a", range(20), max_tokens=5))
+            return t1, r1, t2
+        finally:
+            await eng.stop()
+
+    t1, r1, t2 = asyncio.run(main())
+    assert len(t1) == 5
+    assert t1 == t2                       # same request id → same stream
+    from dynamo_tpu.engine.scheduler import FinishReason
+    assert r1 is FinishReason.LENGTH
+
+
+def test_mock_engine_emits_chained_kv_events():
+    async def main():
+        events = []
+        eng = MockEngine(FAST, kv_event_sink=events.append)
+        try:
+            prompt = list(range(30))       # 3 full blocks of 8 + tail
+            await _collect(eng, _req("a", prompt, max_tokens=2))
+            return events, prompt
+        finally:
+            await eng.stop()
+
+    events, prompt = asyncio.run(main())
+    stored = [h for e in events if e.data.store
+              for h in e.data.store.block_hashes]
+    expected = compute_block_hashes(prompt, block_size=8)[:3]
+    assert stored[:3] == list(expected)
+
+
+def test_mock_engine_prefix_cache_hit_across_requests():
+    async def main():
+        eng = MockEngine(FAST)
+        try:
+            shared = list(range(24))       # 3 blocks
+            await _collect(eng, _req("a", shared + [100, 101], max_tokens=2))
+            await _collect(eng, _req("b", shared + [200, 201], max_tokens=2))
+            return eng.kv.hit_blocks, eng.kv.miss_blocks
+        finally:
+            await eng.stop()
+
+    hits, misses = asyncio.run(main())
+    assert hits >= 3                      # b reused a's 3 shared blocks
+
+
+def test_mock_engine_concurrent_load_and_metrics():
+    async def main():
+        eng = MockEngine(FAST)
+        try:
+            reqs = [_collect(eng, _req(f"r{i}", range(i, i + 40), max_tokens=8))
+                    for i in range(16)]
+            outs = await asyncio.gather(*reqs)
+            return outs, eng.metrics
+        finally:
+            await eng.stop()
+
+    outs, metrics = asyncio.run(main())
+    assert all(len(t) == 8 for t, _ in outs)
+    assert metrics.kv_stats.kv_total_blocks == FAST.num_blocks
