@@ -31,6 +31,15 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser("dynamo_tpu.frontend")
     p.add_argument("--http-host", default="127.0.0.1")
     p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--control-plane", default=None,
+                   help="HOST:PORT of the control plane → distributed mode "
+                        "(discover models from registered workers)")
+    p.add_argument("--serve-control-plane", action="store_true",
+                   help="also host the control-plane server in this process")
+    p.add_argument("--control-plane-port", type=int, default=4222)
+    p.add_argument("--router-mode", default="round_robin",
+                   choices=["round_robin", "random", "kv"])
+    p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--model-name", default="dynamo-tpu")
     p.add_argument("--mocker", action="store_true",
                    help="serve the mock engine (no accelerator)")
@@ -82,12 +91,42 @@ async def build_model_handle(args) -> tuple:
 
 
 async def run(args) -> None:
-    handle, shutdown = await build_model_handle(args)
     models = ModelManager()
-    models.register(handle)
+    shutdowns = []
+
+    cp_server = None
+    if args.serve_control_plane:
+        from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneServer
+
+        cp_server = ControlPlaneServer()
+        port = await cp_server.start(port=args.control_plane_port)
+        args.control_plane = args.control_plane or f"127.0.0.1:{port}"
+        print(f"control plane on 127.0.0.1:{port}", flush=True)
+
+    if args.control_plane:
+        # Distributed mode: discover models from registered workers.
+        from dynamo_tpu.llm.discovery import ModelWatcher
+        from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneClient
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        host, _, port = args.control_plane.rpartition(":")
+        cp = ControlPlaneClient(host, int(port))
+        await cp.start()
+        runtime = DistributedRuntime(cp)
+        watcher = ModelWatcher(runtime, models, router_mode=args.router_mode,
+                               migration_limit=args.migration_limit)
+        await watcher.start()
+        shutdowns += [watcher.stop, runtime.shutdown, cp.close]
+        banner = f"discovering models via {args.control_plane}"
+    else:
+        handle, shutdown = await build_model_handle(args)
+        models.register(handle)
+        shutdowns.append(shutdown)
+        banner = f"serving {handle.name!r}"
+
     svc = HttpService(models)
     port = await svc.start(args.http_host, args.http_port)
-    print(f"dynamo_tpu frontend serving {handle.name!r} "
+    print(f"dynamo_tpu frontend {banner} "
           f"on http://{args.http_host}:{port}", flush=True)
 
     stop_ev = asyncio.Event()
@@ -96,7 +135,10 @@ async def run(args) -> None:
         loop.add_signal_handler(sig, stop_ev.set)
     await stop_ev.wait()
     await svc.stop()
-    await shutdown()
+    for fn in shutdowns:
+        await fn()
+    if cp_server:
+        await cp_server.stop()
 
 
 def main(argv=None) -> None:

@@ -1,0 +1,17 @@
+"""KVBM — the multi-tier KV block manager, re-imagined for TPU.
+
+Role of the reference's `lib/llm/src/block_manager/` (13.5k LoC, SURVEY.md
+§2.2): tiered block pools (G1 device HBM / G2 host DRAM / G3 local disk),
+sequence-hash-keyed reuse with LRU eviction, and an offload manager moving
+cold blocks down-tier and promoting matched blocks back up.
+
+TPU twist: G1 blocks are *slots in one preallocated sharded jax array*
+(the engine's paged cache), not individually-addressable buffers — so
+tier transfers are slot-indexed gathers/scatters executed by donated jit
+functions (in-place on HBM), and the pool tracks slot ids, not pointers.
+"""
+
+from dynamo_tpu.llm.block_manager.pool import BlockPool, BlockRegistry
+from dynamo_tpu.llm.block_manager.manager import KvBlockManager, TieredConfig
+
+__all__ = ["BlockPool", "BlockRegistry", "KvBlockManager", "TieredConfig"]

@@ -1,0 +1,191 @@
+"""Tiered KV block manager: G1 device / G2 host / G3 disk + offload.
+
+Role of the reference's `KvBlockManager` (`block_manager.rs:90`) +
+`offload.rs` OffloadManager: cache levels G1 (device HBM — slots in the
+engine's paged jax array), G2 (pinned host DRAM — one numpy array), G3
+(local disk — numpy memmap), with
+
+- automatic *offload* on G1 eviction: the evicted block's KV rides down to
+  G2 (and G3 when G2 evicts) so the prefix stays warm;
+- *onboard* on match: a prompt prefix found in G2/G3 is copied into fresh
+  G1 slots before prefill, converting disk/DRAM residency into skipped
+  prefill FLOPs.
+
+Device↔host copies are slot-indexed gathers/scatters through donated jit
+functions (in-place HBM updates, no cache reallocation); host↔disk are
+numpy slice copies.  All transfers are synchronous-per-engine-step in this
+round (the async double-buffered offload queue is a planned refinement).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dynamo_tpu.llm.block_manager.pool import BlockPool
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class TieredConfig:
+    """Capacities per tier, in blocks (reference `block_manager/config.rs`)."""
+
+    device_blocks: int           # G1, includes the reserved null block 0
+    host_blocks: int = 0         # G2; 0 disables the tier
+    disk_blocks: int = 0         # G3; 0 disables
+    block_size: int = 64
+    disk_path: Optional[str] = None   # default: temp file
+
+
+class KvBlockManager:
+    """Owns the three pools + the transfer plumbing.
+
+    The device tier's actual KV bytes live in the engine's cache pytree;
+    the engine hands us `extract_fn(slot) -> np.ndarray` and
+    `inject_fn(slot, data)` at construction so the manager stays agnostic
+    of cache layout and sharding.
+    """
+
+    def __init__(
+        self,
+        config: TieredConfig,
+        block_nbytes: int = 0,
+        extract_fn=None,
+        inject_fn=None,
+    ) -> None:
+        self.config = config
+        self.extract_fn = extract_fn
+        self.inject_fn = inject_fn
+
+        self.device = BlockPool(config.device_blocks, name="G1-device",
+                                on_evict=self._on_device_evict,
+                                reserve_null=True)
+        self.host: Optional[BlockPool] = None
+        self.disk: Optional[BlockPool] = None
+        self._host_data: Optional[np.ndarray] = None
+        self._disk_data: Optional[np.ndarray] = None
+        self._block_shape: Optional[tuple] = None
+
+        if config.host_blocks:
+            self.host = BlockPool(config.host_blocks, name="G2-host",
+                                  on_evict=self._on_host_evict)
+        if config.disk_blocks:
+            self.disk = BlockPool(config.disk_blocks, name="G3-disk")
+        self.offloaded_blocks = 0
+        self.onboarded_blocks = 0
+
+    # -- lazy tier storage (shape known at first offload) ------------------
+
+    def _ensure_storage(self, sample: np.ndarray) -> None:
+        if self._block_shape is not None:
+            return
+        self._block_shape = sample.shape
+        if self.host is not None:
+            self._host_data = np.empty(
+                (self.config.host_blocks, *sample.shape), sample.dtype)
+        if self.disk is not None:
+            path = self.config.disk_path or os.path.join(
+                tempfile.gettempdir(), f"dynamo_tpu_kv_{os.getpid()}.bin")
+            self._disk_data = np.lib.format.open_memmap(
+                path, mode="w+", dtype=sample.dtype,
+                shape=(self.config.disk_blocks, *sample.shape))
+
+    # -- offload path (down-tier) ------------------------------------------
+
+    def _on_device_evict(self, block_hash: int, slot: int) -> None:
+        """G1 eviction → stash the block in G2 (if enabled)."""
+        if self.host is None or self.extract_fn is None:
+            return
+        if self.host.registry.lookup(block_hash) is not None:
+            return  # already resident down-tier
+        data = np.asarray(self.extract_fn(slot))
+        self._ensure_storage(data)
+        if not self.host.can_allocate(1):
+            return  # G2 fully pinned (shouldn't happen: G2 blocks unpin fast)
+        [hslot] = self.host.allocate(1)
+        self._host_data[hslot] = data
+        self.host.register(hslot, block_hash)
+        self.host.release([hslot])       # → inactive: resident, evictable
+        self.offloaded_blocks += 1
+
+    def _on_host_evict(self, block_hash: int, slot: int) -> None:
+        """G2 eviction → spill to G3 (if enabled)."""
+        if self.disk is None or self._host_data is None:
+            return
+        if self.disk.registry.lookup(block_hash) is not None:
+            return
+        if not self.disk.can_allocate(1):
+            return
+        [dslot] = self.disk.allocate(1)
+        self._disk_data[dslot] = self._host_data[slot]
+        self.disk.register(dslot, block_hash)
+        self.disk.release([dslot])
+        self.offloaded_blocks += 1
+
+    # -- onboard path (up-tier) --------------------------------------------
+
+    def match_and_onboard(self, hashes: Sequence[int]) -> Tuple[int, List[int]]:
+        """Find the longest prefix resident in ANY tier; promote down-tier
+        blocks into G1; pin and return (num_blocks, device_slot_ids).
+
+        The returned slots are pinned for the caller (release via
+        `release`)."""
+        # 1) direct G1 prefix
+        g1 = self.device.match_sequence_hashes(hashes)
+        ids = self.device.acquire_matched(g1)
+        n = len(ids)
+        # 2) extend from lower tiers
+        while n < len(hashes):
+            h = hashes[n]
+            src = None
+            if self.host and self.host.registry.lookup(h) is not None:
+                src = ("host", self.host.registry.lookup(h))
+            elif self.disk and self.disk.registry.lookup(h) is not None:
+                src = ("disk", self.disk.registry.lookup(h))
+            if src is None or self.inject_fn is None:
+                break
+            if not self.device.can_allocate(1):
+                break
+            tier, slot = src
+            data = (self._host_data[slot.index] if tier == "host"
+                    else np.array(self._disk_data[slot.index]))
+            [gslot] = self.device.allocate(1)
+            self.inject_fn(gslot, data)
+            self.device.register(gslot, h)
+            ids.append(gslot)
+            n += 1
+            self.onboarded_blocks += 1
+        return n, ids
+
+    # -- passthrough G1 ops ------------------------------------------------
+
+    def allocate(self, n: int) -> List[int]:
+        return self.device.allocate(n)
+
+    def register(self, slot: int, block_hash: int) -> bool:
+        return self.device.register(slot, block_hash)
+
+    def release(self, slots: Sequence[int]) -> None:
+        self.device.release(slots)
+
+    @property
+    def stats(self) -> Dict[str, float]:
+        s = {
+            "g1_active": self.device.active_slots,
+            "g1_free": self.device.free_slots,
+            "g1_hits": self.device.hits,
+            "g1_misses": self.device.misses,
+            "offloaded": self.offloaded_blocks,
+            "onboarded": self.onboarded_blocks,
+        }
+        if self.host:
+            s["g2_resident"] = len(self.host.registry.by_hash)
+        if self.disk:
+            s["g3_resident"] = len(self.disk.registry.by_hash)
+        return s

@@ -1,0 +1,184 @@
+"""Block pool: slot allocation + sequence-hash registry + LRU reuse.
+
+Role of the reference's `block_manager/pool.rs` (`BlockPool`:
+allocate_blocks / register_blocks / match_sequence_hashes) and
+`pool/inactive.rs` (sequence-hash-keyed LRU reuse pool).
+
+A pool owns `capacity` slots of one tier.  Slot states mirror the
+reference's block lifecycle (`block/state.rs` Reset→Partial→Complete→
+Registered):
+
+- free      — on the free list, contents meaningless
+- active    — pinned by ≥1 sequence (refcounted), maybe registered
+- inactive  — refcount 0 but REGISTERED under its hash: reusable as a
+              prefix-cache hit until evicted (LRU)
+
+Registration keys are chained block hashes (dynamo_tpu.tokens), so a hash
+match guarantees the whole token prefix matches.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Slot:
+    index: int
+    block_hash: Optional[int] = None
+    ref_count: int = 0
+
+
+class BlockRegistry:
+    """hash → slot mapping with active refcounts + inactive LRU."""
+
+    def __init__(self) -> None:
+        self.by_hash: Dict[int, Slot] = {}
+        self.inactive: "OrderedDict[int, Slot]" = OrderedDict()  # LRU order
+
+    def lookup(self, block_hash: int) -> Optional[Slot]:
+        return self.by_hash.get(block_hash)
+
+    def match_prefix(self, hashes: Sequence[int]) -> int:
+        n = 0
+        for h in hashes:
+            if h in self.by_hash:
+                n += 1
+            else:
+                break
+        return n
+
+
+class BlockPool:
+    """One tier's slots (reference BlockPool, `pool.rs:156`)."""
+
+    def __init__(self, capacity: int, name: str = "pool",
+                 on_evict: Optional[Callable[[int, int], None]] = None,
+                 reserve_null: bool = False) -> None:
+        """`on_evict(block_hash, slot)` fires when a registered block is
+        LRU-evicted to make room (the offload/KV-event hook).  With
+        `reserve_null`, slot 0 is never allocated (the engine's null
+        block)."""
+        self.name = name
+        self.capacity = capacity
+        start = 1 if reserve_null else 0
+        self._free: List[int] = list(range(capacity - 1, start - 1, -1))
+        self._slots: Dict[int, Slot] = {}
+        self.registry = BlockRegistry()
+        self.on_evict = on_evict
+        self.hits = 0
+        self.misses = 0
+
+    # -- views ------------------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def reusable_slots(self) -> int:
+        return len(self._free) + len(self.registry.inactive)
+
+    @property
+    def active_slots(self) -> int:
+        return len(self._slots) - len(self.registry.inactive)
+
+    @property
+    def usage(self) -> float:
+        return self.active_slots / max(1, self.capacity)
+
+    # -- matching ---------------------------------------------------------
+
+    def match_sequence_hashes(self, hashes: Sequence[int]) -> List[Slot]:
+        """Longest registered prefix; returned slots are NOT yet pinned
+        (call acquire_matched to pin)."""
+        out = []
+        for h in hashes:
+            slot = self.registry.lookup(h)
+            if slot is None:
+                break
+            out.append(slot)
+        return out
+
+    def acquire_matched(self, slots: Sequence[Slot]) -> List[int]:
+        """Pin matched slots (revives inactive ones); returns slot ids."""
+        ids = []
+        for slot in slots:
+            if slot.ref_count == 0:
+                self.registry.inactive.pop(slot.block_hash, None)
+            slot.ref_count += 1
+            ids.append(slot.index)
+            self.hits += 1
+        return ids
+
+    # -- allocation -------------------------------------------------------
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= self.reusable_slots
+
+    def allocate(self, n: int) -> List[int]:
+        """Take n fresh slots (evicting LRU inactive blocks as needed)."""
+        if not self.can_allocate(n):
+            raise RuntimeError(
+                f"{self.name}: out of blocks (want {n}, reusable "
+                f"{self.reusable_slots})")
+        out = []
+        for _ in range(n):
+            if not self._free:
+                self._evict_one()
+            idx = self._free.pop()
+            self._slots[idx] = Slot(index=idx, ref_count=1)
+            out.append(idx)
+            self.misses += 1
+        return out
+
+    def _evict_one(self) -> None:
+        h, slot = self.registry.inactive.popitem(last=False)  # LRU
+        del self.registry.by_hash[h]
+        del self._slots[slot.index]
+        self._free.append(slot.index)
+        if self.on_evict:
+            self.on_evict(h, slot.index)
+
+    # -- registration -----------------------------------------------------
+
+    def register(self, slot_index: int, block_hash: int) -> bool:
+        """Publish a completed block under its hash (Complete→Registered).
+
+        If the hash is already registered to another slot (two sequences
+        computed the same block concurrently), keeps the existing
+        registration and returns False — caller's slot simply stays
+        unregistered (duplicate storage until freed, like the reference's
+        duplicate-block handling)."""
+        if block_hash in self.registry.by_hash:
+            return False
+        slot = self._slots.get(slot_index)
+        if slot is None:
+            raise KeyError(f"{self.name}: slot {slot_index} not allocated")
+        slot.block_hash = block_hash
+        self.registry.by_hash[block_hash] = slot
+        return True
+
+    # -- release ----------------------------------------------------------
+
+    def release(self, slot_indices: Sequence[int]) -> None:
+        """Unpin; refcount-0 slots either go inactive (if registered — a
+        future prefix hit) or straight back to the free list."""
+        for idx in reversed(list(slot_indices)):
+            slot = self._slots.get(idx)
+            if slot is None:
+                continue
+            slot.ref_count -= 1
+            if slot.ref_count > 0:
+                continue
+            if slot.block_hash is not None:
+                self.registry.inactive[slot.block_hash] = slot
+                self.registry.inactive.move_to_end(slot.block_hash)
+            else:
+                del self._slots[idx]
+                self._free.append(idx)

@@ -1,0 +1,125 @@
+"""KV-aware routed engine client: the frontend side of KV routing.
+
+Ties the router core (indexer + selector + active sequences, this package)
+into the serving path, playing the reference's `KvPushRouter`
+(`kv_router.rs:304`) role:
+
+- subscribes to the `kv_events` subject on the control plane and feeds the
+  RadixTree indexer (reference: NATS kv_events → `KvIndexer` event loop);
+- on every request, scores live instances (prefix overlap + decode/prefill
+  load) and dispatches *direct* to the chosen worker;
+- tracks in-flight state (ActiveSequencesMultiWorker) — prefill complete on
+  first token, per-token block growth, free on finish;
+- removes workers from the index when their instances vanish.
+
+Composes under MigrationClient: a retried generate() re-routes, and the
+dead worker has already been dropped from the instance set by its lease.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Optional
+
+from dynamo_tpu.engine.engine import TokenDelta
+from dynamo_tpu.llm.kv_router.protocols import RouterEvent
+from dynamo_tpu.llm.kv_router.router import KvRouter, KvRouterConfig
+from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+
+logger = logging.getLogger(__name__)
+
+KV_EVENTS_SUBJECT = "kv_events"
+
+
+class KvRoutedEngineClient:
+    """EngineClient with KV-cache-aware worker selection."""
+
+    def __init__(self, client, runtime, block_size: int = 64,
+                 config: Optional[KvRouterConfig] = None) -> None:
+        from dynamo_tpu.llm.discovery import delta_from_wire, request_to_wire
+
+        self._to_wire = request_to_wire
+        self._from_wire = delta_from_wire
+        self.client = client          # runtime Client (instance watcher)
+        self.runtime = runtime
+        self.router = KvRouter(config or KvRouterConfig(block_size=block_size))
+        self._event_task: Optional[asyncio.Task] = None
+        self._sub = None
+        # Penalty box: workers that just failed a connection are excluded
+        # from routing until their lease expires or the TTL passes —
+        # otherwise the highest-overlap (dead) worker would be re-chosen on
+        # every migration retry (reference PushRouter fault detection,
+        # `push_router.rs:168`).
+        self._penalty: dict = {}
+        self._penalty_ttl = 3.0
+
+    async def start(self) -> None:
+        self._sub = await self.runtime.cp.subscribe(KV_EVENTS_SUBJECT)
+        self._event_task = asyncio.create_task(self._pump_events())
+
+    async def stop(self) -> None:
+        if self._sub:
+            self._sub.cancel()
+        if self._event_task:
+            self._event_task.cancel()
+            try:
+                await self._event_task
+            except asyncio.CancelledError:
+                pass
+
+    async def _pump_events(self) -> None:
+        while True:
+            try:
+                payload = await self._sub.next()
+            except ConnectionError:
+                logger.error("kv_events subscription lost; index frozen")
+                return
+            try:
+                self.router.apply_event(RouterEvent.from_dict(payload))
+            except Exception:
+                logger.exception("bad kv event payload")
+
+    def _sync_workers(self) -> list:
+        """Reconcile the router's worker set with live instances."""
+        import time
+
+        live = self.client.instance_ids()
+        known = self.router.workers()
+        for w in known:
+            if w not in live:
+                self.router.remove_worker(w)
+        now = time.monotonic()
+        self._penalty = {w: t for w, t in self._penalty.items() if t > now}
+        healthy = [w for w in live if w not in self._penalty]
+        return healthy or live  # all penalised → try anyway
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[TokenDelta]:
+        workers = self._sync_workers()
+        worker_id, overlap = self.router.find_best_match(
+            request.request_id, request.token_ids, workers,
+            expected_output_tokens=request.sampling.max_tokens)
+        logger.debug("kv-routed %s → worker %s (overlap %d blocks)",
+                     request.request_id, worker_id, overlap)
+        first = True
+        try:
+            async for d in self.client.direct(self._to_wire(request),
+                                              worker_id):
+                delta = self._from_wire(d)
+                delta.request_id = request.request_id
+                if delta.token_ids:
+                    if first:
+                        self.router.mark_prefill_complete(request.request_id)
+                        first = False
+                    self.router.push_token(request.request_id,
+                                           len(delta.token_ids))
+                yield delta
+        except ConnectionError:
+            import time
+
+            self._penalty[worker_id] = time.monotonic() + self._penalty_ttl
+            raise
+        finally:
+            self.router.free(request.request_id)

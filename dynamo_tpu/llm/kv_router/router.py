@@ -68,6 +68,14 @@ class KvRouter:
             on_hit_rate_event=on_hit_rate_event,
         )
 
+    def workers(self) -> List[WorkerId]:
+        """Workers the router currently knows anything about (index
+        residency or in-flight accounting)."""
+        known = set(self.active.workers())
+        if self.indexer:
+            known.update(self.indexer.tree.workers())
+        return sorted(known)
+
     # -- event ingestion --------------------------------------------------
     def apply_event(self, ev: RouterEvent) -> None:
         if self.indexer:

@@ -129,6 +129,10 @@ class ActiveSequencesMultiWorker:
             self._workers[worker] = ws
         return ws
 
+    def workers(self) -> list:
+        with self._lock:
+            return sorted(self._workers)
+
     def add_request(
         self,
         request_id: str,

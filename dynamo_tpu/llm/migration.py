@@ -1,0 +1,80 @@
+"""Request migration: resume in-flight streams on worker death.
+
+Role of the reference's `lib/llm/src/migration.rs:27-163` (RetryManager):
+wraps an EngineClient; when the stream dies mid-request (ConnectionError /
+no instances), it re-issues the request to a surviving worker with the
+already-generated tokens appended to the prompt and `max_tokens`
+decremented (`track_response` semantics, `migration.rs:148-163`), up to
+`migration_limit` attempts.  The client sees one uninterrupted stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from typing import AsyncIterator
+
+from dynamo_tpu.engine.engine import TokenDelta
+from dynamo_tpu.engine.scheduler import FinishReason
+from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime.distributed import NoInstancesError
+from dynamo_tpu.runtime.rpc import RpcError
+
+logger = logging.getLogger(__name__)
+
+RETRYABLE = (ConnectionError, NoInstancesError)
+
+
+class MigrationClient:
+    """EngineClient decorator adding stream migration."""
+
+    def __init__(self, inner, migration_limit: int = 3,
+                 retry_delay: float = 0.05) -> None:
+        self.inner = inner
+        self.migration_limit = migration_limit
+        self.retry_delay = retry_delay
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[TokenDelta]:
+        generated: list = []
+        attempts_left = self.migration_limit
+        req = request
+        while True:
+            try:
+                async for delta in self.inner.generate(req):
+                    generated.extend(delta.token_ids)
+                    yield delta
+                    if delta.finished:
+                        return
+                return  # clean end without finished marker: treat as done
+            except RETRYABLE as e:
+                if attempts_left <= 0:
+                    logger.error("migration budget exhausted for %s",
+                                 request.request_id)
+                    raise
+                attempts_left -= 1
+                # Resume: prompt + tokens so far; budget shrinks by
+                # what was already delivered (reference migration.rs:148).
+                new_max = request.sampling.max_tokens - len(generated)
+                if new_max <= 0:
+                    # Full budget was delivered before the worker died (only
+                    # the finished marker was lost) — close the stream as a
+                    # normal length-finish, not an error.
+                    yield TokenDelta(request_id=request.request_id,
+                                     token_ids=[], finished=True,
+                                     finish_reason=FinishReason.LENGTH)
+                    return
+                req = dataclasses.replace(
+                    request,
+                    request_id=f"{request.request_id}#m{self.migration_limit - attempts_left}",
+                    token_ids=list(request.token_ids) + generated,
+                    sampling=dataclasses.replace(
+                        request.sampling, max_tokens=new_max),
+                )
+                logger.warning(
+                    "migrating %s after %s (%d tokens in, %d attempts left)",
+                    request.request_id, type(e).__name__, len(generated),
+                    attempts_left)
+                await asyncio.sleep(self.retry_delay)

@@ -1,0 +1,389 @@
+"""TCP transport for the control plane (multi-process deployments).
+
+`ControlPlaneServer` hosts a ControlPlaneState over asyncio TCP with
+newline-delimited JSON frames; `ControlPlaneClient` implements the same
+interface as InProcessControlPlane, so DistributedRuntime doesn't care
+which it got.  (Native C++ broker: see csrc/ — this Python server defines
+the wire protocol the C++ implementation speaks too.)
+
+Wire protocol (one JSON object per line):
+  request:  {"op": <name>, "id": N, ...args}
+  response: {"id": N, "ok": true, ...result} | {"id": N, "ok": false, "error": ...}
+  pushed:   {"push": "watch"|"sub"|"queue", "sid": S, ...payload}
+
+Connection death cleans up that client's watches/subscriptions; leases die
+by TTL (a dead worker's instance keys vanish within one lease TTL, the
+reference's liveness model — `transports/etcd/lease.rs`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json
+import logging
+from typing import Dict, Optional
+
+from dynamo_tpu.runtime.control_plane import (
+    ControlPlaneState,
+    WatchEvent,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class ControlPlaneServer:
+    def __init__(self, state: Optional[ControlPlaneState] = None) -> None:
+        self.state = state or ControlPlaneState()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._connections: set = set()
+        self.port: Optional[int] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        if self.state._reaper is None:
+            self.state._reaper = asyncio.create_task(self.state.run_reaper())
+        logger.info("control plane on %s:%d", host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self.state._reaper:
+            self.state._reaper.cancel()
+            try:
+                await self.state._reaper
+            except asyncio.CancelledError:
+                pass
+            self.state._reaper = None
+        if self._server:
+            self._server.close()
+            # Sever live client connections before wait_closed(): on
+            # Python 3.12+ it blocks until every connection handler
+            # returns, and handlers sit in blocking reads.
+            for w in list(self._connections):
+                w.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self._connections.add(writer)
+        watches: Dict[int, asyncio.Queue] = {}
+        subs: Dict[int, tuple] = {}     # sid → (subject, queue)
+        pumps: list = []
+        send_lock = asyncio.Lock()
+
+        async def send(obj: dict) -> None:
+            async with send_lock:
+                writer.write(json.dumps(obj).encode() + b"\n")
+                await writer.drain()
+
+        async def pump_watch(sid: int, q: asyncio.Queue) -> None:
+            while True:
+                ev: WatchEvent = await q.get()
+                await send({"push": "watch", "sid": sid, "kind": ev.kind,
+                            "key": ev.key, "value": ev.value})
+
+        async def pump_sub(sid: int, q: asyncio.Queue) -> None:
+            while True:
+                payload = await q.get()
+                await send({"push": "sub", "sid": sid, "payload": payload})
+
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    await send({"ok": False, "error": "bad json", "id": None})
+                    continue
+                op, mid = msg.get("op"), msg.get("id")
+                st = self.state
+                try:
+                    if op == "lease_grant":
+                        res = {"lease": st.lease_grant(msg.get("ttl", 10.0))}
+                    elif op == "lease_keepalive":
+                        res = {"alive": st.lease_keepalive(msg["lease"])}
+                    elif op == "lease_revoke":
+                        st.lease_revoke(msg["lease"])
+                        res = {}
+                    elif op == "put":
+                        st.put(msg["key"], msg["value"], msg.get("lease"))
+                        res = {}
+                    elif op == "get":
+                        res = {"value": st.get(msg["key"])}
+                    elif op == "get_prefix":
+                        res = {"values": st.get_prefix(msg["prefix"])}
+                    elif op == "delete":
+                        res = {"deleted": st.delete(msg["key"])}
+                    elif op == "watch":
+                        sid = msg["sid"]
+                        q = st.watch_prefix(msg["prefix"])
+                        watches[sid] = q
+                        pumps.append(asyncio.create_task(pump_watch(sid, q)))
+                        res = {}
+                    elif op == "unwatch":
+                        q = watches.pop(msg["sid"], None)
+                        if q:
+                            st.unwatch(q)
+                        res = {}
+                    elif op == "subscribe":
+                        sid = msg["sid"]
+                        q = st.subscribe(msg["subject"])
+                        subs[sid] = (msg["subject"], q)
+                        pumps.append(asyncio.create_task(pump_sub(sid, q)))
+                        res = {}
+                    elif op == "unsubscribe":
+                        subj_q = subs.pop(msg["sid"], None)
+                        if subj_q:
+                            st.unsubscribe(*subj_q)
+                        res = {}
+                    elif op == "publish":
+                        res = {"n": st.publish(msg["subject"], msg["payload"])}
+                    elif op == "queue_push":
+                        st.queue_push(msg["queue"], msg["payload"])
+                        res = {}
+                    elif op == "queue_pop":
+                        # Async pop: reply comes whenever an item arrives.
+                        async def do_pop(mid=mid, name=msg["queue"]):
+                            item = await st.queue_pop(name)
+                            await send({"id": mid, "ok": True, "payload": item})
+                        pumps.append(asyncio.create_task(do_pop()))
+                        continue
+                    elif op == "queue_len":
+                        res = {"n": st.queue_len(msg["queue"])}
+                    else:
+                        raise ValueError(f"unknown op {op!r}")
+                    await send({"id": mid, "ok": True, **res})
+                except Exception as e:  # per-op failure, connection survives
+                    await send({"id": mid, "ok": False, "error": str(e)})
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            for t in pumps:
+                t.cancel()
+            for q in watches.values():
+                self.state.unwatch(q)
+            for subj, q in subs.values():
+                self.state.unsubscribe(subj, q)
+            self._connections.discard(writer)
+            writer.close()
+
+
+_POISON = object()  # sentinel pushed into stream queues on connection death
+
+
+class _RemoteWatch:
+    def __init__(self, client: "ControlPlaneClient", sid: int) -> None:
+        self._client, self._sid = client, sid
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    async def next(self) -> WatchEvent:
+        item = await self.queue.get()
+        if item is _POISON:
+            raise ConnectionError("control plane connection lost")
+        return item
+
+    def cancel(self) -> None:
+        self._client._drop_watch(self._sid)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        return await self.next()
+
+
+class _RemoteSubscription:
+    def __init__(self, client: "ControlPlaneClient", sid: int,
+                 subject: str) -> None:
+        self._client, self._sid, self.subject = client, sid, subject
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+    async def next(self) -> dict:
+        item = await self.queue.get()
+        if item is _POISON:
+            raise ConnectionError("control plane connection lost")
+        return item
+
+    def cancel(self) -> None:
+        self._client._drop_sub(self._sid)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> dict:
+        return await self.next()
+
+
+class ControlPlaneClient:
+    """TCP client with the InProcessControlPlane interface."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self.host, self.port = host, port
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._mid = itertools.count(1)
+        self._sid = itertools.count(1)
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._watches: Dict[int, _RemoteWatch] = {}
+        self._subs: Dict[int, _RemoteSubscription] = {}
+        self._rx_task: Optional[asyncio.Task] = None
+        self._keepalive_tasks: Dict[int, asyncio.Task] = {}
+        self._send_lock = asyncio.Lock()
+
+    async def start(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        self._rx_task = asyncio.create_task(self._rx_loop())
+
+    async def close(self) -> None:
+        for t in self._keepalive_tasks.values():
+            t.cancel()
+        if self._rx_task:
+            self._rx_task.cancel()
+            try:
+                await self._rx_task
+            except asyncio.CancelledError:
+                pass
+        self._fail_all(ConnectionError("control plane client closed"))
+        if self._writer:
+            self._writer.close()
+
+    def _fail_all(self, exc: Exception) -> None:
+        """Connection is gone: fail pending calls AND poison stream queues,
+        so watchers/subscribers surface the outage instead of waiting on a
+        frozen queue forever."""
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+        self._pending.clear()
+        for w in self._watches.values():
+            w.queue.put_nowait(_POISON)
+        for s in self._subs.values():
+            s.queue.put_nowait(_POISON)
+
+    async def _rx_loop(self) -> None:
+        assert self._reader is not None
+        while True:
+            line = await self._reader.readline()
+            if not line:
+                self._fail_all(ConnectionError("control plane gone"))
+                return
+            msg = json.loads(line)
+            push = msg.get("push")
+            if push == "watch":
+                w = self._watches.get(msg["sid"])
+                if w:
+                    w.queue.put_nowait(WatchEvent(
+                        msg["kind"], msg["key"], msg.get("value")))
+            elif push == "sub":
+                s = self._subs.get(msg["sid"])
+                if s:
+                    s.queue.put_nowait(msg["payload"])
+            else:
+                fut = self._pending.pop(msg.get("id"), None)
+                if fut and not fut.done():
+                    fut.set_result(msg)
+
+    async def _call(self, op: str, **kw) -> dict:
+        mid = next(self._mid)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[mid] = fut
+        async with self._send_lock:
+            self._writer.write(
+                json.dumps({"op": op, "id": mid, **kw}).encode() + b"\n")
+            await self._writer.drain()
+        msg = await fut
+        if not msg.get("ok"):
+            raise RuntimeError(f"control plane {op} failed: {msg.get('error')}")
+        return msg
+
+    # -- leases -----------------------------------------------------------
+
+    async def lease_grant(self, ttl: float = 10.0,
+                          auto_keepalive: bool = True) -> int:
+        lease = (await self._call("lease_grant", ttl=ttl))["lease"]
+        if auto_keepalive:
+            self._keepalive_tasks[lease] = asyncio.create_task(
+                self._keepalive_loop(lease, ttl))
+        return lease
+
+    async def _keepalive_loop(self, lease: int, ttl: float) -> None:
+        try:
+            while True:
+                await asyncio.sleep(ttl / 3.0)
+                try:
+                    msg = await self._call("lease_keepalive", lease=lease)
+                except (RuntimeError, ConnectionError):
+                    return
+                if not msg.get("alive"):
+                    # Lease expired server-side (stall > TTL or control-plane
+                    # restart): our registrations are gone.  Surface loudly —
+                    # a silently-invisible worker is the worst failure mode.
+                    logger.error(
+                        "lease %d expired server-side; registrations lost "
+                        "(worker must re-register)", lease)
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    async def lease_revoke(self, lease: int) -> None:
+        t = self._keepalive_tasks.pop(lease, None)
+        if t:
+            t.cancel()
+        await self._call("lease_revoke", lease=lease)
+
+    # -- kv ---------------------------------------------------------------
+
+    async def put(self, key: str, value: dict,
+                  lease: Optional[int] = None) -> None:
+        await self._call("put", key=key, value=value, lease=lease)
+
+    async def get(self, key: str) -> Optional[dict]:
+        return (await self._call("get", key=key))["value"]
+
+    async def get_prefix(self, prefix: str) -> Dict[str, dict]:
+        return (await self._call("get_prefix", prefix=prefix))["values"]
+
+    async def delete(self, key: str) -> bool:
+        return (await self._call("delete", key=key))["deleted"]
+
+    async def watch_prefix(self, prefix: str) -> _RemoteWatch:
+        sid = next(self._sid)
+        w = _RemoteWatch(self, sid)
+        self._watches[sid] = w
+        await self._call("watch", prefix=prefix, sid=sid)
+        return w
+
+    def _drop_watch(self, sid: int) -> None:
+        self._watches.pop(sid, None)
+        asyncio.ensure_future(self._call("unwatch", sid=sid))
+
+    # -- pub/sub ----------------------------------------------------------
+
+    async def publish(self, subject: str, payload: dict) -> None:
+        await self._call("publish", subject=subject, payload=payload)
+
+    async def subscribe(self, subject: str) -> _RemoteSubscription:
+        sid = next(self._sid)
+        s = _RemoteSubscription(self, sid, subject)
+        self._subs[sid] = s
+        await self._call("subscribe", subject=subject, sid=sid)
+        return s
+
+    def _drop_sub(self, sid: int) -> None:
+        self._subs.pop(sid, None)
+        asyncio.ensure_future(self._call("unsubscribe", sid=sid))
+
+    # -- queues -----------------------------------------------------------
+
+    async def queue_push(self, name: str, payload: dict) -> None:
+        await self._call("queue_push", queue=name, payload=payload)
+
+    async def queue_pop(self, name: str) -> dict:
+        return (await self._call("queue_pop", queue=name))["payload"]
+
+    async def queue_len(self, name: str) -> int:
+        return (await self._call("queue_len", queue=name))["n"]

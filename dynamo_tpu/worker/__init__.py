@@ -1,0 +1,2 @@
+"""Backend worker entrypoints (reference `dynamo.vllm` / `dynamo.mocker`
+worker mains, `components/backends/*/src/dynamo/*/main.py`)."""

@@ -1,0 +1,3 @@
+from dynamo_tpu.worker.main import main
+
+main()

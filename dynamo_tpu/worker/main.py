@@ -1,0 +1,143 @@
+"""`python -m dynamo_tpu.worker` — a backend worker process.
+
+Reference analog: `dynamo.vllm`/`dynamo.mocker` mains — connect to the
+control plane, serve the engine endpoint, `register_llm`, publish KV
+events + load metrics, drain gracefully on SIGTERM (SURVEY.md §3.2).
+
+    python -m dynamo_tpu.worker --control-plane HOST:PORT --mocker
+    python -m dynamo_tpu.worker --control-plane HOST:PORT --model tiny-test
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from dynamo_tpu.llm.discovery import engine_wire_handler, register_llm
+from dynamo_tpu.llm.kv_router.protocols import RouterEvent
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneClient
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+logger = logging.getLogger("dynamo_tpu.worker")
+
+KV_EVENTS_SUBJECT = "kv_events"        # reference kv_router.rs:56
+METRICS_SUBJECT = "load_metrics"       # reference stats endpoint name
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("dynamo_tpu.worker")
+    p.add_argument("--control-plane", required=True,
+                   help="control plane HOST:PORT")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--model-name", default="dynamo-tpu")
+    p.add_argument("--mocker", action="store_true")
+    p.add_argument("--model", default=None, help="JAX engine model preset")
+    p.add_argument("--num-blocks", type=int, default=512)
+    p.add_argument("--block-size", type=int, default=64)
+    p.add_argument("--speedup-ratio", type=float, default=10.0)
+    p.add_argument("--metrics-interval", type=float, default=1.0)
+    return p.parse_args(argv)
+
+
+async def build_engine(args, kv_event_sink):
+    """Returns (engine_client, metrics_fn, shutdown)."""
+    if args.mocker:
+        from dynamo_tpu.llm.mocker import MockEngine, MockEngineArgs
+
+        engine = MockEngine(
+            MockEngineArgs(block_size=args.block_size,
+                           speedup_ratio=args.speedup_ratio),
+            kv_event_sink=kv_event_sink)
+        await engine.start()
+        return engine, (lambda: engine.metrics), engine.stop
+
+    from dynamo_tpu.engine.engine import (
+        EngineConfig, EngineCore, InferenceEngine)
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.llm.service import LocalEngineClient
+    from dynamo_tpu.models.config import get_config
+
+    core = EngineCore(
+        EngineConfig(model=get_config(args.model or "llama-3-1b"),
+                     num_blocks=args.num_blocks,
+                     scheduler=SchedulerConfig(block_size=args.block_size)),
+        kv_event_sink=kv_event_sink)
+    engine = InferenceEngine(core)
+    await engine.start()
+    return LocalEngineClient(engine), (lambda: core.metrics), engine.stop
+
+
+async def run(args) -> None:
+    cp = ControlPlaneClient(*_split(args.control_plane))
+    await cp.start()
+    runtime = DistributedRuntime(cp)
+    endpoint = (runtime.namespace(args.namespace)
+                .component(args.component).endpoint(args.endpoint))
+
+    loop = asyncio.get_running_loop()
+    pending_events: list = []
+
+    def kv_event_sink(event):
+        # Engine threads may emit; hop onto the loop for the publish.
+        loop.call_soon_threadsafe(pending_events.append, event)
+
+    engine, metrics_fn, shutdown = await build_engine(args, kv_event_sink)
+    instance = await endpoint.serve(engine_wire_handler(engine))
+    card = ModelDeploymentCard(name=args.model_name,
+                               kv_block_size=args.block_size)
+    await register_llm(endpoint, instance, card)
+    print(f"worker instance {instance.instance_id} serving "
+          f"{args.model_name!r} at {instance.address}", flush=True)
+
+    async def pump_events():
+        while True:
+            await asyncio.sleep(0.02)
+            while pending_events:
+                ev = pending_events.pop(0)
+                await cp.publish(KV_EVENTS_SUBJECT, RouterEvent(
+                    worker_id=instance.instance_id, event=ev).to_dict())
+
+    async def pump_metrics():
+        while True:
+            await asyncio.sleep(args.metrics_interval)
+            m = metrics_fn()
+            await cp.publish(METRICS_SUBJECT, {
+                "worker_id": instance.instance_id,
+                "metrics": m.to_dict()})
+
+    pumps = [asyncio.create_task(pump_events()),
+             asyncio.create_task(pump_metrics())]
+
+    stop_ev = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop_ev.set)
+    await stop_ev.wait()
+
+    # Graceful drain: leave routing instantly, finish in-flight streams.
+    await endpoint.leave()
+    while runtime.rpc.active_streams > 0:
+        await asyncio.sleep(0.05)
+    for t in pumps:
+        t.cancel()
+    await shutdown()
+    await runtime.shutdown()
+    await cp.close()
+
+
+def _split(addr: str):
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    main()

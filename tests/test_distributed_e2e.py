@@ -1,0 +1,156 @@
+"""Multi-process e2e: control plane + frontend + worker subprocesses.
+
+Mirror of the reference's pytest e2e tier (SURVEY.md §4: real etcd + NATS
++ ManagedProcess workers — here our own control plane + real `python -m
+dynamo_tpu.worker` subprocesses) including the fault-tolerance scenario of
+`tests/fault_tolerance/test_request_migration.py`: kill a worker
+mid-stream, assert the stream migrates to the survivor.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+_worker_seq = [0]
+
+
+def _spawn_worker(cp_port: int, name: str, speedup: float = 10.0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    _worker_seq[0] += 1
+    # Log to a file, not a pipe: a filled pipe buffer would wedge the
+    # worker, and a crashed worker's output must survive for diagnosis.
+    log = open(f"/tmp/dynamo_tpu_test_worker_{os.getpid()}_{_worker_seq[0]}.log",
+               "w+")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.worker",
+         "--control-plane", f"127.0.0.1:{cp_port}",
+         "--mocker", "--model-name", name,
+         "--block-size", "8",
+         "--speedup-ratio", str(speedup)],
+        env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT, text=True)
+    proc._logfile = log  # type: ignore[attr-defined]
+    return proc
+
+
+def _worker_log(proc) -> str:
+    proc._logfile.flush()
+    proc._logfile.seek(0)
+    return proc._logfile.read()
+
+
+async def _wait_port_instances(cp, prefix, n, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        found = await cp.get_prefix(prefix)
+        if len(found) >= n:
+            return found
+        await asyncio.sleep(0.2)
+    raise TimeoutError(f"never saw {n} entries under {prefix}")
+
+
+@pytest.mark.e2e
+def test_distributed_serving_and_migration():
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm.discovery import ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.service import ModelManager
+    from dynamo_tpu.runtime.control_plane_tcp import (
+        ControlPlaneClient, ControlPlaneServer)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    workers = []
+
+    async def main():
+        cp_server = ControlPlaneServer()
+        cp_port = await cp_server.start()
+
+        # Frontend in-process: discovery + HTTP.
+        cp = ControlPlaneClient("127.0.0.1", cp_port)
+        await cp.start()
+        runtime = DistributedRuntime(cp)
+        models = ModelManager()
+        watcher = ModelWatcher(runtime, models, migration_limit=3)
+        await watcher.start()
+        svc = HttpService(models)
+        http_port = await svc.start()
+
+        # Two mock workers as real OS processes.  Slow decode (speedup 1)
+        # so a mid-stream kill lands while generating.
+        workers.append(_spawn_worker(cp_port, "mock-model", speedup=1.0))
+        workers.append(_spawn_worker(cp_port, "mock-model", speedup=1.0))
+        await _wait_port_instances(cp, "models/mock-model/", 2, timeout=60)
+        await watcher.wait_for_model("mock-model", timeout=10)
+
+        base = f"http://127.0.0.1:{http_port}"
+        async with ClientSession() as s:
+            # 1) Plain unary requests spread across workers.
+            for i in range(4):
+                async with s.post(f"{base}/v1/chat/completions", json={
+                        "model": "mock-model",
+                        "messages": [{"role": "user", "content": f"q{i}"}],
+                        "max_tokens": 3}) as r:
+                    assert r.status == 200, await r.text()
+                    data = await r.json()
+                    assert data["usage"]["completion_tokens"] == 3
+
+            # 2) Long streaming request; kill one worker mid-stream.
+            payload = {
+                "model": "mock-model",
+                "messages": [{"role": "user", "content": "long"}],
+                "max_tokens": 60, "stream": True,
+            }
+            tokens_seen = 0
+            killed = False
+            finish_reason = None
+            async with s.post(f"{base}/v1/chat/completions",
+                              json=payload) as r:
+                assert r.status == 200
+                async for raw in r.content:
+                    line = raw.decode().strip()
+                    if not line.startswith("data:") or line == "data: [DONE]":
+                        continue
+                    chunk = json.loads(line[5:])
+                    choice = chunk["choices"][0]
+                    if choice.get("delta", {}).get("content"):
+                        tokens_seen += 1
+                        if tokens_seen == 5 and not killed:
+                            # Kill both? No — kill ONE; migration should
+                            # land the retry on the survivor.
+                            workers[0].send_signal(signal.SIGKILL)
+                            killed = True
+                    if choice.get("finish_reason"):
+                        finish_reason = choice["finish_reason"]
+            assert killed
+            assert finish_reason == "length"
+            # The stream completed despite the kill; the resumed request
+            # re-issues remaining budget, so total content tokens reach
+            # (close to) max_tokens.  Chunk boundaries may merge bytes, so
+            # assert on a safe lower bound.
+            assert tokens_seen >= 30, f"only {tokens_seen} content chunks"
+
+        await watcher.stop()
+        await svc.stop()
+        await runtime.shutdown()
+        await cp.close()
+        await cp_server.stop()
+
+    try:
+        asyncio.run(asyncio.wait_for(main(), timeout=180))
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+            out = _worker_log(w)
+            if out:
+                print(f"--- worker output (rc={w.poll()}) ---")
+                print(out[-3000:])

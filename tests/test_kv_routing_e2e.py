@@ -1,0 +1,107 @@
+"""KV-aware routing over the runtime, with mock engines as workers
+(reference analog: `tests/router/test_router_e2e_with_mockers.py`).
+
+Two mock workers serve behind KV routing; requests sharing a prefix must
+stick to the worker holding that prefix's blocks (observable as prefix-
+cache hits on exactly one mocker), while distinct-prefix load spreads.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.llm.discovery import engine_wire_handler
+from dynamo_tpu.llm.kv_router.client import KvRoutedEngineClient
+from dynamo_tpu.llm.kv_router.protocols import RouterEvent
+from dynamo_tpu.llm.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime.control_plane import InProcessControlPlane
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+FAST = MockEngineArgs(num_blocks=256, block_size=8, speedup_ratio=100.0)
+
+
+def _req(rid, tokens, max_tokens=4):
+    return PreprocessedRequest(
+        request_id=rid, model="m", token_ids=list(tokens),
+        sampling=SamplingParams(max_tokens=max_tokens))
+
+
+def test_kv_routing_prefix_stickiness_and_spread():
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        runtime = DistributedRuntime(cp)
+        # Two runtimes → two real RPC addresses, each serving a mock engine
+        # that publishes KV events attributed to its instance id (like
+        # dynamo_tpu.worker's event pump).
+        rt2 = DistributedRuntime(cp)
+        ep1 = (runtime.namespace("dyn").component("backend")
+               .endpoint("generate"))
+        ep2 = (rt2.namespace("dyn").component("backend")
+               .endpoint("generate"))
+        pend1, pend2 = [], []
+        eng1 = MockEngine(FAST, kv_event_sink=pend1.append)
+        eng2 = MockEngine(FAST, kv_event_sink=pend2.append)
+        await eng1.start()
+        await eng2.start()
+        inst1 = await ep1.serve(engine_wire_handler(eng1))
+        inst2 = await ep2.serve(engine_wire_handler(eng2))
+        engines = {inst1.instance_id: eng1, inst2.instance_id: eng2}
+
+        async def pump(pending, iid):
+            while True:
+                await asyncio.sleep(0.005)
+                while pending:
+                    ev = pending.pop(0)
+                    await cp.publish("kv_events", RouterEvent(
+                        worker_id=iid, event=ev).to_dict())
+
+        pumps = [asyncio.create_task(pump(pend1, inst1.instance_id)),
+                 asyncio.create_task(pump(pend2, inst2.instance_id))]
+
+        client = await (runtime.namespace("dyn").component("backend")
+                        .endpoint("generate").client())
+        await client.wait_for_instances()
+        kv = KvRoutedEngineClient(client, runtime, block_size=8)
+        await kv.start()
+
+        async def run_one(rid, tokens):
+            out = []
+            async for d in kv.generate(_req(rid, tokens)):
+                out.extend(d.token_ids)
+            return out
+
+        # Phase 1: two distinct long prefixes → load spreads (each lands
+        # somewhere; with empty caches the selector balances by load).
+        prefix_a = list(range(100, 164))      # 8 blocks
+        prefix_b = list(range(200, 264))
+        await run_one("a0", prefix_a)
+        await run_one("b0", prefix_b)
+        await asyncio.sleep(0.05)             # let events index
+
+        # Phase 2: repeats of each prefix must go to the worker that
+        # already holds it (prefix-cache stickiness).
+        for i in range(1, 4):
+            await run_one(f"a{i}", prefix_a + [i])
+            await run_one(f"b{i}", prefix_b + [i])
+            await asyncio.sleep(0.02)
+
+        hits = {iid: e.kv.hit_blocks for iid, e in engines.items()}
+        total_hits = sum(hits.values())
+        # 6 repeat requests × 8 shared blocks = 48 potential hits; routing
+        # that ignored residency would average ~half.  Require most.
+        assert total_hits >= 36, f"prefix hits too low: {hits}"
+
+        for t in pumps:
+            t.cancel()
+        await kv.stop()
+        await client.stop()
+        await eng1.stop()
+        await eng2.stop()
+        await runtime.shutdown()
+        await rt2.shutdown()
+        await cp.close()
+
+    asyncio.run(asyncio.wait_for(main(), timeout=60))

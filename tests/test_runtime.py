@@ -1,0 +1,301 @@
+"""Distributed runtime: control plane, RPC streams, component model.
+
+Mirrors the reference's `lib/runtime/tests/{lifecycle,pipeline}.rs` +
+bindings hello_world: echo handlers served cross-"process" (separate
+runtimes in one test process, talking over real TCP sockets).
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.control_plane import (
+    ControlPlaneState,
+    InProcessControlPlane,
+)
+from dynamo_tpu.runtime.control_plane_tcp import (
+    ControlPlaneClient,
+    ControlPlaneServer,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime, NoInstancesError
+from dynamo_tpu.runtime.rpc import RpcClient, RpcError, RpcServer
+
+
+def _run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# -- control plane state -----------------------------------------------------
+
+
+def test_kv_lease_expiry_removes_keys():
+    async def main():
+        st = ControlPlaneState()
+        lease = st.lease_grant(ttl=0.05)
+        st.put("instances/ns/c/e:1", {"x": 1}, lease=lease)
+        st.put("persistent", {"y": 2})
+        assert st.get("instances/ns/c/e:1") == {"x": 1}
+        await asyncio.sleep(0.1)
+        st.expire_leases()
+        assert st.get("instances/ns/c/e:1") is None
+        assert st.get("persistent") == {"y": 2}
+
+    _run(main())
+
+
+def test_watch_sees_existing_and_new():
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        try:
+            await cp.put("pre/a", {"v": 1})
+            w = await cp.watch_prefix("pre/")
+            ev = await w.next()
+            assert (ev.kind, ev.key, ev.value) == ("put", "pre/a", {"v": 1})
+            await cp.put("pre/b", {"v": 2})
+            ev = await w.next()
+            assert ev.key == "pre/b"
+            await cp.delete("pre/a")
+            ev = await w.next()
+            assert (ev.kind, ev.key) == ("delete", "pre/a")
+        finally:
+            await cp.close()
+
+    _run(main())
+
+
+def test_pubsub_and_queue():
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        try:
+            sub = await cp.subscribe("kv_events")
+            await cp.publish("kv_events", {"n": 1})
+            assert await sub.next() == {"n": 1}
+
+            await cp.queue_push("prefill", {"req": "a"})
+            assert await cp.queue_len("prefill") == 1
+            assert await cp.queue_pop("prefill") == {"req": "a"}
+        finally:
+            await cp.close()
+
+    _run(main())
+
+
+# -- TCP control plane -------------------------------------------------------
+
+
+def test_tcp_control_plane_roundtrip():
+    async def main():
+        srv = ControlPlaneServer()
+        port = await srv.start()
+        c1 = ControlPlaneClient("127.0.0.1", port)
+        c2 = ControlPlaneClient("127.0.0.1", port)
+        await c1.start()
+        await c2.start()
+        try:
+            # KV + watch across clients.
+            w = await c2.watch_prefix("m/")
+            lease = await c1.lease_grant(ttl=5.0)
+            await c1.put("m/x", {"addr": "h:1"}, lease=lease)
+            ev = await w.next()
+            assert (ev.kind, ev.key, ev.value) == ("put", "m/x", {"addr": "h:1"})
+            assert await c2.get("m/x") == {"addr": "h:1"}
+            assert await c2.get_prefix("m/") == {"m/x": {"addr": "h:1"}}
+
+            # Lease revoke propagates as delete event.
+            await c1.lease_revoke(lease)
+            ev = await w.next()
+            assert (ev.kind, ev.key) == ("delete", "m/x")
+
+            # Pub/sub across clients.
+            sub = await c2.subscribe("s")
+            await c1.publish("s", {"k": 9})
+            assert await sub.next() == {"k": 9}
+
+            # Work queue: blocking pop completes when item arrives.
+            pop = asyncio.create_task(c2.queue_pop("q"))
+            await asyncio.sleep(0.05)
+            await c1.queue_push("q", {"job": 1})
+            assert await pop == {"job": 1}
+        finally:
+            await c1.close()
+            await c2.close()
+            await srv.stop()
+
+    _run(main())
+
+
+def test_tcp_lease_ttl_expires_dead_client():
+    async def main():
+        srv = ControlPlaneServer()
+        port = await srv.start()
+        c1 = ControlPlaneClient("127.0.0.1", port)
+        await c1.start()
+        lease = await c1.lease_grant(ttl=0.2, auto_keepalive=False)
+        await c1.put("inst/a:1", {"x": 1}, lease=lease)
+        # Simulate worker death: close without revoke; TTL reaps the key.
+        await c1.close()
+        await asyncio.sleep(1.5)   # reaper interval 1s + ttl
+        c2 = ControlPlaneClient("127.0.0.1", port)
+        await c2.start()
+        try:
+            assert await c2.get("inst/a:1") is None
+        finally:
+            await c2.close()
+            await srv.stop()
+
+    _run(main())
+
+
+# -- rpc ---------------------------------------------------------------------
+
+
+def test_rpc_stream_and_error():
+    async def main():
+        srv = RpcServer()
+
+        async def echo3(payload):
+            for i in range(3):
+                yield {"i": i, "msg": payload["msg"]}
+
+        async def boom(payload):
+            yield {"ok": 1}
+            raise ValueError("kaboom")
+
+        srv.register("ns/c/echo", echo3)
+        srv.register("ns/c/boom", boom)
+        addr = await srv.start()
+        client = RpcClient(addr)
+        try:
+            got = [d async for d in client.call("ns/c/echo", {"msg": "hi"})]
+            assert got == [{"i": 0, "msg": "hi"}, {"i": 1, "msg": "hi"},
+                           {"i": 2, "msg": "hi"}]
+
+            with pytest.raises(RpcError, match="kaboom"):
+                async for d in client.call("ns/c/boom", {}):
+                    assert d == {"ok": 1}
+
+            with pytest.raises(RpcError, match="no such endpoint"):
+                async for _ in client.call("ns/c/missing", {}):
+                    pass
+        finally:
+            await client.close()
+            await srv.stop()
+
+    _run(main())
+
+
+def test_rpc_cancellation_stops_handler():
+    async def main():
+        srv = RpcServer()
+        cancelled = asyncio.Event()
+
+        async def slow(payload):
+            try:
+                for i in range(1000):
+                    yield {"i": i}
+                    await asyncio.sleep(0.01)
+            except asyncio.CancelledError:
+                cancelled.set()
+                raise
+
+        srv.register("e", slow)
+        addr = await srv.start()
+        client = RpcClient(addr)
+        try:
+            agen = client.call("e", {})
+            first = await agen.__anext__()
+            assert first == {"i": 0}
+            await agen.aclose()          # client walks away
+            await asyncio.wait_for(cancelled.wait(), 5)
+        finally:
+            await client.close()
+            await srv.stop()
+
+    _run(main())
+
+
+def test_rpc_connection_loss_surfaces():
+    async def main():
+        srv = RpcServer()
+
+        async def forever(payload):
+            yield {"first": True}
+            await asyncio.sleep(3600)
+
+        srv.register("e", forever)
+        addr = await srv.start()
+        client = RpcClient(addr)
+        try:
+            agen = client.call("e", {})
+            assert await agen.__anext__() == {"first": True}
+            await srv.stop()             # worker dies mid-stream
+            with pytest.raises(ConnectionError):
+                await agen.__anext__()
+        finally:
+            await client.close()
+
+    _run(main())
+
+
+# -- component model ---------------------------------------------------------
+
+
+def test_component_serve_route_and_leave():
+    async def main():
+        cp_state = ControlPlaneState()
+        cp = InProcessControlPlane(cp_state)
+        await cp.start()
+
+        # Two "workers" + one client runtime, sharing the control plane but
+        # with their own RPC servers (real sockets).
+        w1, w2 = DistributedRuntime(cp), DistributedRuntime(cp)
+        frontend = DistributedRuntime(cp)
+
+        async def make_handler(tag):
+            async def handler(payload):
+                yield {"from": tag, "echo": payload["x"]}
+            return handler
+
+        ep1 = w1.namespace("dyn").component("backend").endpoint("generate")
+        ep2 = w2.namespace("dyn").component("backend").endpoint("generate")
+        await ep1.serve(await make_handler("w1"))
+        await ep2.serve(await make_handler("w2"))
+
+        client = await (frontend.namespace("dyn").component("backend")
+                        .endpoint("generate").client())
+        await client.wait_for_instances()
+        assert len(client.instance_ids()) == 2
+
+        # Round-robin spreads.
+        sources = set()
+        for i in range(4):
+            async for d in client.generate({"x": i}):
+                sources.add(d["from"])
+        assert sources == {"w1", "w2"}
+
+        # Direct targets a specific instance.
+        iid = client.instance_ids()[0]
+        async for d in client.direct({"x": 9}, iid):
+            assert d["echo"] == 9
+
+        # Graceful leave removes from routing.
+        await ep1.leave()
+        await asyncio.sleep(0.05)
+        assert len(client.instance_ids()) == 1
+        async for d in client.generate({"x": 5}):
+            assert d["from"] == "w2"
+
+        await ep2.leave()
+        await asyncio.sleep(0.05)
+        with pytest.raises(NoInstancesError):
+            async for _ in client.generate({"x": 0}):
+                pass
+
+        await client.stop()
+        for rt in (w1, w2, frontend):
+            await rt.shutdown()
+        await cp.close()
+
+    _run(main())
