@@ -74,16 +74,26 @@ class Request:
 class BlockAllocator:
     """Free-list page allocator over the paged cache (block 0 reserved null).
 
-    Prefix-cache reuse lives in the block manager (dynamo_tpu/llm/
-    block_manager); this allocator only tracks ownership, and reports the
-    watermark the admission check uses (reference mocker `KvManager`
-    watermark semantics)."""
+    The minimal block source: no prefix reuse (match always misses).  The
+    engine normally uses the tiered, prefix-caching source
+    (dynamo_tpu.llm.block_manager.engine_source.ManagedBlockSource), which
+    duck-types this interface; this one remains for scheduler unit tests
+    and reuse-free configurations.  Watermark semantics follow the
+    reference mocker `KvManager`."""
 
     def __init__(self, num_blocks: int) -> None:
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (block 0 is the null block)")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    # Prefix-cache interface (no-ops here).
+    def match(self, prompt_tokens: Sequence[int]):
+        """Returns (cached_tokens, pinned_pages)."""
+        return 0, []
+
+    def register_block(self, page: int, block_hash: int) -> None:
+        pass
 
     @property
     def free_blocks(self) -> int:
@@ -199,16 +209,27 @@ class Scheduler:
         usable = self.allocator.num_blocks - 1
         while self.waiting and len(self.running) < self.config.max_seqs:
             req = self.waiting[0]
-            # Admit only if the prompt's pages fit and leave the watermark.
-            need = self._pages_needed(len(req.prompt_tokens) + 1)
-            if self.allocator.free_blocks - need < self.config.watermark * usable:
-                break
             slot = next(
                 (i for i, s in enumerate(self._slots) if s is None), None)
             if slot is None:
                 break
+            # Prefix-cache match first: cached pages are reused (pinned),
+            # only the remainder needs fresh allocation.
+            cached_tokens, cached_pages = self.allocator.match(
+                req.prompt_tokens)
+            need_total = self._pages_needed(len(req.prompt_tokens) + 1)
+            need_new = max(0, need_total - len(cached_pages))
+            # Admit only if the new pages fit and leave the watermark.
+            if self.allocator.free_blocks - need_new < \
+                    self.config.watermark * usable:
+                if cached_pages:
+                    self.allocator.release(cached_pages)
+                break
             self.waiting.pop(0)
-            req.pages = self.allocator.allocate(need)
+            req.pages = list(cached_pages) + self.allocator.allocate(need_new)
+            # Cached prefix skips prefill compute, but at least the last
+            # prompt token is always recomputed so admission yields logits.
+            req.prefilled = min(cached_tokens, len(req.prompt_tokens) - 1)
             req.slot = slot
             self._slots[slot] = req
             req.state = RequestState.PREFILL

@@ -1,0 +1,89 @@
+"""ManagedBlockSource: the engine's page supplier, backed by the KVBM.
+
+Duck-types the scheduler's allocator interface (BlockAllocator) while
+adding what the tiered manager enables:
+
+- `match(prompt_tokens)` → (cached_tokens, pinned_device_pages): chained-
+  hash prefix lookup across ALL tiers, onboarding G2/G3 blocks into HBM —
+  the engine skips prefill for every matched token;
+- `register_block(page, hash)` → publishes completed blocks for reuse;
+- eviction → REMOVED KV events (router index stays truthful) + offload
+  down-tier.
+
+This is where the reference's engine-internal prefix cache (vLLM's) and
+Dynamo's KVBM meet in one component — ours owns both sides.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from dynamo_tpu.llm.block_manager.manager import KvBlockManager, TieredConfig
+from dynamo_tpu.tokens import compute_block_hashes
+
+logger = logging.getLogger(__name__)
+
+
+class ManagedBlockSource:
+    def __init__(
+        self,
+        config: TieredConfig,
+        extract_fn=None,
+        inject_fn=None,
+        on_removed: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        """`on_removed(block_hash)` fires when a block leaves the device
+        tier (the engine turns it into a REMOVED KV event)."""
+        self._on_removed = on_removed
+        self.manager = KvBlockManager(config, extract_fn=extract_fn,
+                                      inject_fn=inject_fn)
+        # Chain the eviction hooks: offload first (manager's), then event.
+        inner_evict = self.manager.device.on_evict
+
+        def on_evict(block_hash: int, slot: int) -> None:
+            if inner_evict:
+                inner_evict(block_hash, slot)
+            if self._on_removed:
+                self._on_removed(block_hash)
+
+        self.manager.device.on_evict = on_evict
+        self.block_size = config.block_size
+
+    # -- scheduler allocator interface ------------------------------------
+
+    @property
+    def num_blocks(self) -> int:
+        return self.manager.device.capacity
+
+    @property
+    def free_blocks(self) -> int:
+        # Inactive registered blocks are evictable → allocatable.
+        return self.manager.device.reusable_slots
+
+    @property
+    def usage(self) -> float:
+        return self.manager.device.usage
+
+    def match(self, prompt_tokens: Sequence[int]) -> Tuple[int, List[int]]:
+        # Only fully-sealed prompt blocks participate in reuse.
+        n_sealed = len(prompt_tokens) // self.block_size
+        if n_sealed == 0:
+            return 0, []
+        hashes = compute_block_hashes(prompt_tokens[: n_sealed * self.block_size],
+                                      self.block_size)
+        n, pages = self.manager.match_and_onboard(hashes)
+        return n * self.block_size, pages
+
+    def allocate(self, n: int) -> List[int]:
+        return self.manager.allocate(n)
+
+    def release(self, pages: Sequence[int]) -> None:
+        self.manager.release(pages)
+
+    def register_block(self, page: int, block_hash: int) -> None:
+        self.manager.register(page, block_hash)
+
+    @property
+    def stats(self):
+        return self.manager.stats
