@@ -88,6 +88,11 @@ class EngineConfig:
     mesh: Optional[object] = None          # jax.sharding.Mesh for tp/ep
     seed: int = 0
     enable_kv_events: bool = True
+    # Prefix cache / tiered KVBM (G1 device always; G2 host / G3 disk when
+    # sized > 0).  Off → plain free-list allocator, no reuse.
+    enable_prefix_cache: bool = True
+    host_blocks: int = 0
+    disk_blocks: int = 0
 
 
 class EngineCore:
@@ -107,8 +112,6 @@ class EngineCore:
             cfg, num_blocks=config.num_blocks, block_size=self.block_size,
             dtype=config.cache_dtype,
         )
-        self.allocator = BlockAllocator(config.num_blocks)
-        self.scheduler = Scheduler(sched_cfg, self.allocator)
         self.mesh = config.mesh
 
         if params is None:
@@ -124,6 +127,35 @@ class EngineCore:
             cache = kvc.init_cache(self.cache_cfg)
         self.params = params
         self.cache = cache
+
+        # Block source: tiered, prefix-caching KVBM by default (ADVICE r1:
+        # it must actually be wired, not just exist); plain free list when
+        # prefix caching is off.  The managed source owns residency truth,
+        # so REMOVED events come from its eviction hook rather than from
+        # request finish.
+        self._managed_cache = config.enable_prefix_cache
+        if config.enable_prefix_cache:
+            from dynamo_tpu.llm.block_manager.engine_source import (
+                ManagedBlockSource,
+            )
+            from dynamo_tpu.llm.block_manager.manager import TieredConfig
+
+            self._extract_jit, self._inject_jit = kvc.make_block_ops(
+                self.block_size)
+            self.allocator = ManagedBlockSource(
+                TieredConfig(
+                    device_blocks=config.num_blocks,
+                    host_blocks=config.host_blocks,
+                    disk_blocks=config.disk_blocks,
+                    block_size=self.block_size,
+                ),
+                extract_fn=self._extract_block,
+                inject_fn=self._inject_block,
+                on_removed=self._on_block_evicted,
+            )
+        else:
+            self.allocator = BlockAllocator(config.num_blocks)
+        self.scheduler = Scheduler(sched_cfg, self.allocator)
 
         self._table_width = sched_cfg.max_pages_per_seq + 1  # last col null
         self._pad_position = sched_cfg.max_pages_per_seq * self.block_size
@@ -250,7 +282,6 @@ class EngineCore:
     def _run_decode(self, work: DecodeWork) -> List[TokenDelta]:
         reqs = work.requests
         bucket = work.bucket
-        n = len(reqs)
 
         tokens = np.zeros((bucket, 1), np.int32)
         positions = np.full((bucket, 1), self._pad_position, np.int32)
@@ -258,17 +289,20 @@ class EngineCore:
         bts = np.zeros((bucket, self._table_width), np.int32)
 
         live: List[Request] = []
-        for i, req in enumerate(reqs):
-            # The token being fed is the last sampled one; its KV lands at
-            # position context_len and seq becomes context_len + 1.
-            pos = req.context_len
-            if not self.scheduler.ensure_capacity(req, pos + 1):
-                self._finish(req, FinishReason.LENGTH)
+        for req in reqs:
+            # The token being fed is the last sampled one — its KV has NOT
+            # been written yet.  It lands at position context_len - 1 and
+            # the valid context becomes context_len (ADVICE r1: feeding at
+            # context_len shifted every generated token's KV/RoPE by one).
+            ctx = req.context_len
+            if not self.scheduler.ensure_capacity(req, ctx):
+                self._preempt_or_finish(req)
                 continue
+            i = len(live)  # compact rows: only live requests hit the device
             tokens[i, 0] = (req.output_tokens[-1] if req.output_tokens
                             else req.prompt_tokens[-1])
-            positions[i, 0] = pos
-            seq_lens[i] = pos + 1
+            positions[i, 0] = ctx - 1
+            seq_lens[i] = ctx
             bts[i, : len(req.pages)] = req.pages
             live.append(req)
 
@@ -280,17 +314,38 @@ class EngineCore:
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(seq_lens), jnp.asarray(bts))
 
-        sampled = self._sample_rows(logits[: len(reqs), -1], reqs)
+        sampled = self._sample_rows(logits[: len(live), -1], live)
         deltas = []
-        for i, req in enumerate(reqs):
-            if req not in live:
-                continue
+        for i, req in enumerate(live):
             # Publish blocks sealed by *previous* tokens before appending:
             # if this token finishes the request, its state is dropped and a
             # late publish would re-emit the whole sequence from scratch.
             self._publish_completed_blocks(req)
             deltas.append(self._append_token(req, int(sampled[i])))
         return deltas
+
+    def _preempt_or_finish(self, req: Request) -> None:
+        """KV blocks exhausted mid-decode.  Preempt-and-recompute when other
+        requests hold pages (they will free some); a lone request that OOMs
+        would just thrash, so it finishes with LENGTH (the reference engines'
+        preemption semantics, vLLM-style recompute)."""
+        total_need = self.scheduler._pages_needed(req.total_len + 1)
+        if (len(self.scheduler.running) <= 1
+                or total_need > self.allocator.num_blocks - 1):
+            self._finish(req, FinishReason.LENGTH)
+            return
+        logger.info("preempting %s: out of KV blocks", req.request_id)
+        if not self._managed_cache:
+            # Plain allocator: the pages really are gone; re-publish on the
+            # recompute pass.  (Managed source keeps sealed blocks resident
+            # as inactive entries — its eviction hook reports removals.)
+            self._publish_removed_blocks(req)
+        # Reset seal tracking either way: publication must follow the
+        # *recomputed* KV, never the pre-preemption block list (a stale list
+        # would register pages whose KV hasn't been rewritten yet).
+        self._hash_seqs.pop(req.request_id, None)
+        self._published_blocks.pop(req.request_id, None)
+        self.scheduler.preempt(req)
 
     def _sample_rows(self, logits: jax.Array, reqs: List[Request]) -> np.ndarray:
         n = logits.shape[0]
@@ -301,12 +356,14 @@ class EngineCore:
         top_p = np.asarray([r.sampling.top_p for r in reqs[:n]]
                            + [1.0] * (n - len(reqs)), np.float32)
         # Per-row keys: a seeded request's stream depends only on
-        # (seed, token index) — reproducible regardless of batch mix.
+        # (seed, token index) — reproducible regardless of batch mix and
+        # across preemption (prior_output keeps the index monotonic).
         keys = []
         for r in reqs[:n]:
             if r.sampling.seed is not None:
                 keys.append(jax.random.fold_in(
-                    jax.random.key(r.sampling.seed), len(r.output_tokens)))
+                    jax.random.key(r.sampling.seed),
+                    r.prior_output + len(r.output_tokens)))
             else:
                 self._rng, k = jax.random.split(self._rng)
                 keys.append(k)
@@ -320,7 +377,8 @@ class EngineCore:
             req.first_token_ts = time.monotonic()
         req.output_tokens.append(token)
         stop = token in req.sampling.stop_token_ids
-        length = len(req.output_tokens) >= req.sampling.max_tokens
+        length = (req.prior_output + len(req.output_tokens)
+                  >= req.sampling.max_tokens)
         if stop or length:
             self._finish(req, FinishReason.STOP if stop else FinishReason.LENGTH)
             delta = TokenDelta(req.request_id, [token], finished=True,
@@ -330,7 +388,10 @@ class EngineCore:
         return TokenDelta(req.request_id, [token])
 
     def _finish(self, req: Request, reason: FinishReason) -> None:
-        self._publish_removed_blocks(req)
+        # With the managed source, sealed blocks stay resident (inactive,
+        # matchable) after finish — REMOVED comes from its eviction hook.
+        if not self._managed_cache:
+            self._publish_removed_blocks(req)
         self.scheduler.finish(req, reason)
 
     def _drop(self, req: Request) -> None:
@@ -338,12 +399,29 @@ class EngineCore:
         self._hash_seqs.pop(req.request_id, None)
         self._published_blocks.pop(req.request_id, None)
 
-    # -- KV events --------------------------------------------------------
+    # -- block registration + KV events ------------------------------------
+
+    def _extract_block(self, page: int) -> np.ndarray:
+        """Device block → host array [2, L, bs, Hkv, D] (offload/transfer)."""
+        return np.asarray(self._extract_jit(self.cache, jnp.int32(page)))
+
+    def _inject_block(self, page: int, data: np.ndarray) -> None:
+        """Host array → device block (onboard/transfer-in)."""
+        self.cache = self._inject_jit(self.cache, jnp.int32(page),
+                                      jnp.asarray(data))
+
+    def _on_block_evicted(self, block_hash: int) -> None:
+        """Managed source evicted a block from G1 → router must forget it."""
+        if self._kv_event_sink and self.config.enable_kv_events:
+            self._emit(KvCacheEventData.removed([block_hash]))
 
     def _publish_completed_blocks(self, req: Request) -> None:
-        """Emit STORED events for pages newly filled by this request."""
-        if not self._kv_event_sink or not self.config.enable_kv_events:
-            return
+        """Seal pages newly completed by this request: register them with
+        the block source (future prefix hits) and emit STORED events."""
+        events_on = (self._kv_event_sink is not None
+                     and self.config.enable_kv_events)
+        if not self._managed_cache and not events_on:
+            return  # nobody consumes seals: skip the per-step hashing
         if req.request_id not in self._requests:
             return  # already finished and dropped
         seq = self._hash_seqs.get(req.request_id)
@@ -357,9 +435,13 @@ class EngineCore:
         if len(complete) <= done:
             return
         new = complete[done:]
-        parent = complete[done - 1].block_hash if done else None
-        self._emit(KvCacheEventData.stored(
-            [b.block_hash for b in new], parent_hash=parent))
+        for bi, blk in enumerate(new, start=done):
+            if bi < len(req.pages):
+                self.allocator.register_block(req.pages[bi], blk.block_hash)
+        if events_on:
+            parent = complete[done - 1].block_hash if done else None
+            self._emit(KvCacheEventData.stored(
+                [b.block_hash for b in new], parent_hash=parent))
         self._published_blocks[req.request_id] = len(complete)
 
     def _publish_removed_blocks(self, req: Request) -> None:

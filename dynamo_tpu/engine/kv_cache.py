@@ -134,3 +134,36 @@ def gather_kv(
     k = jnp.take(cache_layer_k, slots, axis=0, mode="clip")
     v = jnp.take(cache_layer_v, slots, axis=0, mode="clip")
     return k, v
+
+
+def make_block_ops(block_size: int):
+    """Jitted whole-block extract/inject against the cache pytree.
+
+    These are the device ends of every tier/wire movement — G1→G2 offload,
+    G2/G3→G1 onboard, and the cross-worker transfer data plane (the role of
+    the reference's `block_copy.cu` scatter/gather kernel,
+    `lib/llm/src/kernels/block_copy.cu:41`).  The page id is traced so one
+    compiled program serves every page.
+
+    Returns (extract, inject):
+      extract(cache, page) -> [2, L, block_size, Hkv, D] (K stacked on V)
+      inject(cache, page, data) -> cache' (donated, in-place on device)
+    """
+
+    def extract(cache: dict, page: jax.Array) -> jax.Array:
+        start = page * block_size
+        k = jax.lax.dynamic_slice_in_dim(cache["k"], start, block_size, axis=1)
+        v = jax.lax.dynamic_slice_in_dim(cache["v"], start, block_size, axis=1)
+        return jnp.stack([k, v])
+
+    def inject(cache: dict, page: jax.Array, data: jax.Array) -> dict:
+        start = page * block_size
+        data = data.astype(cache["k"].dtype)
+        return {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], data[0], start, axis=1),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], data[1], start, axis=1),
+        }
+
+    return jax.jit(extract), jax.jit(inject, donate_argnums=(0,))

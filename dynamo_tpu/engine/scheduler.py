@@ -60,6 +60,12 @@ class Request:
     finish_reason: Optional[FinishReason] = None
     arrival_ts: float = field(default_factory=time.monotonic)
     first_token_ts: Optional[float] = None
+    # Tokens emitted before a preemption folded them into the prompt —
+    # keeps max_tokens budgeting and seeded-RNG indices monotonic.
+    prior_output: int = 0
+    # Memoized chained prompt-block hashes (admission retries must not
+    # re-hash a long prompt every engine step); None = not yet computed.
+    block_hashes: Optional[tuple] = None
 
     @property
     def total_len(self) -> int:
@@ -67,7 +73,9 @@ class Request:
 
     @property
     def context_len(self) -> int:
-        """Tokens whose KV is in cache."""
+        """Prompt+output tokens the model has consumed.  All but the newest
+        sampled token have KV in cache; the newest one's KV is written by
+        the decode step that feeds it (at position context_len - 1)."""
         return self.prefilled + len(self.output_tokens)
 
 
@@ -88,7 +96,10 @@ class BlockAllocator:
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
 
     # Prefix-cache interface (no-ops here).
-    def match(self, prompt_tokens: Sequence[int]):
+    def prompt_hashes(self, prompt_tokens: Sequence[int]) -> tuple:
+        return ()
+
+    def match(self, prompt_tokens: Sequence[int], hashes=None):
         """Returns (cached_tokens, pinned_pages)."""
         return 0, []
 
@@ -215,8 +226,11 @@ class Scheduler:
                 break
             # Prefix-cache match first: cached pages are reused (pinned),
             # only the remainder needs fresh allocation.
+            if req.block_hashes is None:
+                req.block_hashes = self.allocator.prompt_hashes(
+                    req.prompt_tokens)
             cached_tokens, cached_pages = self.allocator.match(
-                req.prompt_tokens)
+                req.prompt_tokens, req.block_hashes)
             need_total = self._pages_needed(len(req.prompt_tokens) + 1)
             need_new = max(0, need_total - len(cached_pages))
             # Admit only if the new pages fit and leave the watermark.
@@ -224,6 +238,12 @@ class Scheduler:
                     self.config.watermark * usable:
                 if cached_pages:
                     self.allocator.release(cached_pages)
+                # Nothing running means nothing will ever free pages — the
+                # head request can never fit; fail it instead of spinning.
+                if not self.running:
+                    self.waiting.pop(0)
+                    req.state = RequestState.FINISHED
+                    req.finish_reason = FinishReason.LENGTH
                 break
             self.waiting.pop(0)
             req.pages = list(cached_pages) + self.allocator.allocate(need_new)
@@ -286,6 +306,30 @@ class Scheduler:
             ))
             budget -= chunk
         return StepPlan(prefills=prefills, decode=decode)
+
+    # -- preemption -------------------------------------------------------
+
+    def preempt(self, req: Request) -> None:
+        """Release the request's pages and requeue it (front of line) for
+        recompute.  Generated tokens fold into the prompt: the recompute
+        prefill rebuilds their KV, and completion of that prefill samples
+        the next token exactly as if decode had continued.  (vLLM-style
+        recompute preemption; the reference delegates this to its engines.)"""
+        if req in self.running:
+            self.running.remove(req)
+        if req.slot is not None:
+            self._slots[req.slot] = None
+            req.slot = None
+        if req.pages:
+            self.allocator.release(req.pages)
+            req.pages = []
+        req.prior_output += len(req.output_tokens)
+        req.prompt_tokens = req.prompt_tokens + req.output_tokens
+        req.output_tokens = []
+        req.prefilled = 0
+        req.block_hashes = None  # prompt changed: re-hash on re-admission
+        req.state = RequestState.WAITING
+        self.waiting.insert(0, req)
 
     # -- completion callbacks --------------------------------------------
 

@@ -65,13 +65,23 @@ class ManagedBlockSource:
     def usage(self) -> float:
         return self.manager.device.usage
 
-    def match(self, prompt_tokens: Sequence[int]) -> Tuple[int, List[int]]:
-        # Only fully-sealed prompt blocks participate in reuse.
+    def prompt_hashes(self, prompt_tokens: Sequence[int]) -> Tuple[int, ...]:
+        """Chained hashes of the sealed prompt blocks — computed once per
+        request by the scheduler and passed back into match() on every
+        admission retry (hashing a long prompt per engine step is waste)."""
         n_sealed = len(prompt_tokens) // self.block_size
         if n_sealed == 0:
+            return ()
+        return tuple(compute_block_hashes(
+            prompt_tokens[: n_sealed * self.block_size], self.block_size))
+
+    def match(self, prompt_tokens: Sequence[int],
+              hashes: Optional[Sequence[int]] = None) -> Tuple[int, List[int]]:
+        # Only fully-sealed prompt blocks participate in reuse.
+        if hashes is None:
+            hashes = self.prompt_hashes(prompt_tokens)
+        if not hashes:
             return 0, []
-        hashes = compute_block_hashes(prompt_tokens[: n_sealed * self.block_size],
-                                      self.block_size)
         n, pages = self.manager.match_and_onboard(hashes)
         return n * self.block_size, pages
 

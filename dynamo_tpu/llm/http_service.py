@@ -127,6 +127,9 @@ class HttpService:
             pre = handle.preprocessor.preprocess_completion(body, rid)
         except ValueError as e:
             return self._error(400, str(e))
+        if body.stream:
+            return await self._stream_completion(request, handle, body, pre,
+                                                 rid)
 
         start = time.monotonic()
         self.metrics.requests_total.inc(labels={"model": body.model})
@@ -153,6 +156,23 @@ class HttpService:
                 completion_tokens=det.completion_tokens,
                 total_tokens=len(pre.token_ids) + det.completion_tokens))
         return web.json_response(resp.model_dump(exclude_none=True))
+
+    async def _stream_completion(self, request, handle, body, pre, rid):
+        """SSE stream of `text_completion` chunks (ADVICE r1: the unary-only
+        handler broke OpenAI streaming clients)."""
+
+        def make_chunk(out):
+            return oai.CompletionResponse(
+                id=rid, model=body.model,
+                choices=[oai.CompletionChoice(
+                    text=out.text or "", finish_reason=out.finish_reason)])
+
+        def make_usage_chunk(usage):
+            return oai.CompletionResponse(
+                id=rid, model=body.model, choices=[], usage=usage)
+
+        return await self._stream_sse(request, handle, body, pre, rid,
+                                      make_chunk, make_usage_chunk)
 
     # -- chat serving internals -------------------------------------------
 
@@ -212,6 +232,31 @@ class HttpService:
         return web.json_response(resp.model_dump(exclude_none=True))
 
     async def _stream_chat(self, request, handle, body, pre, rid):
+        def make_chunk(out):
+            return oai.ChatCompletionChunk(
+                id=rid, model=body.model,
+                choices=[oai.ChatStreamChoice(
+                    delta=oai.ChatChoiceDelta(content=out.text or None),
+                    finish_reason=out.finish_reason)])
+
+        def make_usage_chunk(usage):
+            return oai.ChatCompletionChunk(
+                id=rid, model=body.model, choices=[], usage=usage)
+
+        # Leading chunk with the assistant role (OpenAI convention).
+        head = oai.ChatCompletionChunk(
+            id=rid, model=body.model,
+            choices=[oai.ChatStreamChoice(
+                delta=oai.ChatChoiceDelta(role="assistant", content=""))])
+        return await self._stream_sse(request, handle, body, pre, rid,
+                                      make_chunk, make_usage_chunk,
+                                      head_chunk=head)
+
+    async def _stream_sse(self, request, handle, body, pre, rid,
+                          make_chunk, make_usage_chunk, head_chunk=None):
+        """Shared SSE scaffolding for chat + text completion streams:
+        metrics, disconnect-cancel, optional stream_options.include_usage
+        final chunk, and the [DONE] sentinel."""
         start = time.monotonic()
         self.metrics.requests_total.inc(labels={"model": body.model})
         self.metrics.requests_in_flight.add(1, labels={"model": body.model})
@@ -221,23 +266,22 @@ class HttpService:
         await response.prepare(request)
 
         det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
-        # Leading chunk with the assistant role (OpenAI convention).
-        head = oai.ChatCompletionChunk(
-            id=rid, model=body.model,
-            choices=[oai.ChatStreamChoice(
-                delta=oai.ChatChoiceDelta(role="assistant", content=""))])
-        await response.write(oai.sse_encode(head).encode())
         try:
+            if head_chunk is not None:
+                await response.write(oai.sse_encode(head_chunk).encode())
             async for out in self._token_stream(handle, pre, det,
                                                 body.model, start):
-                chunk = oai.ChatCompletionChunk(
-                    id=rid, model=body.model,
-                    choices=[oai.ChatStreamChoice(
-                        delta=oai.ChatChoiceDelta(content=out.text or None),
-                        finish_reason=out.finish_reason)])
-                await response.write(oai.sse_encode(chunk).encode())
+                await response.write(oai.sse_encode(make_chunk(out)).encode())
                 if out.finished:
                     break
+            if (body.stream_options or {}).get("include_usage"):
+                n_in = len(pre.token_ids)
+                usage = oai.Usage(
+                    prompt_tokens=n_in,
+                    completion_tokens=det.completion_tokens,
+                    total_tokens=n_in + det.completion_tokens)
+                await response.write(
+                    oai.sse_encode(make_usage_chunk(usage)).encode())
             await response.write(oai.SSE_DONE.encode())
         except (ConnectionResetError, asyncio.CancelledError):
             # Client went away: closing the generator cancels the engine

@@ -169,12 +169,16 @@ class CompletionChoice(BaseModel):
 
 
 class CompletionResponse(BaseModel):
+    """Doubles as the SSE chunk type when streaming (same `text_completion`
+    object tag, OpenAI convention); chunks leave `usage` unset so clients
+    never read zeroed counts mid-stream."""
+
     id: str
     object: Literal["text_completion"] = "text_completion"
     created: int = Field(default_factory=now_ts)
     model: str
     choices: List[CompletionChoice]
-    usage: Usage = Field(default_factory=Usage)
+    usage: Optional[Usage] = None
 
 
 # ---------------------------------------------------------------------------

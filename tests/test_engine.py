@@ -164,12 +164,15 @@ def test_stop_token_finishes_early():
 
 
 def test_kv_events_emitted_with_chained_hashes():
+    """Plain-allocator event contract: STORED on seal, REMOVED on finish
+    (no residency after release).  Managed-cache semantics are tested in
+    test_prefix_cache_* below."""
     from dynamo_tpu.tokens import compute_block_hashes
 
     events = []
     core = EngineCore(
         EngineConfig(
-            model=TINY, num_blocks=64,
+            model=TINY, num_blocks=64, enable_prefix_cache=False,
             scheduler=SchedulerConfig(
                 max_seqs=4, block_size=8, max_pages_per_seq=8,
                 max_prefill_chunk=16,
@@ -252,6 +255,130 @@ def test_async_engine_concurrent_requests():
 
     a, b, c = asyncio.run(main())
     assert len(a) == len(b) == len(c) == 4
+
+
+def test_engine_matches_single_forward_contract():
+    """Engine greedy decode must equal re-prefilling the whole sequence from
+    scratch each step (locks the decode position contract; ADVICE r1 found a
+    +1 shift here that batching-invariance tests could not see)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine import kv_cache as kvc
+    from dynamo_tpu.models.llama import init_params, make_forward_step
+
+    prompt = [5, 6, 7, 8, 9]
+    n_out = 6
+
+    core = small_engine()
+    core.add_request("r1", prompt, SamplingParams(max_tokens=n_out))
+    outputs, _ = run_to_completion(core)
+    engine_out = outputs["r1"]
+
+    # Ground truth: full fresh prefill of (prompt + generated-so-far) each
+    # step; argmax of the last position's logits.
+    cfg = TINY
+    params = init_params(cfg, jax.random.key(0))
+    step = jax.jit(make_forward_step(cfg, 8))
+    ref_out = []
+    toks = list(prompt)
+    for _ in range(n_out):
+        L = len(toks)
+        pages = (L + 7) // 8
+        cache = kvc.init_cache(
+            kvc.KvCacheConfig.for_model(cfg, num_blocks=16, block_size=8))
+        logits, _ = step(
+            params, cache,
+            jnp.asarray([toks], jnp.int32),
+            jnp.arange(L, dtype=jnp.int32)[None, :],
+            jnp.asarray([L], jnp.int32),
+            jnp.asarray([list(range(1, pages + 1)) + [0] * (16 - pages)],
+                        jnp.int32),
+        )
+        nxt = int(jnp.argmax(logits[0, L - 1]))
+        ref_out.append(nxt)
+        toks.append(nxt)
+
+    assert engine_out == ref_out
+
+
+def test_preemption_invisible_to_greedy_output():
+    """Under block contention one request is preempted (recompute) — its
+    final output must match an uncontended run exactly."""
+    prompts = {"a": [1, 2, 3, 4, 5, 6, 7, 8], "b": [9, 10, 11, 12, 13, 14]}
+    n_out = 30
+
+    solo = {}
+    for rid, p in prompts.items():
+        core = small_engine(num_blocks=64)
+        core.add_request(rid, p, SamplingParams(max_tokens=n_out))
+        out, _ = run_to_completion(core)
+        solo[rid] = out[rid]
+
+    # 9 blocks → 8 usable pages of 8 tokens; two requests growing to
+    # ~38 tokens each (5 pages) must collide and preempt.
+    core = small_engine(num_blocks=9)
+    for rid, p in prompts.items():
+        core.add_request(rid, p, SamplingParams(max_tokens=n_out))
+    batched, finished = run_to_completion(core, max_steps=2000)
+
+    assert batched == solo
+    assert all(r is FinishReason.LENGTH for r in finished.values())
+
+
+def test_prefix_cache_hit_skips_prefill_and_matches():
+    """Second identical prompt must hit G1 prefix blocks (live wiring of the
+    managed block source — ADVICE r1 found it dead) and produce identical
+    output."""
+    prompt = list(range(1, 25))  # 3 sealed blocks of 8
+
+    core = small_engine()
+    core.add_request("a", prompt, SamplingParams(max_tokens=4))
+    out_a, _ = run_to_completion(core)
+    hits_before = core.allocator.manager.device.hits
+
+    core.add_request("b", prompt, SamplingParams(max_tokens=4))
+    out_b, _ = run_to_completion(core)
+    assert core.allocator.manager.device.hits > hits_before
+    assert out_b["b"] == out_a["a"]
+    # The cached-prefix request recomputed only the last prompt token.
+
+
+def test_managed_eviction_emits_removed_and_offloads():
+    """Filling the pool evicts an earlier request's registered blocks →
+    REMOVED KV events fire from the eviction hook, and with a G2 tier the
+    block survives and onboards back on a later match."""
+    events = []
+    core = EngineCore(
+        EngineConfig(
+            model=TINY, num_blocks=9, host_blocks=16,
+            scheduler=SchedulerConfig(
+                max_seqs=4, block_size=8, max_pages_per_seq=8,
+                max_prefill_chunk=16,
+                decode_buckets=(1, 2, 4), prefill_buckets=(8, 16)),
+        ),
+        kv_event_sink=events.append,
+    )
+    prompt_a = list(range(1, 17))  # 2 sealed blocks
+    core.add_request("a", prompt_a, SamplingParams(max_tokens=2))
+    out_a1, _ = run_to_completion(core)
+
+    # Churn through enough distinct blocks to evict a's.
+    for i in range(3):
+        core.add_request(f"c{i}", [100 + 8 * i + j for j in range(16)],
+                         SamplingParams(max_tokens=2))
+        run_to_completion(core)
+
+    removed = [h for e in events if e.data.remove is not None
+               for h in e.data.remove.block_hashes]
+    assert removed, "eviction must emit REMOVED events"
+    assert core.allocator.manager.offloaded_blocks > 0
+
+    # Re-running prompt_a onboards from G2 (hash-correct KV) and matches.
+    onboarded_before = core.allocator.manager.onboarded_blocks
+    core.add_request("a2", prompt_a, SamplingParams(max_tokens=2))
+    out_a2, _ = run_to_completion(core)
+    assert out_a2["a2"] == out_a1["a"]
+    assert core.allocator.manager.onboarded_blocks > onboarded_before
 
 
 def test_seeded_sampling_reproducible_across_batch_mix():
