@@ -1,16 +1,28 @@
 """Headline bench: steady-state decode throughput on the real TPU chip.
 
-Measures tokens/sec of the paged-cache decode step for the flagship
-single-chip model (Llama-3-1B geometry, bf16, batch 64, 512-token
-contexts) — the TPU analog of the reference's decode profiling row
+Measures the flagship single-chip model (Llama-3-1B geometry, bf16) at the
+ENGINE'S SERVING GEOMETRY — `max_pages_per_seq=128` (8k context ceiling)
+with context-length-bucketed block tables, i.e. the tables the engine
+actually dispatches at ctx 512 are 16 pages wide (r1's bench silently used
+9-page tables while the engine served 129-wide ones; the bucketing fix in
+engine/scheduler.py makes the serving path and this bench the same
+geometry).  The TPU analog of the reference's decode profiling row
 (`docs/architecture/pre_deployment_profiling.md:38` — 51.22 tok/s/GPU,
-ITL 4.83 ms, Llama-70B TP=4 on H100-class).  `vs_baseline` is the ratio
-of our per-chip tok/s to that reference number; the models differ in size
-(1B on one 16GB v5e chip vs 70B over 4 H100s), so treat it as a tracking
-number, not an apples-to-apples comparison — the honest cross-check
-arrives with the multi-chip 70B config (BASELINE.md ladder #3).
+ITL 4.83 ms, Llama-70B TP=4 on H100-class).  `vs_baseline` is the ratio of
+our per-chip tok/s to that number; model sizes differ (1B on one 16GB v5e
+chip vs 70B over 4 H100s) so treat it as a tracking number — the honest
+cross-check arrives with the multi-chip 70B config (BASELINE.md ladder #3;
+Llama-3-8B bf16 at ~16 GB exceeds one v5e chip's HBM, so ladder #1 needs
+tp>=2 hardware).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Reports, in ONE JSON line:
+- value:        raw-step decode tok/s/chip (batch 64, ctx 512, width 16)
+- mfu:          model FLOPs utilisation of that loop (bf16 peak)
+- serving_tok_s: tok/s through the FULL EngineCore path (scheduler, page
+                 growth, on-device sampling, host loop) — the number a
+                 worker actually delivers
+- prefill_tok_s: batched-prefill throughput, 8 prompts x 512 tokens in one
+                 dispatch per chunk bucket
 """
 
 import json
@@ -21,7 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine import kv_cache as kvc
-from dynamo_tpu.engine.sampling import greedy
+from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+from dynamo_tpu.engine.sampling import SamplingParams, greedy
+from dynamo_tpu.engine.scheduler import SchedulerConfig
 from dynamo_tpu.models import config as mcfg
 from dynamo_tpu.models.llama import init_params, make_forward_step
 
@@ -30,34 +44,54 @@ REFERENCE_DECODE_TOK_S_PER_DEVICE = 51.22  # pre_deployment_profiling.md:38
 BATCH = 64
 CTX = 512
 BLOCK = 64
+MAX_PAGES = 128            # serving geometry: 8k-token context ceiling
 DECODE_STEPS = 64
 WARMUP = 8
 
 
-def main():
-    cfg = mcfg.get_config("llama-3-1b")
-    pages = CTX // BLOCK + 1
-    num_blocks = 1 + BATCH * pages
+def _bf16_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    return 197e12  # conservative default
+
+
+def _flops_per_token(cfg, params, ctx: int) -> float:
+    """2 x weight-params matmul FLOPs + attention score/value FLOPs."""
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    attn = cfg.num_layers * 4 * cfg.num_heads * cfg.head_dim * ctx
+    return 2.0 * n_params + attn
+
+
+def bench_raw_step(cfg, params, use_pallas_decode=False):
+    """Steady-state decode loop at the width the engine dispatches for
+    ctx-512 sequences under serving geometry (page bucket 16 of 128)."""
+    width = 16  # bucket_for_pages(ceil(576/64)=9) -> 16
+    num_blocks = 1 + BATCH * width
     cache = kvc.init_cache(kvc.KvCacheConfig.for_model(
         cfg, num_blocks=num_blocks, block_size=BLOCK))
-    params = init_params(cfg, jax.random.key(0))
-    step = jax.jit(make_forward_step(cfg, BLOCK), donate_argnums=(1,))
+    step = jax.jit(
+        make_forward_step(cfg, BLOCK, use_pallas_decode=use_pallas_decode),
+        donate_argnums=(1,))
 
-    bt = np.zeros((BATCH, pages), np.int32)
+    bt = np.zeros((BATCH, width), np.int32)
     for i in range(BATCH):
-        bt[i] = np.arange(1 + i * pages, 1 + (i + 1) * pages)
+        bt[i] = np.arange(1 + i * width, 1 + (i + 1) * width)
     bt = jnp.asarray(bt)
-
-    # Throughput measurement doesn't need semantically meaningful cache
-    # contents: block tables and seq_lens drive the exact same gathers and
-    # FLOPs as a real 512-token context.
     tokens = jnp.ones((BATCH, 1), jnp.int32)
+
+    sample_pos = jnp.zeros((BATCH,), jnp.int32)
 
     def decode_step(cache, tokens, t):
         positions = jnp.full((BATCH, 1), t, jnp.int32)
         seq_lens = jnp.full((BATCH,), t + 1, jnp.int32)
-        logits, cache = step(params, cache, tokens, positions, seq_lens, bt)
-        return cache, greedy(logits[:, -1])[:, None]
+        logits, cache = step(params, cache, tokens, positions, seq_lens, bt,
+                             sample_pos)
+        return cache, greedy(logits)[:, None]
 
     t0 = time.perf_counter()
     for i in range(WARMUP):
@@ -70,17 +104,77 @@ def main():
         cache, tokens = decode_step(cache, tokens, CTX + WARMUP + i)
     tokens.block_until_ready()
     elapsed = time.perf_counter() - t0
+    return BATCH * DECODE_STEPS / elapsed, elapsed / DECODE_STEPS, compile_s
 
-    tok_per_s = BATCH * DECODE_STEPS / elapsed
-    itl_ms = 1000.0 * elapsed / DECODE_STEPS
+
+def bench_serving_path(cfg, params):
+    """Tok/s through the full EngineCore: admission, batched prefill,
+    page growth, bucketed decode, on-device sampling, host loop."""
+    core = EngineCore(
+        EngineConfig(
+            model=cfg,
+            num_blocks=1 + BATCH * (MAX_PAGES // 8),
+            enable_prefix_cache=False,  # distinct prompts; skip hash cost
+            scheduler=SchedulerConfig(
+                max_seqs=BATCH, block_size=BLOCK,
+                max_pages_per_seq=MAX_PAGES,
+                max_prefill_chunk=512, max_batched_tokens=8192,
+                # 16 = prefill-batch row bucket (8192/512 chunks per step),
+                # 64 = steady-state decode bucket.
+                decode_buckets=(16, 64), prefill_buckets=(512,)),
+        ),
+        params=params,
+    )
+    rng = np.random.default_rng(0)
+    n_out = WARMUP + DECODE_STEPS
+    for i in range(BATCH):
+        prompt = rng.integers(1, cfg.vocab_size, size=CTX).tolist()
+        core.add_request(f"r{i}", prompt, SamplingParams(max_tokens=n_out))
+
+    # Prefill all prompts (batched), then the first decode steps compile.
+    t0 = time.perf_counter()
+    while any(r.state.value in ("waiting", "prefill")
+              for r in core._requests.values()):
+        core.step()
+    prefill_s = time.perf_counter() - t0
+    for _ in range(WARMUP - 1):
+        core.step()
+
+    t0 = time.perf_counter()
+    produced = 0
+    for _ in range(DECODE_STEPS):
+        produced += len(core.step())
+    elapsed = time.perf_counter() - t0
+    serving_tok_s = produced / elapsed
+    prefill_tok_s = BATCH * CTX / prefill_s  # includes prefill compiles
+    return serving_tok_s, prefill_tok_s
+
+
+def main():
+    cfg = mcfg.get_config("llama-3-1b")
+    params = init_params(cfg, jax.random.key(0))
+    dev = jax.devices()[0]
+
+    on_tpu = jax.default_backend() == "tpu"
+    tok_s_xla, _, compile_s = bench_raw_step(cfg, params,
+                                             use_pallas_decode=False)
+    tok_s, step_s, _ = bench_raw_step(cfg, params, use_pallas_decode=on_tpu)
+    mfu = tok_s * _flops_per_token(cfg, params, CTX) / _bf16_peak_flops(dev)
+    serving_tok_s, prefill_tok_s = bench_serving_path(cfg, params)
+
     print(json.dumps({
-        "metric": "decode_throughput_llama1b_b64_ctx512",
-        "value": round(tok_per_s, 2),
+        "metric": "decode_throughput_llama1b_b64_ctx512_serving_geom",
+        "value": round(tok_s, 2),
         "unit": "tok/s/chip",
-        "vs_baseline": round(tok_per_s / REFERENCE_DECODE_TOK_S_PER_DEVICE, 3),
-        "itl_ms": round(itl_ms, 3),
+        "vs_baseline": round(tok_s / REFERENCE_DECODE_TOK_S_PER_DEVICE, 3),
+        "itl_ms": round(1000.0 * step_s, 3),
+        "mfu": round(mfu, 4),
+        "xla_gather_tok_s": round(tok_s_xla, 2),
+        "serving_tok_s": round(serving_tok_s, 2),
+        "prefill_tok_s": round(prefill_tok_s, 2),
+        "max_pages_per_seq": MAX_PAGES,
         "warmup_s": round(compile_s, 1),
-        "device": str(jax.devices()[0]),
+        "device": str(dev),
     }))
 
 

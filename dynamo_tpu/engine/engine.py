@@ -18,10 +18,12 @@ REMOVED events through a pluggable publisher — the same event stream the
 reference's vLLM worker bridges over ZMQ (`kv_router/publisher.rs:222`),
 here born native.
 
-Padding discipline (see scheduler.py): block tables are `max_pages + 1`
-wide with the last column permanently null, and all padding writes target
-position `max_pages * block_size`, which lands in the null block — padded
-lanes can never corrupt live cache pages.
+Padding discipline (see scheduler.py): block tables are sliced to the
+batch's page bucket (context-length bucketing — the gather cost scales
+with actual context, not max_context), unallocated entries are the null
+block 0, and all padding writes target position `max_pages * block_size`,
+which indexes past every runtime table width and resolves to the null
+block — padded lanes can never corrupt live cache pages.
 """
 
 from __future__ import annotations
@@ -39,11 +41,12 @@ import numpy as np
 
 from dynamo_tpu.engine import kv_cache as kvc
 from dynamo_tpu.engine.sampling import SamplingParams, sample
+from dynamo_tpu.engine.sampling import greedy as greedy_sample
 from dynamo_tpu.engine.scheduler import (
     BlockAllocator,
     DecodeWork,
     FinishReason,
-    PrefillWork,
+    PrefillBatch,
     Request,
     RequestState,
     Scheduler,
@@ -93,6 +96,15 @@ class EngineConfig:
     enable_prefix_cache: bool = True
     host_blocks: int = 0
     disk_blocks: int = 0
+    # Pallas paged-decode kernel; None = auto (TPU backend, unsharded —
+    # the sharded step keeps the GSPMD-partitionable gather path).
+    use_pallas_decode: Optional[bool] = None
+    # Fused decode window: K tokens per device dispatch with on-device
+    # token feedback, host syncs lagging `pipeline_depth` windows behind.
+    # 1 disables (single-step host loop).  Eliminates the per-token
+    # host↔device round-trip (SURVEY §7 decode hard part).
+    decode_window: int = 8
+    window_pipeline_depth: int = 2
 
 
 class EngineCore:
@@ -122,9 +134,17 @@ class EngineCore:
             cache = shard_pytree(
                 kvc.init_cache(self.cache_cfg), cache_pspecs(), self.mesh)
         else:
+            pallas = config.use_pallas_decode
+            if pallas is None:
+                pallas = jax.default_backend() == "tpu"
             self._step = jax.jit(
-                make_forward_step(cfg, self.block_size), donate_argnums=(1,))
+                make_forward_step(cfg, self.block_size,
+                                  use_pallas_decode=pallas),
+                donate_argnums=(1,))
+            self._use_pallas = pallas
             cache = kvc.init_cache(self.cache_cfg)
+        self._window_fns: Dict[bool, Callable] = {}
+        self._inflight: List = []  # dispatched-unsynced decode windows
         self.params = params
         self.cache = cache
 
@@ -157,7 +177,9 @@ class EngineCore:
             self.allocator = BlockAllocator(config.num_blocks)
         self.scheduler = Scheduler(sched_cfg, self.allocator)
 
-        self._table_width = sched_cfg.max_pages_per_seq + 1  # last col null
+        # Padding writes target this position; it indexes past every
+        # runtime table width, so slots_for_positions resolves it to the
+        # null block (tables are bucket-sliced — see bucket_for_pages).
         self._pad_position = sched_cfg.max_pages_per_seq * self.block_size
         self._requests: Dict[str, Request] = {}
         self._hash_seqs: Dict[str, TokenBlockSequence] = {}
@@ -207,25 +229,52 @@ class EngineCore:
     # -- stepping ---------------------------------------------------------
 
     def step(self) -> List[TokenDelta]:
-        """Run one engine iteration; returns token deltas (may be empty)."""
+        """Run one engine iteration; returns token deltas (may be empty).
+
+        Steady-state decode (no prefill, no admissions, stable request
+        set) runs through the pipelined window path: dispatch one fused
+        K-token window, sync the window from `window_pipeline_depth`
+        dispatches ago.  Any scheduling change drains the pipeline first
+        so host bookkeeping never diverges from device state."""
         plan = self.scheduler.plan()
         deltas: List[TokenDelta] = []
-        if plan.empty:
-            # Surface requests admission-rejected into FINISHED (too long).
-            self._collect_dead(deltas)
-            return deltas
 
-        for work in plan.prefills:
-            delta = self._run_prefill(work)
-            if delta:
-                deltas.append(delta)
-        if plan.decode:
-            deltas.extend(self._run_decode(plan.decode))
+        window_ok = self._window_eligible(plan)
+        if self._inflight and not (
+                window_ok and self._same_reqs(plan.decode.requests)):
+            deltas.extend(self._drain_inflight())
+            plan = self.scheduler.plan()  # finished reqs changed the plan
+            window_ok = self._window_eligible(plan)
+
+        if window_ok:
+            d = self._dispatch_window(plan.decode)
+            if d is None:
+                # Capacity refused under lookahead: drain, then let the
+                # next iteration take the single-step path (which preempts
+                # properly with non-shadowed state).
+                deltas.extend(self._drain_inflight())
+            else:
+                deltas.extend(d)
+        elif not plan.empty:
+            if plan.prefill:
+                deltas.extend(self._run_prefill_batch(plan.prefill))
+            if plan.decode:
+                deltas.extend(self._run_decode(plan.decode))
 
         self._collect_dead(deltas)
         self.step_count += 1
         self._refresh_metrics()
         return deltas
+
+    def _window_eligible(self, plan) -> bool:
+        return (self.config.decode_window > 1
+                and self.mesh is None
+                and plan.decode is not None
+                and plan.prefill is None
+                and not self.scheduler.waiting)
+
+    def _same_reqs(self, reqs: List[Request]) -> bool:
+        return [r.request_id for r in reqs] == self._inflight[-1]["rids"]
 
     def _collect_dead(self, deltas: List[TokenDelta]) -> None:
         for rid, req in list(self._requests.items()):
@@ -246,38 +295,50 @@ class EngineCore:
 
     # -- internals --------------------------------------------------------
 
-    def _block_table(self, req: Request) -> np.ndarray:
-        bt = np.zeros((self._table_width,), np.int32)
-        bt[: len(req.pages)] = req.pages
-        return bt
+    def _run_prefill_batch(self, batch: PrefillBatch) -> List[TokenDelta]:
+        """One device call for ALL scheduled prefill chunks (ragged rows
+        padded to the chunk bucket; pad rows/tails write to the null block).
+        Completion rows sample their first output token (TTFT)."""
+        R, T, P = batch.rows, batch.chunk, batch.pages
+        tokens = np.zeros((R, T), np.int32)
+        positions = np.full((R, T), self._pad_position, np.int32)
+        seq_lens = np.zeros((R,), np.int32)
+        bts = np.zeros((R, P), np.int32)
 
-    def _run_prefill(self, work: PrefillWork) -> Optional[TokenDelta]:
-        req = work.request
-        bucket = work.bucket
-        tokens = np.zeros((1, bucket), np.int32)
-        positions = np.full((1, bucket), self._pad_position, np.int32)
-        chunk = req.prompt_tokens[work.start: work.start + work.length]
-        tokens[0, : work.length] = chunk
-        positions[0, : work.length] = np.arange(work.start,
-                                                work.start + work.length)
-        seq_lens = np.asarray([work.start + work.length], np.int32)
-        bt = self._block_table(req)[None, :]
+        sample_pos = np.zeros((R,), np.int32)
+        for i, work in enumerate(batch.items):
+            req = work.request
+            chunk = req.prompt_tokens[work.start: work.start + work.length]
+            tokens[i, : work.length] = chunk
+            positions[i, : work.length] = np.arange(
+                work.start, work.start + work.length)
+            seq_lens[i] = work.start + work.length
+            sample_pos[i] = work.length - 1
+            n = min(len(req.pages), P)
+            bts[i, :n] = req.pages[:n]
 
         logits, self.cache = self._step(
             self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(seq_lens), jnp.asarray(bt))
+            jnp.asarray(seq_lens), jnp.asarray(bts),
+            jnp.asarray(sample_pos))
 
-        self.scheduler.prefill_done(work)
-        self._publish_completed_blocks(req)
-        if req.state is not RequestState.DECODE:
-            return None  # more prompt chunks to go
-
-        # Prompt complete: sample the first output token from the last
-        # real position of this chunk (this is TTFT).
-        token = self._sample_rows(
-            logits[:, work.length - 1], [req])[0]
-        return self._append_token(req, int(token))
+        deltas: List[TokenDelta] = []
+        done_rows: List[int] = []
+        for i, work in enumerate(batch.items):
+            self.scheduler.prefill_done(work)
+            self._publish_completed_blocks(work.request)
+            if work.request.state is RequestState.DECODE:
+                done_rows.append(i)
+        if done_rows:
+            # Sample first tokens for rows whose prompt completed (logits
+            # already point at each row's last real chunk position).
+            sel = logits[jnp.asarray(done_rows)]
+            reqs = [batch.items[i].request for i in done_rows]
+            sampled = self._sample_rows(sel, reqs)
+            for j, req in enumerate(reqs):
+                deltas.append(self._append_token(req, int(sampled[j])))
+        return deltas
 
     def _run_decode(self, work: DecodeWork) -> List[TokenDelta]:
         reqs = work.requests
@@ -286,7 +347,7 @@ class EngineCore:
         tokens = np.zeros((bucket, 1), np.int32)
         positions = np.full((bucket, 1), self._pad_position, np.int32)
         seq_lens = np.zeros((bucket,), np.int32)
-        bts = np.zeros((bucket, self._table_width), np.int32)
+        bts = np.zeros((bucket, work.pages), np.int32)
 
         live: List[Request] = []
         for req in reqs:
@@ -303,7 +364,8 @@ class EngineCore:
                             else req.prompt_tokens[-1])
             positions[i, 0] = ctx - 1
             seq_lens[i] = ctx
-            bts[i, : len(req.pages)] = req.pages
+            n = min(len(req.pages), work.pages)
+            bts[i, :n] = req.pages[:n]
             live.append(req)
 
         if not live:
@@ -312,9 +374,10 @@ class EngineCore:
         logits, self.cache = self._step(
             self.params, self.cache,
             jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(seq_lens), jnp.asarray(bts))
+            jnp.asarray(seq_lens), jnp.asarray(bts),
+            jnp.zeros((bucket,), jnp.int32))
 
-        sampled = self._sample_rows(logits[: len(live), -1], live)
+        sampled = self._sample_rows(logits[: len(live)], live)
         deltas = []
         for i, req in enumerate(live):
             # Publish blocks sealed by *previous* tokens before appending:
@@ -322,6 +385,115 @@ class EngineCore:
             # late publish would re-emit the whole sequence from scratch.
             self._publish_completed_blocks(req)
             deltas.append(self._append_token(req, int(sampled[i])))
+        return deltas
+
+    # -- pipelined decode windows ------------------------------------------
+
+    def _window_fn(self, greedy_only: bool):
+        fn = self._window_fns.get(greedy_only)
+        if fn is None:
+            from dynamo_tpu.models.llama import make_decode_window
+
+            fn = jax.jit(
+                make_decode_window(
+                    self.config.model, self.block_size,
+                    self.config.decode_window,
+                    use_pallas_decode=self._use_pallas,
+                    greedy_only=greedy_only),
+                donate_argnums=(1,))
+            self._window_fns[greedy_only] = fn
+        return fn
+
+    def _dispatch_window(self, work: DecodeWork) -> Optional[List[TokenDelta]]:
+        """Dispatch one fused K-token decode window (no host sync); sync
+        and emit the window from pipeline_depth dispatches ago.  Returns
+        None if page capacity can't cover the lookahead (caller drains and
+        falls back to the single-step path)."""
+        K = self.config.decode_window
+        reqs = work.requests
+        bucket = work.bucket
+        lag = len(self._inflight)  # windows dispatched but unsynced
+
+        # Shadow context: host bookkeeping lags the device by lag*K tokens.
+        shadows = []
+        for req in reqs:
+            shadow = req.context_len + lag * K
+            if not self.scheduler.ensure_capacity(req, shadow + K):
+                return None
+            shadows.append(shadow)
+
+        bs = self.block_size
+        width = self.scheduler.config.bucket_for_pages(
+            max((s + K + bs - 1) // bs for s in shadows))
+        positions0 = np.full((bucket,), self._pad_position, np.int32)
+        seq_lens0 = np.zeros((bucket,), np.int32)
+        bts = np.zeros((bucket, width), np.int32)
+        temp = np.zeros((bucket,), np.float32)
+        top_k = np.zeros((bucket,), np.int32)
+        top_p = np.ones((bucket,), np.float32)
+        offsets = np.zeros((bucket,), np.int32)
+        for i, req in enumerate(reqs):
+            positions0[i] = shadows[i] - 1
+            seq_lens0[i] = shadows[i]
+            n = min(len(req.pages), width)
+            bts[i, :n] = req.pages[:n]
+            temp[i] = req.sampling.temperature
+            top_k[i] = req.sampling.top_k
+            top_p[i] = req.sampling.top_p
+            offsets[i] = (req.prior_output + len(req.output_tokens)
+                          + lag * K)
+
+        if lag:
+            last_tokens = self._inflight[-1]["out"][K - 1]  # device, no sync
+        else:
+            toks = np.zeros((bucket,), np.int32)
+            for i, req in enumerate(reqs):
+                toks[i] = (req.output_tokens[-1] if req.output_tokens
+                           else req.prompt_tokens[-1])
+            last_tokens = jnp.asarray(toks)
+
+        greedy_only = all(r.sampling.temperature <= 0 for r in reqs)
+        if greedy_only:
+            base_keys = jax.random.split(jax.random.key(0), bucket)
+        else:
+            self._rng, sub = jax.random.split(self._rng)
+            base_keys = jax.random.split(sub, bucket)
+            for i, req in enumerate(reqs):
+                if req.sampling.seed is not None:
+                    base_keys = base_keys.at[i].set(
+                        jax.random.key(req.sampling.seed))
+
+        self.cache, out = self._window_fn(greedy_only)(
+            self.params, self.cache, last_tokens,
+            jnp.asarray(positions0), jnp.asarray(seq_lens0),
+            jnp.asarray(bts), jnp.asarray(temp), jnp.asarray(top_k),
+            jnp.asarray(top_p), base_keys, jnp.asarray(offsets))
+        self._inflight.append({
+            "rids": [r.request_id for r in reqs],
+            "reqs": list(reqs),
+            "out": out,
+        })
+        if len(self._inflight) > self.config.window_pipeline_depth:
+            return self._sync_one_window()
+        return []
+
+    def _sync_one_window(self) -> List[TokenDelta]:
+        entry = self._inflight.pop(0)
+        tokens = np.asarray(jax.device_get(entry["out"]))  # [K, bucket]
+        deltas: List[TokenDelta] = []
+        for i in range(tokens.shape[0]):
+            for j, req in enumerate(entry["reqs"]):
+                if (req.request_id not in self._requests
+                        or req.state is not RequestState.DECODE):
+                    continue  # finished/cancelled mid-window: discard tail
+                self._publish_completed_blocks(req)
+                deltas.append(self._append_token(req, int(tokens[i, j])))
+        return deltas
+
+    def _drain_inflight(self) -> List[TokenDelta]:
+        deltas: List[TokenDelta] = []
+        while self._inflight:
+            deltas.extend(self._sync_one_window())
         return deltas
 
     def _preempt_or_finish(self, req: Request) -> None:
@@ -349,27 +521,33 @@ class EngineCore:
 
     def _sample_rows(self, logits: jax.Array, reqs: List[Request]) -> np.ndarray:
         n = logits.shape[0]
-        temp = np.asarray([r.sampling.temperature for r in reqs[:n]]
+        reqs = reqs[:n]
+        if all(r.sampling.temperature <= 0 for r in reqs):
+            # Greedy fast path: no keys, no sort — a plain argmax (the
+            # common serving mix; per-row key plumbing here cost dozens of
+            # device round-trips per step in r1).
+            return np.asarray(jax.device_get(greedy_sample(logits)))
+
+        temp = np.asarray([r.sampling.temperature for r in reqs]
                           + [0.0] * (n - len(reqs)), np.float32)
-        top_k = np.asarray([r.sampling.top_k for r in reqs[:n]]
+        top_k = np.asarray([r.sampling.top_k for r in reqs]
                            + [0] * (n - len(reqs)), np.int32)
-        top_p = np.asarray([r.sampling.top_p for r in reqs[:n]]
+        top_p = np.asarray([r.sampling.top_p for r in reqs]
                            + [1.0] * (n - len(reqs)), np.float32)
-        # Per-row keys: a seeded request's stream depends only on
-        # (seed, token index) — reproducible regardless of batch mix and
-        # across preemption (prior_output keeps the index monotonic).
-        keys = []
-        for r in reqs[:n]:
+        # One split yields the whole batch's fresh keys (a single device
+        # op); seeded rows then overwrite theirs with fold_in(seed, index)
+        # so a seeded stream depends only on (seed, token index) —
+        # reproducible across batch mixes and preemption (prior_output
+        # keeps the index monotonic).
+        self._rng, sub = jax.random.split(self._rng)
+        keys = jax.random.split(sub, n)
+        for i, r in enumerate(reqs):
             if r.sampling.seed is not None:
-                keys.append(jax.random.fold_in(
+                keys = keys.at[i].set(jax.random.fold_in(
                     jax.random.key(r.sampling.seed),
                     r.prior_output + len(r.output_tokens)))
-            else:
-                self._rng, k = jax.random.split(self._rng)
-                keys.append(k)
-        keys.extend(jax.random.key(0) for _ in range(n - len(reqs)))
         out = sample(logits, jnp.asarray(temp), jnp.asarray(top_k),
-                     jnp.asarray(top_p), jnp.stack(keys))
+                     jnp.asarray(top_p), keys)
         return np.asarray(jax.device_get(out))
 
     def _append_token(self, req: Request, token: int) -> TokenDelta:
@@ -398,6 +576,33 @@ class EngineCore:
         self._requests.pop(req.request_id, None)
         self._hash_seqs.pop(req.request_id, None)
         self._published_blocks.pop(req.request_id, None)
+
+    # -- cross-worker KV transfer ------------------------------------------
+
+    def export_blocks(self, hashes) -> Dict[int, np.ndarray]:
+        """Raw KV bytes for every requested block resident in any tier
+        (the extract side of the worker↔worker data plane).  Must run on
+        the engine thread — InferenceEngine wraps it as a command."""
+        out: Dict[int, np.ndarray] = {}
+        if not self._managed_cache:
+            return out
+        for h in hashes:
+            data = self.allocator.manager.export_block(h)
+            if data is not None:
+                out[h] = data
+        return out
+
+    def import_blocks(self, blocks: Dict[int, np.ndarray]) -> int:
+        """Inject fetched blocks into G1 as registered prefix-cache entries;
+        a subsequent add_request with the matching prompt prefix skips
+        their prefill (the decode-side onboard of disaggregated P/D)."""
+        if not self._managed_cache:
+            return 0
+        n = 0
+        for h, data in blocks.items():
+            if self.allocator.manager.import_block(h, data):
+                n += 1
+        return n
 
     # -- block registration + KV events ------------------------------------
 
@@ -476,6 +681,7 @@ class InferenceEngine:
         self._cmd_lock = threading.Lock()
         self._pending_adds: List[tuple] = []
         self._pending_cancels: List[str] = []
+        self._pending_calls: List[tuple] = []  # (fn, asyncio.Future)
         self._stop = threading.Event()
         self._wake = threading.Event()
 
@@ -508,6 +714,14 @@ class InferenceEngine:
         with self._cmd_lock:
             adds, self._pending_adds = self._pending_adds, []
             cancels, self._pending_cancels = self._pending_cancels, []
+            calls, self._pending_calls = self._pending_calls, []
+        for fn, fut in calls:
+            try:
+                result = fn()
+            except Exception as e:  # surfaced to the awaiting caller
+                self._resolve(fut, None, e)
+            else:
+                self._resolve(fut, result, None)
         for rid, prompt, sampling in adds:
             try:
                 self.core.add_request(rid, prompt, sampling)
@@ -518,6 +732,19 @@ class InferenceEngine:
                 logger.warning("rejecting request %s: %s", rid, e)
         for rid in cancels:
             self.core.cancel(rid)
+
+    def _resolve(self, fut, result, exc) -> None:
+        assert self._loop is not None
+
+        def setter():
+            if fut.done():
+                return
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+
+        self._loop.call_soon_threadsafe(setter)
 
     def _dispatch(self, delta: TokenDelta) -> None:
         q = self._queues.get(delta.request_id)
@@ -555,6 +782,23 @@ class InferenceEngine:
             with self._cmd_lock:
                 self._pending_cancels.append(request_id)
             self._wake.set()
+
+    async def run_in_engine(self, fn):
+        """Run fn() on the engine thread between steps (cache access must
+        never race the step loop); returns its result."""
+        fut = asyncio.get_running_loop().create_future()
+        with self._cmd_lock:
+            self._pending_calls.append((fn, fut))
+        self._wake.set()
+        return await fut
+
+    async def export_blocks(self, hashes) -> Dict[int, np.ndarray]:
+        return await self.run_in_engine(
+            lambda: self.core.export_blocks(hashes))
+
+    async def import_blocks(self, blocks) -> int:
+        return await self.run_in_engine(
+            lambda: self.core.import_blocks(blocks))
 
     @property
     def metrics(self) -> ForwardPassMetrics:

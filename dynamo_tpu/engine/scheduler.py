@@ -164,15 +164,38 @@ class SchedulerConfig:
                 return b
         return self.prefill_buckets[-1]
 
+    def bucket_for_pages(self, n: int) -> int:
+        """Block-table width bucket: the device step's context gather costs
+        O(width × block_size), so tables are sliced to the smallest
+        power-of-two page count covering the batch — NOT the static
+        max_pages width (that was an order-of-magnitude decode cliff at
+        serving geometry: every step paid for max_context regardless of
+        actual context)."""
+        b = 2
+        while b < n:
+            b *= 2
+        return min(b, self.max_pages_per_seq)
+
 
 @dataclass
 class PrefillWork:
-    """One prefill chunk for one sequence (static chunk-length bucket)."""
+    """One prefill chunk for one sequence."""
 
     request: Request
     start: int        # absolute position of chunk start
     length: int       # real tokens in chunk
-    bucket: int       # padded chunk length to run
+
+
+@dataclass
+class PrefillBatch:
+    """All of this iteration's prefill chunks, packed into ONE device call
+    (ragged rows padded to `chunk`): N concurrent prompts cost one dispatch,
+    not N (r1 ran one sequence per call — TTFT under concurrency died)."""
+
+    items: List[PrefillWork]
+    rows: int         # padded row count (batch bucket)
+    chunk: int        # padded chunk length (token bucket)
+    pages: int        # padded block-table width (page bucket)
 
 
 @dataclass
@@ -181,16 +204,17 @@ class DecodeWork:
 
     requests: List[Request]
     bucket: int
+    pages: int        # padded block-table width (page bucket)
 
 
 @dataclass
 class StepPlan:
-    prefills: List[PrefillWork]
+    prefill: Optional[PrefillBatch]
     decode: Optional[DecodeWork]
 
     @property
     def empty(self) -> bool:
-        return not self.prefills and self.decode is None
+        return self.prefill is None and self.decode is None
 
 
 class Scheduler:
@@ -277,35 +301,49 @@ class Scheduler:
         remaining token budget goes to prefill chunks, longest-waiting
         first (FCFS, like the reference mocker)."""
         self._try_admit()
+        bs = self.config.block_size
 
         budget = self.config.max_batched_tokens
         decoding = [r for r in self.running if r.state is RequestState.DECODE]
         decode = None
         if decoding:
+            # Width covers the context each row will have AFTER this step's
+            # page growth (ensure_capacity grows to ceil(context_len/bs));
+            # rows may hold extra pre-allocated pages beyond that — the
+            # engine clips the row fill, the gather never reads past
+            # seq_len anyway.
             decode = DecodeWork(
                 requests=decoding,
                 bucket=self.config.bucket_for_decode(len(decoding)),
+                pages=self.config.bucket_for_pages(max(
+                    (r.context_len + bs - 1) // bs for r in decoding)),
             )
             budget -= len(decoding)
 
-        prefills: List[PrefillWork] = []
+        items: List[PrefillWork] = []
         for req in self.running:
             if req.state is not RequestState.PREFILL:
                 continue
-            if budget <= 0:
+            if budget <= 0 or len(items) >= self.config.max_seqs:
                 break
             remaining = len(req.prompt_tokens) - req.prefilled
             chunk = min(remaining, self.config.max_prefill_chunk, budget)
             if chunk <= 0:
                 continue
-            prefills.append(PrefillWork(
-                request=req,
-                start=req.prefilled,
-                length=chunk,
-                bucket=self.config.bucket_for_prefill(chunk),
-            ))
+            items.append(PrefillWork(
+                request=req, start=req.prefilled, length=chunk))
             budget -= chunk
-        return StepPlan(prefills=prefills, decode=decode)
+        prefill = None
+        if items:
+            prefill = PrefillBatch(
+                items=items,
+                rows=self.config.bucket_for_decode(len(items)),
+                chunk=self.config.bucket_for_prefill(
+                    max(w.length for w in items)),
+                pages=self.config.bucket_for_pages(max(
+                    (w.start + w.length + bs - 1) // bs for w in items)),
+            )
+        return StepPlan(prefill=prefill, decode=decode)
 
     # -- preemption -------------------------------------------------------
 

@@ -163,6 +163,42 @@ class KvBlockManager:
             self.onboarded_blocks += 1
         return n, ids
 
+    # -- cross-worker transfer (the NIXL-analog data plane) ----------------
+
+    def export_block(self, block_hash: int) -> Optional[np.ndarray]:
+        """Raw KV bytes of a resident block, searched G1→G2→G3 (the
+        extract side of worker↔worker transfer; reference
+        `block_manager/block/transfer.rs` + `storage/nixl.rs:403`)."""
+        slot = self.device.registry.lookup(block_hash)
+        if slot is not None and self.extract_fn is not None:
+            return np.asarray(self.extract_fn(slot.index))
+        if self.host is not None:
+            hslot = self.host.registry.lookup(block_hash)
+            if hslot is not None and self._host_data is not None:
+                return np.array(self._host_data[hslot.index])
+        if self.disk is not None:
+            dslot = self.disk.registry.lookup(block_hash)
+            if dslot is not None and self._disk_data is not None:
+                return np.array(self._disk_data[dslot.index])
+        return None
+
+    def import_block(self, block_hash: int, data: np.ndarray) -> bool:
+        """Inject a fetched block into G1 and register it (inactive,
+        matchable) — the onboard side of a remote transfer.  Returns False
+        when already resident or no capacity."""
+        if self.device.registry.lookup(block_hash) is not None:
+            return False  # already resident
+        if self.inject_fn is None or not self.device.can_allocate(1):
+            return False
+        [slot] = self.device.allocate(1)
+        self.inject_fn(slot, data)
+        if not self.device.register(slot, block_hash):
+            self.device.release([slot])
+            return False
+        self.device.release([slot])  # -> inactive: resident, matchable
+        self.onboarded_blocks += 1
+        return True
+
     # -- passthrough G1 ops ------------------------------------------------
 
     def allocate(self, n: int) -> List[int]:

@@ -1,0 +1,109 @@
+"""Cross-worker KV-block transfer over the RPC plane (NIXL analog).
+
+The reference moves KV blocks between workers with NIXL RDMA
+(`lib/llm/src/block_manager/block/transfer.rs`, `storage/nixl.rs:403`) and
+registers transfer metadata in etcd (`docs/architecture/disagg_serving.md:
+96-110`).  Here the data plane is host-staged over the same peer-TCP RPC
+the request plane uses: a worker serves the `kv_blocks` endpoint, peers
+pull blocks by chained hash.  The "metadata in etcd" analog is the
+instance record each worker already publishes — its RPC address IS the
+transfer descriptor (hash-addressed blocks need no per-block metadata).
+
+Wire format (one RPC delta per block, binary-safe msgpack):
+    request:  {"hashes": [int, ...]}
+    delta:    {"hash": int, "data": bytes, "dtype": str, "shape": [int]}
+
+A native ICI/DCN device-to-device path (pallas make_async_remote_copy)
+slots in behind the same interface when multi-chip topology is available;
+the host-staged path stays as the cross-slice / DCN fallback, mirroring
+the reference's memcpy/NIXL strategy selection (`transfer/strategy.rs`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+KV_BLOCKS_ENDPOINT = "kv_blocks"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def encode_block(block_hash: int, data: np.ndarray) -> dict:
+    return {
+        "hash": block_hash,
+        "data": data.tobytes(),
+        "dtype": data.dtype.name,
+        "shape": list(data.shape),
+    }
+
+
+def decode_block(msg: dict) -> tuple:
+    arr = np.frombuffer(msg["data"], dtype=_np_dtype(msg["dtype"]))
+    return msg["hash"], arr.reshape(msg["shape"])
+
+
+def make_kv_blocks_handler(engine):
+    """RPC handler streaming resident blocks by hash; register on the
+    worker's RpcServer under KV_BLOCKS_ENDPOINT.  `engine` is an
+    InferenceEngine (async export) or anything with `export_blocks`."""
+
+    async def handler(payload: dict):
+        hashes = payload.get("hashes", [])
+        blocks = await engine.export_blocks(hashes)
+        for h in hashes:             # preserve request order for streaming
+            data = blocks.get(h)
+            if data is not None:
+                yield encode_block(h, data)
+
+    return handler
+
+
+async def fetch_blocks(rpc_client, hashes: Iterable[int],
+                       ) -> Dict[int, np.ndarray]:
+    """Pull blocks from a peer worker; missing hashes are simply absent
+    from the result (the caller prefills them locally)."""
+    hashes = list(hashes)
+    if not hashes:
+        return {}
+    out: Dict[int, np.ndarray] = {}
+    async for msg in rpc_client.call(KV_BLOCKS_ENDPOINT, {"hashes": hashes}):
+        h, arr = decode_block(msg)
+        out[h] = arr
+    return out
+
+
+async def pull_prefix(engine, rpc_client, prompt_tokens: List[int],
+                      block_size: int) -> int:
+    """Fetch + inject every sealed prompt block a peer holds; returns the
+    number of tokens now covered by local cache.  This is the decode-side
+    onboard step of disaggregated P/D (reference: decode pulls KV via
+    NIXL after remote prefill, `disagg_serving.md:70-99`)."""
+    from dynamo_tpu.tokens import compute_block_hashes
+
+    n_sealed = len(prompt_tokens) // block_size
+    if n_sealed == 0:
+        return 0
+    hashes = compute_block_hashes(prompt_tokens[: n_sealed * block_size],
+                                  block_size)
+    blocks = await fetch_blocks(rpc_client, hashes)
+    # Inject the longest contiguous prefix only — a gap breaks the chain.
+    contiguous: Dict[int, np.ndarray] = {}
+    for h in hashes:
+        if h not in blocks:
+            break
+        contiguous[h] = blocks[h]
+    if not contiguous:
+        return 0
+    await engine.import_blocks(contiguous)
+    return len(contiguous) * block_size

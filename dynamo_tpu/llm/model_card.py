@@ -6,9 +6,14 @@ published to NATS object store + etcd entry): everything a frontend needs
 to serve a model it has never seen locally — tokenizer construction,
 chat template, context limits, KV geometry for routing.
 
-Tokenizer is carried by spec, not bytes: {"kind": "byte"} or
-{"kind": "hf_file", "path": ...} (workers and frontends share a filesystem
-or model cache in deployment, like the reference's HF-hub local cache).
+Tokenizer specs:
+- {"kind": "byte"} — dependency-free test tokenizer;
+- {"kind": "hf_file", "path": ...} — shared-filesystem deployments;
+- {"kind": "hf_inline", "json": <tokenizer.json contents>, "eos_token"?} —
+  the artifact TRAVELS WITH THE CARD, so a frontend that has never seen
+  the checkpoint serves the real tokenizer (the reference uploads MDC
+  artifacts to the NATS object store, `model_card.rs:241`; our control
+  plane carries them inline).
 """
 
 from __future__ import annotations
@@ -44,5 +49,11 @@ class ModelDeploymentCard:
             return ByteTokenizer()
         if kind == "hf_file":
             return HFTokenizer(spec["path"],
-                               eos_token_ids=spec.get("eos_token_ids"))
-        raise ValueError(f"unknown tokenizer spec {spec!r}")
+                               eos_token_ids=spec.get("eos_token_ids"),
+                               eos_token=spec.get("eos_token"))
+        if kind == "hf_inline":
+            return HFTokenizer.from_json(
+                spec["json"],
+                eos_token_ids=spec.get("eos_token_ids"),
+                eos_token=spec.get("eos_token"))
+        raise ValueError(f"unknown tokenizer spec kind {kind!r}")

@@ -116,14 +116,34 @@ class ByteTokenizer:
 class HFTokenizer:
     """HuggingFace `tokenizers` wrapper loaded from a local tokenizer.json."""
 
-    def __init__(self, path: str, eos_token_ids: Optional[Sequence[int]] = None):
+    def __init__(self, path: str, eos_token_ids: Optional[Sequence[int]] = None,
+                 eos_token: Optional[str] = None):
         from tokenizers import Tokenizer as _HFTok
 
         self._tok = _HFTok.from_file(path)
+        self._init_eos(eos_token_ids, eos_token)
+
+    @classmethod
+    def from_json(cls, json_str: str,
+                  eos_token_ids: Optional[Sequence[int]] = None,
+                  eos_token: Optional[str] = None) -> "HFTokenizer":
+        """Build from tokenizer.json CONTENTS — the artifact travels inside
+        the model card so remote frontends never need the worker's
+        filesystem (reference: MDC artifacts ride the NATS object store,
+        `model_card.rs:241`)."""
+        from tokenizers import Tokenizer as _HFTok
+
+        self = cls.__new__(cls)
+        self._tok = _HFTok.from_str(json_str)
+        self._init_eos(eos_token_ids, eos_token)
+        return self
+
+    def _init_eos(self, eos_token_ids, eos_token) -> None:
         self._eos = tuple(eos_token_ids or ())
+        candidates = ([eos_token] if eos_token else []) + [
+            "</s>", "<|endoftext|>", "<|eot_id|>", "<|end_of_text|>"]
         if not self._eos:
-            # Common convention: try the standard special tokens.
-            for name in ("</s>", "<|endoftext|>", "<|eot_id|>", "<|end_of_text|>"):
+            for name in candidates:
                 tid = self._tok.token_to_id(name)
                 if tid is not None:
                     self._eos += (tid,)

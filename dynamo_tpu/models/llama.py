@@ -8,11 +8,17 @@ SURVEY.md §2.3); this module is the TPU replacement for that delegation.
 Forward contract (unified prefill/decode, see dynamo_tpu/ops/attention.py):
 
     logits, cache = forward_step(cfg, params, cache, tokens, positions,
-                                 seq_lens, block_tables)
+                                 seq_lens, block_tables, sample_positions)
 
 - tokens/positions: [B, T] — T is the chunk length (1 for decode).
 - seq_lens: [B] total valid context length *after* this chunk.
 - block_tables: [B, P] page ids into the paged cache.
+- sample_positions: [B] index WITHIN the chunk whose logits the caller
+  wants (chunk_len - 1 for a completing prefill, 0 for decode); logits
+  come back [B, V] for exactly those positions.  Materialising the full
+  [B, T, V] f32 logits of a batched 512-token prefill is a multi-GB
+  allocation for nothing — the LM head runs on one hidden row per
+  sequence.
 - The chunk's K/V are scattered into the cache first, then the chunk
   attends to all cached context with an absolute-position causal mask, so
   the same compiled function serves prefill, chunked prefill and decode.
@@ -129,8 +135,10 @@ def _attention_block(
     positions: jax.Array,    # [B, T]
     seq_lens: jax.Array,     # [B]
     write_slots: jax.Array,  # [B*T] flat cache slots for this chunk
-    ctx_slots: jax.Array,    # [B, C] flat cache slots of full context
-    kv_positions: jax.Array, # [B, C]
+    ctx_slots,               # [B, C] context slots, or None (pallas decode)
+    kv_positions,            # [B, C], or None
+    block_tables: jax.Array, # [B, P]
+    block_size: int,
     cache: Dict,
 ) -> Tuple[jax.Array, Dict]:
     B, T, _ = x.shape
@@ -153,8 +161,20 @@ def _attention_block(
         "v": cache["v"].at[layer_idx].set(v_layer),
     }
 
-    k_ctx, v_ctx = kvc.gather_kv(k_layer, v_layer, ctx_slots)
-    out = paged_attention(q, k_ctx, v_ctx, positions, kv_positions, seq_lens)
+    if ctx_slots is None:
+        # Decode hot path: stream pages via the Pallas kernel — no
+        # materialised context gather (ops/pallas/paged_attention.py).
+        from dynamo_tpu.ops.pallas import paged_decode_attention
+
+        out = paged_decode_attention(
+            q[:, 0], k_layer, v_layer, block_tables, seq_lens,
+            block_size=block_size,
+            interpret=jax.default_backend() != "tpu",
+        )[:, None]
+    else:
+        k_ctx, v_ctx = kvc.gather_kv(k_layer, v_layer, ctx_slots)
+        out = paged_attention(q, k_ctx, v_ctx, positions, kv_positions,
+                              seq_lens)
     out = out.reshape(B, T, cfg.q_size) @ p_attn["wo"]
     return out, cache
 
@@ -186,25 +206,88 @@ def _moe_mlp(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# Fused decode window
+
+
+def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
+                       use_pallas_decode: bool = False,
+                       greedy_only: bool = False):
+    """K decode steps in ONE device dispatch, tokens fed back on-device.
+
+    The per-token host loop costs a host↔device round-trip per step — the
+    latency SURVEY §7 flags as the decode hard part (and which a tunneled
+    TPU turns into ~170 ms/step).  `lax.fori_loop` keeps K steps on device:
+    each iteration writes the fed token's KV, computes one-position logits,
+    samples the next token, and feeds it to the next iteration.  The host
+    reads the [K, B] token block lazily, windows behind the dispatch
+    (engine pipelining), so steady-state decode never blocks on the wire.
+
+    Sampling: per-row (temperature, top_k, top_p) are fixed across the
+    window; per-row keys derive on-device as fold_in(base_key, offset + i)
+    so seeded streams stay reproducible across window boundaries and
+    batch mixes.  `greedy_only` compiles the argmax-only variant (no sort,
+    no keys — the common serving mix).
+
+    Returns run(params, cache, last_tokens[B], positions0[B], seq_lens0[B],
+                block_tables[B,P], temp[B], top_k[B], top_p[B],
+                base_keys[B], key_offsets[B]) -> (cache, tokens[K, B]).
+    """
+    from dynamo_tpu.engine.sampling import sample
+
+    step = make_forward_step(cfg, block_size, use_pallas_decode)
+
+    def run(params, cache, last_tokens, positions0, seq_lens0, block_tables,
+            temp, top_k, top_p, base_keys, key_offsets):
+        B = last_tokens.shape[0]
+        zero_pos = jnp.zeros((B,), jnp.int32)
+
+        def body(i, carry):
+            cache, toks, out = carry
+            logits, cache = step(
+                params, cache, toks[:, None],
+                (positions0 + i)[:, None], seq_lens0 + i,
+                block_tables, zero_pos)
+            if greedy_only:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                keys = jax.vmap(jax.random.fold_in)(base_keys,
+                                                    key_offsets + i)
+                nxt = sample(logits, temp, top_k, top_p, keys)
+            return cache, nxt, out.at[i].set(nxt)
+
+        out0 = jnp.zeros((window, B), jnp.int32)
+        cache, _, out = jax.lax.fori_loop(
+            0, window, body, (cache, last_tokens, out0))
+        return cache, out
+
+    return run
+
+
+# ---------------------------------------------------------------------------
 # Forward
 
 
-def make_forward_step(cfg: ModelConfig, block_size: int):
+def make_forward_step(cfg: ModelConfig, block_size: int,
+                      use_pallas_decode: bool = False):
     """Build the jitted unified step for a given cache geometry.
 
     Separate factory (rather than passing block_size as a traced value)
     because slot math needs the block size statically for XLA to fold the
-    index arithmetic.
+    index arithmetic.  With `use_pallas_decode`, T==1 traces route
+    attention through the Pallas paged-decode kernel instead of the
+    gathered-context XLA path (chunk length is static at trace time, so
+    the same factory serves both prefill and decode compilations).
     """
     cfg.validate()
 
     def step(
         params: Params,
         cache: Dict,
-        tokens: jax.Array,        # [B, T]
-        positions: jax.Array,     # [B, T]
-        seq_lens: jax.Array,      # [B]
-        block_tables: jax.Array,  # [B, P]
+        tokens: jax.Array,            # [B, T]
+        positions: jax.Array,         # [B, T]
+        seq_lens: jax.Array,          # [B]
+        block_tables: jax.Array,      # [B, P]
+        sample_positions=None,        # [B] chunk-local index, or None = all
     ) -> Tuple[jax.Array, Dict]:
         B, T = tokens.shape
         P = block_tables.shape[1]
@@ -213,10 +296,14 @@ def make_forward_step(cfg: ModelConfig, block_size: int):
         write_slots = kvc.slots_for_positions(block_tables, positions, block_size)
         write_slots = write_slots.reshape(B * T)
 
-        ctx_positions = jnp.broadcast_to(
-            jnp.arange(C, dtype=jnp.int32), (B, C)
-        )
-        ctx_slots = kvc.slots_for_positions(block_tables, ctx_positions, block_size)
+        if use_pallas_decode and T == 1:
+            ctx_positions = ctx_slots = None  # kernel streams pages itself
+        else:
+            ctx_positions = jnp.broadcast_to(
+                jnp.arange(C, dtype=jnp.int32), (B, C)
+            )
+            ctx_slots = kvc.slots_for_positions(
+                block_tables, ctx_positions, block_size)
 
         x = jnp.take(params["embed"], tokens, axis=0)
         for i, layer in enumerate(params["layers"]):
@@ -224,6 +311,7 @@ def make_forward_step(cfg: ModelConfig, block_size: int):
                 cfg, layer["attn"], i,
                 rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps),
                 positions, seq_lens, write_slots, ctx_slots, ctx_positions,
+                block_tables, block_size,
                 cache,
             )
             x = x + attn_out
@@ -234,6 +322,14 @@ def make_forward_step(cfg: ModelConfig, block_size: int):
                 x = x + _dense_mlp(layer["mlp"], h)
 
         x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        # LM head on the one sampled row per sequence ([B, H] @ [H, V]) —
+        # full [B, T, V] logits of a batched 512-token prefill would be a
+        # multi-GB f32 allocation for nothing.  None keeps every position
+        # (tests, logprob paths).
+        if sample_positions is not None:
+            x = jnp.take_along_axis(
+                x, sample_positions[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0]
         head = params.get("lm_head")
         if head is None:
             head = params["embed"].T

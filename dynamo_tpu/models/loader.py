@@ -1,0 +1,198 @@
+"""Checkpoint loading: HF-format safetensors → engine param pytree.
+
+Role of the reference's `lib/llm/src/local_model.rs:39-236` + `hub.rs`
+(resolve a model path, build the deployment card, hand real weights to the
+engine) — minus the hub download (no egress in this environment; a local
+directory in HF layout is the contract, which is also what a mounted model
+cache looks like in deployment).
+
+Name mapping (HF Llama/Mixtral → dynamo_tpu.models.llama pytree):
+
+    model.embed_tokens.weight            embed                [V, H]
+    model.norm.weight                    final_norm           [H]
+    lm_head.weight                       lm_head (transposed) [H, V]
+    model.layers.N.input_layernorm       layers[N].attn_norm
+    model.layers.N.post_attention_ln     layers[N].mlp_norm
+    ...self_attn.{q,k,v}_proj.weight     attn.w{q,k,v} (transposed)
+    ...self_attn.o_proj.weight           attn.wo       (transposed)
+    ...mlp.{gate,up,down}_proj.weight    mlp.w_{gate,up,down} (transposed)
+    ...block_sparse_moe.gate.weight      moe.router    (transposed)
+    ...block_sparse_moe.experts.E.w{1,3,2}  moe.w_{gate,up,down}[E]
+
+HF stores `nn.Linear` weights as [out, in]; our pytree multiplies x @ W so
+every projection transposes on load.  GQA head order: HF q head h shares
+kv head h // G (blocked) — ops/attention.py uses the same convention, and
+our RoPE is the half-split (NeoX/Llama) rotation HF uses, so logits match
+a `transformers` forward to float tolerance (locked by
+tests/test_loader.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+Params = Dict
+
+
+def config_from_hf(hf: dict, name: str = "") -> ModelConfig:
+    """Map an HF config.json dict to our ModelConfig."""
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    moe = "Mixtral" in arch or "num_local_experts" in hf
+    num_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+    return ModelConfig(
+        name=name or hf.get("model_type", "hf-model"),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=hf.get("num_key_value_heads", num_heads),
+        head_dim=head_dim,
+        intermediate_size=hf["intermediate_size"],
+        max_context=hf.get("max_position_embeddings", 8192),
+        rope_theta=float(hf.get("rope_theta", 10_000.0)),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        num_experts=hf.get("num_local_experts", 0),
+        num_experts_per_token=hf.get("num_experts_per_tok", 2),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+
+
+class _TensorSource:
+    """All safetensors shards of a checkpoint, keyed by tensor name."""
+
+    def __init__(self, model_dir: str) -> None:
+        from safetensors import safe_open
+
+        self._handles = []
+        self._where: Dict[str, int] = {}
+        shards = sorted(f for f in os.listdir(model_dir)
+                        if f.endswith(".safetensors"))
+        if not shards:
+            raise FileNotFoundError(f"no .safetensors files in {model_dir}")
+        for i, fname in enumerate(shards):
+            h = safe_open(os.path.join(model_dir, fname), framework="np")
+            self._handles.append(h)
+            for key in h.keys():
+                self._where[key] = i
+
+    def get(self, name: str) -> np.ndarray:
+        idx = self._where.get(name)
+        if idx is None:
+            raise KeyError(f"tensor {name!r} not in checkpoint "
+                           f"(have e.g. {sorted(self._where)[:5]})")
+        return self._handles[idx].get_tensor(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._where
+
+
+def load_params(model_dir: str,
+                cfg: Optional[ModelConfig] = None,
+                dtype=None) -> Tuple[ModelConfig, Params]:
+    """Load an HF-layout checkpoint directory into (config, params).
+
+    `dtype=None` keeps the config's dtype (bf16 for real models).  Arrays
+    land as jnp arrays on the default device; for sharded serving the
+    engine re-places them with shard_pytree (device_put moves, no copy
+    through host when layouts agree).
+    """
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            cfg = config_from_hf(json.load(f),
+                                 name=os.path.basename(model_dir.rstrip("/")))
+    cfg.validate()
+    dtype = dtype or cfg.dtype
+    src = _TensorSource(model_dir)
+
+    def lin(name: str) -> jnp.ndarray:
+        # HF nn.Linear [out, in] -> ours [in, out].
+        return jnp.asarray(src.get(name)).T.astype(dtype)
+
+    def vec(name: str) -> jnp.ndarray:
+        return jnp.asarray(src.get(name)).astype(dtype)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        layer = {
+            "attn": {
+                "wq": lin(p + "self_attn.q_proj.weight"),
+                "wk": lin(p + "self_attn.k_proj.weight"),
+                "wv": lin(p + "self_attn.v_proj.weight"),
+                "wo": lin(p + "self_attn.o_proj.weight"),
+            },
+            "attn_norm": vec(p + "input_layernorm.weight"),
+            "mlp_norm": vec(p + "post_attention_layernorm.weight"),
+        }
+        if cfg.is_moe:
+            experts_gate = []
+            experts_up = []
+            experts_down = []
+            for e in range(cfg.num_experts):
+                ep = p + f"block_sparse_moe.experts.{e}."
+                experts_gate.append(lin(ep + "w1.weight"))
+                experts_up.append(lin(ep + "w3.weight"))
+                experts_down.append(lin(ep + "w2.weight"))
+            layer["moe"] = {
+                "router": lin(p + "block_sparse_moe.gate.weight"),
+                "w_gate": jnp.stack(experts_gate),
+                "w_up": jnp.stack(experts_up),
+                "w_down": jnp.stack(experts_down),
+            }
+        else:
+            layer["mlp"] = {
+                "w_gate": lin(p + "mlp.gate_proj.weight"),
+                "w_up": lin(p + "mlp.up_proj.weight"),
+                "w_down": lin(p + "mlp.down_proj.weight"),
+            }
+        layers.append(layer)
+
+    params: Params = {
+        "embed": jnp.asarray(src.get("model.embed_tokens.weight")).astype(dtype),
+        "final_norm": vec("model.norm.weight"),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in src:
+            params["lm_head"] = lin("lm_head.weight")
+        else:
+            cfg = cfg.replace(tie_embeddings=True)
+    return cfg, params
+
+
+def resolve_model(path_or_preset: str):
+    """Resolve a --model argument: an HF-layout directory (real weights) or
+    a preset name (random weights; bench/test mode).
+
+    Returns (cfg, params_or_None, tokenizer_spec, chat_template_or_None).
+    """
+    from dynamo_tpu.models import config as mcfg
+
+    if os.path.isdir(path_or_preset):
+        cfg, params = load_params(path_or_preset)
+        spec = {"kind": "byte"}
+        tok_path = os.path.join(path_or_preset, "tokenizer.json")
+        if os.path.exists(tok_path):
+            with open(tok_path) as f:
+                spec = {"kind": "hf_inline", "json": f.read()}
+        template = None
+        cfg_path = os.path.join(path_or_preset, "tokenizer_config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                tok_cfg = json.load(f)
+            template = tok_cfg.get("chat_template")
+            eos = tok_cfg.get("eos_token")
+            if isinstance(eos, dict):
+                eos = eos.get("content")
+            if eos and spec.get("kind") == "hf_inline":
+                spec["eos_token"] = eos
+        return cfg, params, spec, template
+    return mcfg.get_config(path_or_preset), None, {"kind": "byte"}, None

@@ -53,12 +53,16 @@ def paged_attention(
     if scale is None:
         scale = D ** -0.5
 
-    qg = q.reshape(B, T, G, Hkv, D).astype(jnp.float32)
+    # Blocked GQA convention (HF Llama): q head h shares kv head h // G —
+    # the reshape keeps kv as the SLOW axis.  (An interleaved reshape is
+    # self-consistent for random weights but silently wrong for real
+    # checkpoints.)
+    qg = q.reshape(B, T, Hkv, G, D).astype(jnp.float32)
     kf = k_ctx.astype(jnp.float32)
     vf = v_ctx.astype(jnp.float32)
 
-    # [B, G, Hkv, T, C]
-    scores = jnp.einsum("btghd,bchd->bghtc", qg, kf) * scale
+    # [B, Hkv, G, T, C]
+    scores = jnp.einsum("btkgd,bckd->bkgtc", qg, kf) * scale
     if soft_cap is not None:
         scores = soft_cap * jnp.tanh(scores / soft_cap)
 
@@ -71,7 +75,7 @@ def paged_attention(
     # Fully-masked rows (padding queries) produce uniform probs over junk;
     # callers discard padding-token outputs, so no NaN guard is needed
     # beyond softmax's own max-subtraction.
-    out = jnp.einsum("bghtc,bchd->btghd", probs, vf)
+    out = jnp.einsum("bkgtc,bckd->btkgd", probs, vf)
     return out.reshape(B, T, Hq, D).astype(q.dtype)
 
 

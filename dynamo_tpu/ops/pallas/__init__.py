@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the serving hot path.
+
+The reference's one mandated CUDA kernel is the KV block scatter/gather
+(`lib/llm/src/kernels/block_copy.cu:41`); on TPU the block copies compile
+to XLA dynamic slices (engine/kv_cache.py:make_block_ops) and the kernel
+budget goes where it pays: paged-attention decode, which would otherwise
+materialise a full gathered context per step.
+"""
+
+from dynamo_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+__all__ = ["paged_decode_attention"]

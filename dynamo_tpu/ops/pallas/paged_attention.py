@@ -1,0 +1,183 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The XLA fallback (ops/attention.py) materialises every sequence's context
+K/V — [B, width*block_size, Hkv, D] in f32 — per layer per decode step.
+Context-length bucketing bounds that width, but the gather still reads and
+converts the full bucket for every sequence regardless of its own length.
+This kernel streams exactly `ceil(seq_len/block_size)` KV pages per
+sequence from HBM through VMEM with an online-softmax (flash-attention)
+accumulator, so decode attention cost is per-sequence-length, and no
+gathered context array ever exists in HBM.
+
+Role in the reference: the engines it delegates to (vLLM) run paged
+attention CUDA kernels; the one kernel the reference itself ships is the
+block-copy scatter/gather (`lib/llm/src/kernels/block_copy.cu:41`).  This
+is the TPU-native equivalent of that layer of the stack.
+
+Layout strategy: Mosaic DMA wants 128-aligned trailing dims, and head_dim
+is 64 on small Llamas — so the kernel sees the cache as 2D
+`[S, F = Hkv * head_dim]` (a free reshape of the engine's [S, Hkv, D]
+layout) and GQA head selection is algebraic instead of indexed:
+
+- queries are pre-scattered (in XLA, outside the kernel) into zero-padded
+  rows `qp[B, Hq, F]` where row h occupies only its KV head's column band,
+  so `qp @ k_page.T` contracts to exactly the right per-head scores;
+- `probs @ v_page` produces [Hq, F] whose band h is the right output;
+  the band extraction is again XLA outside the kernel.
+
+The padded matmuls do Hkv x the minimal attention FLOPs, but decode
+attention is HBM-bandwidth-bound, and bytes moved is what the kernel
+minimises; the MXU eats the extra zeros for free at these sizes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(block_size: int, soft_cap: Optional[float],
+                   # refs
+                   bt_ref, len_ref,          # scalar-prefetch (SMEM)
+                   qp_ref, k_hbm, v_hbm,     # inputs (2D cache views)
+                   o_ref,                    # output [1, Hq, F]
+                   k_vmem, v_vmem, sem):     # scratch
+    b = pl.program_id(0)
+    seq_len = len_ref[b]
+    n_pages = pl.cdiv(seq_len, block_size)
+
+    Hq, F = qp_ref.shape[1], qp_ref.shape[2]
+    qp = qp_ref[0].astype(jnp.float32)                # [Hq, F] (pre-scaled)
+
+    m0 = jnp.full((Hq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((Hq, 1), jnp.float32)
+    a0 = jnp.zeros((Hq, F), jnp.float32)
+
+    # Double-buffered page pipeline: fetch page p+1 while computing on p.
+    def get_k(slot, p):
+        return pltpu.make_async_copy(
+            k_hbm.at[pl.ds(bt_ref[b, p] * block_size, block_size)],
+            k_vmem.at[slot], sem.at[slot, 0])
+
+    def get_v(slot, p):
+        return pltpu.make_async_copy(
+            v_hbm.at[pl.ds(bt_ref[b, p] * block_size, block_size)],
+            v_vmem.at[slot], sem.at[slot, 1])
+
+    @pl.when(n_pages > 0)
+    def _():
+        get_k(0, 0).start()
+        get_v(0, 0).start()
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(p, 2)
+        nxt = jax.lax.rem(p + 1, 2)
+
+        @pl.when(p + 1 < n_pages)
+        def _():
+            get_k(nxt, p + 1).start()
+            get_v(nxt, p + 1).start()
+
+        get_k(slot, p).wait()
+        get_v(slot, p).wait()
+
+        k = k_vmem[slot].astype(jnp.float32)          # [bs, F]
+        v = v_vmem[slot].astype(jnp.float32)
+        # Zero bands in qp make this the per-KV-head score despite the
+        # full-F contraction: [Hq, F] x [bs, F] -> [Hq, bs].
+        s = jax.lax.dot_general(
+            qp, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        pos = p * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)
+        s = jnp.where(pos < seq_len, s, -jnp.inf)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        probs = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(probs, axis=-1, keepdims=True)
+        # [Hq, bs] x [bs, F] -> [Hq, F]; band h carries head h's output.
+        pv = jax.lax.dot_general(
+            probs, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * alpha + pv
+
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, a0))
+    # Padding rows (seq_len 0) skip the loop: l stays 0; guard the divide —
+    # their output rows are discarded by the engine anyway.
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "scale", "soft_cap", "interpret"))
+def paged_decode_attention(
+    q: jax.Array,             # [B, Hq, D] current (single) decode queries
+    k_cache: jax.Array,       # [S, Hkv, D] one layer's flat-slot keys
+    v_cache: jax.Array,       # [S, Hkv, D]
+    block_tables: jax.Array,  # [B, P] int32 page ids
+    seq_lens: jax.Array,      # [B] int32 valid context length
+    *,
+    block_size: int,
+    scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode-step attention over the paged cache; returns [B, Hq, D].
+
+    Numerics match ops/attention.py's masked gather path for T=1 (the
+    decode query at position seq_len-1 sees exactly slots pos < seq_len).
+    """
+    B, Hq, D = q.shape
+    S, Hkv, _ = k_cache.shape
+    if Hq % Hkv:
+        raise ValueError(f"Hq={Hq} not a multiple of Hkv={Hkv}")
+    G = Hq // Hkv
+    F = Hkv * D
+    if scale is None:
+        scale = D ** -0.5
+
+    # Scatter each query row into its KV head's column band (XLA side).
+    head_of_q = jnp.arange(Hq, dtype=jnp.int32) // G           # [Hq]
+    sel = jax.nn.one_hot(head_of_q, Hkv, dtype=jnp.float32)    # [Hq, Hkv]
+    qp = jnp.einsum(
+        "bhd,hk->bhkd", q.astype(jnp.float32) * scale, sel
+    ).reshape(B, Hq, F)
+
+    kernel = functools.partial(_decode_kernel, block_size, soft_cap)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hq, F), lambda b, bt, sl: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # V stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, Hq, F), lambda b, bt, sl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_size, F), k_cache.dtype),
+            pltpu.VMEM((2, block_size, F), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out_full = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, F), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables, seq_lens, qp, k_cache.reshape(S, F),
+      v_cache.reshape(S, F))
+
+    # Extract each head's band: [B, Hq, Hkv, D] -> [B, Hq, D].
+    out = out_full.reshape(B, Hq, Hkv, D)
+    return jnp.take_along_axis(
+        out, head_of_q[None, :, None, None], axis=2)[:, :, 0]

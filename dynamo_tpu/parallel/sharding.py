@@ -131,9 +131,10 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh):
         NamedSharding(mesh, d["positions"]),
         NamedSharding(mesh, d["seq_lens"]),
         NamedSharding(mesh, d["block_tables"]),
+        NamedSharding(mesh, P("dp")),              # sample_positions [B]
     )
     out_shardings = (
-        NamedSharding(mesh, P("dp", None, None)),  # logits [B, T, V]
+        NamedSharding(mesh, P("dp", None)),        # logits [B, V]
         jax.tree.map(lambda s: NamedSharding(mesh, s), cache_pspecs()),
     )
     return jax.jit(

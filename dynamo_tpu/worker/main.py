@@ -36,7 +36,9 @@ def parse_args(argv=None):
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--model-name", default="dynamo-tpu")
     p.add_argument("--mocker", action="store_true")
-    p.add_argument("--model", default=None, help="JAX engine model preset")
+    p.add_argument("--model", default=None,
+                   help="model preset name (random weights) or HF-layout "
+                        "checkpoint directory (real weights + tokenizer)")
     p.add_argument("--num-blocks", type=int, default=512)
     p.add_argument("--block-size", type=int, default=64)
     p.add_argument("--speedup-ratio", type=float, default=10.0)
@@ -45,7 +47,9 @@ def parse_args(argv=None):
 
 
 async def build_engine(args, kv_event_sink):
-    """Returns (engine_client, metrics_fn, shutdown)."""
+    """Returns (engine_client, metrics_fn, shutdown, card_fields,
+    transfer_engine) — transfer_engine serves the kv_blocks data plane
+    (None for the mocker, which has no real KV bytes)."""
     if args.mocker:
         from dynamo_tpu.llm.mocker import MockEngine, MockEngineArgs
 
@@ -54,22 +58,31 @@ async def build_engine(args, kv_event_sink):
                            speedup_ratio=args.speedup_ratio),
             kv_event_sink=kv_event_sink)
         await engine.start()
-        return engine, (lambda: engine.metrics), engine.stop
+        return engine, (lambda: engine.metrics), engine.stop, {}, None
 
     from dynamo_tpu.engine.engine import (
         EngineConfig, EngineCore, InferenceEngine)
     from dynamo_tpu.engine.scheduler import SchedulerConfig
     from dynamo_tpu.llm.service import LocalEngineClient
-    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.models.loader import resolve_model
 
+    cfg, params, tok_spec, template = resolve_model(
+        args.model or "llama-3-1b")
     core = EngineCore(
-        EngineConfig(model=get_config(args.model or "llama-3-1b"),
+        EngineConfig(model=cfg,
                      num_blocks=args.num_blocks,
                      scheduler=SchedulerConfig(block_size=args.block_size)),
+        params=params,
         kv_event_sink=kv_event_sink)
     engine = InferenceEngine(core)
     await engine.start()
-    return LocalEngineClient(engine), (lambda: core.metrics), engine.stop
+    card_fields = {
+        "tokenizer_spec": tok_spec,
+        "chat_template": template,
+        "max_context": cfg.max_context,
+    }
+    return LocalEngineClient(engine), (lambda: core.metrics), engine.stop, \
+        card_fields, engine
 
 
 async def run(args) -> None:
@@ -86,10 +99,18 @@ async def run(args) -> None:
         # Engine threads may emit; hop onto the loop for the publish.
         loop.call_soon_threadsafe(pending_events.append, event)
 
-    engine, metrics_fn, shutdown = await build_engine(args, kv_event_sink)
+    engine, metrics_fn, shutdown, card_fields, transfer_engine = \
+        await build_engine(args, kv_event_sink)
+    if transfer_engine is not None:
+        from dynamo_tpu.llm.block_manager.transfer import (
+            KV_BLOCKS_ENDPOINT, make_kv_blocks_handler)
+
+        runtime.rpc.register(KV_BLOCKS_ENDPOINT,
+                             make_kv_blocks_handler(transfer_engine))
     instance = await endpoint.serve(engine_wire_handler(engine))
     card = ModelDeploymentCard(name=args.model_name,
-                               kv_block_size=args.block_size)
+                               kv_block_size=args.block_size,
+                               **card_fields)
     await register_llm(endpoint, instance, card)
     print(f"worker instance {instance.instance_id} serving "
           f"{args.model_name!r} at {instance.address}", flush=True)

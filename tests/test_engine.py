@@ -85,10 +85,12 @@ def test_chunked_prefill_budget():
     sched.add_request(_req("a", 40))
     sched.add_request(_req("b", 40))
     plan = sched.plan()
-    # Budget 24: a gets a 16-chunk, b gets the remaining 8.
-    assert [(w.request.request_id, w.length) for w in plan.prefills] == \
+    # Budget 24: a gets a 16-chunk, b gets the remaining 8 — packed into
+    # ONE batched device call.
+    assert [(w.request.request_id, w.length) for w in plan.prefill.items] == \
         [("a", 16), ("b", 8)]
-    for w in plan.prefills:
+    assert plan.prefill.rows == 2 and plan.prefill.chunk == 16
+    for w in plan.prefill.items:
         sched.prefill_done(w)
     assert sched.running[0].prefilled == 16
 

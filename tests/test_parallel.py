@@ -36,12 +36,12 @@ def _inputs(cfg, batch, T, key=5):
     return tokens, positions, seq_lens, jnp.asarray(bt)
 
 
-def _reference_logits(cfg, params, inputs, num_blocks=64):
+def _reference_logits(cfg, params, inputs, sample_positions, num_blocks=64):
     cache = kvc.init_cache(
         kvc.KvCacheConfig.for_model(cfg, num_blocks=num_blocks,
                                     block_size=BLOCK, dtype=jnp.float32))
     step = make_forward_step(cfg, BLOCK)
-    logits, _ = step(params, cache, *inputs)
+    logits, _ = step(params, cache, *inputs, sample_positions)
     return np.asarray(logits)
 
 
@@ -58,7 +58,8 @@ def test_sharded_step_matches_unsharded(cfg_name, mesh_cfg):
     params = init_params(cfg, jax.random.key(0))
     batch, T = 4, 16
     inputs = _inputs(cfg, batch, T)
-    want = _reference_logits(cfg, params, inputs)
+    sample_pos = jnp.full((batch,), T - 1, jnp.int32)
+    want = _reference_logits(cfg, params, inputs, sample_pos)
 
     mesh = make_mesh(mesh_cfg, jax.devices()[: mesh_cfg.size])
     sharded = shard_pytree(params, param_pspecs(cfg), mesh)
@@ -67,7 +68,7 @@ def test_sharded_step_matches_unsharded(cfg_name, mesh_cfg):
             cfg, num_blocks=64, block_size=BLOCK, dtype=jnp.float32)),
         cache_pspecs(), mesh)
     step = make_sharded_step(cfg, BLOCK, mesh)
-    got, cache2 = step(sharded, cache, *inputs)
+    got, cache2 = step(sharded, cache, *inputs, sample_pos)
 
     np.testing.assert_allclose(want, np.asarray(got), rtol=5e-4, atol=5e-4)
     # Cache sharding must survive the step (donation keeps layout).
@@ -96,7 +97,8 @@ def test_decode_after_sharded_prefill():
     batch, T = 2, 12
     tokens, positions, seq_lens, bt = _inputs(cfg, batch, T, key=7)
     full_inputs = (tokens, positions, jnp.full((batch,), T, jnp.int32), bt)
-    want = _reference_logits(cfg, params, full_inputs)
+    want = _reference_logits(cfg, params, full_inputs,
+                             jnp.full((batch,), T - 1, jnp.int32))
 
     sharded = shard_pytree(params, param_pspecs(cfg), mesh)
     cache = shard_pytree(
@@ -106,8 +108,10 @@ def test_decode_after_sharded_prefill():
 
     split = T - 1
     _, cache = step(sharded, cache, tokens[:, :split], positions[:, :split],
-                    jnp.full((batch,), split, jnp.int32), bt)
+                    jnp.full((batch,), split, jnp.int32), bt,
+                    jnp.full((batch,), split - 1, jnp.int32))
     got, _ = step(sharded, cache, tokens[:, split:], positions[:, split:],
-                  jnp.full((batch,), T, jnp.int32), bt)
-    np.testing.assert_allclose(want[:, -1], np.asarray(got)[:, 0],
+                  jnp.full((batch,), T, jnp.int32), bt,
+                  jnp.zeros((batch,), jnp.int32))
+    np.testing.assert_allclose(want, np.asarray(got),
                                rtol=5e-4, atol=5e-4)
