@@ -249,13 +249,18 @@ class EngineCore:
         if window_ok:
             d = self._dispatch_window(plan.decode)
             if d is None:
-                # Capacity refused under lookahead: drain, then let the
-                # next iteration take the single-step path (which preempts
-                # properly with non-shadowed state).
+                # Capacity refused under lookahead: drain and fall through
+                # to the single-step path THIS iteration (it preempts
+                # properly with non-shadowed state).  Merely returning here
+                # would livelock — the next plan() is window-eligible
+                # again and refuses again, forever (r2 shipped that bug:
+                # tests/test_engine.py:306 stalled at 17 tokens).
                 deltas.extend(self._drain_inflight())
+                plan = self.scheduler.plan()
+                window_ok = False
             else:
                 deltas.extend(d)
-        elif not plan.empty:
+        if not window_ok and not plan.empty:
             if plan.prefill:
                 deltas.extend(self._run_prefill_batch(plan.prefill))
             if plan.decode:
