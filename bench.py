@@ -1,31 +1,30 @@
 """Headline bench: steady-state decode throughput on the real TPU chip.
 
-Measures the flagship single-chip model (Llama-3-1B geometry, bf16) at the
-ENGINE'S SERVING GEOMETRY — `max_pages_per_seq=128` (8k context ceiling)
-with context-length-bucketed block tables, i.e. the tables the engine
-actually dispatches at ctx 512 are 16 pages wide (r1's bench silently used
-9-page tables while the engine served 129-wide ones; the bucketing fix in
-engine/scheduler.py makes the serving path and this bench the same
-geometry).  The TPU analog of the reference's decode profiling row
-(`docs/architecture/pre_deployment_profiling.md:38` — 51.22 tok/s/GPU,
-ITL 4.83 ms, Llama-70B TP=4 on H100-class).  `vs_baseline` is the ratio of
-our per-chip tok/s to that number; model sizes differ (1B on one 16GB v5e
-chip vs 70B over 4 H100s) so treat it as a tracking number — the honest
-cross-check arrives with the multi-chip 70B config (BASELINE.md ladder #3;
-Llama-3-8B bf16 at ~16 GB exceeds one v5e chip's HBM, so ladder #1 needs
-tp>=2 hardware).
+Honesty rules (VERDICT r2 found every r2 number inflated or mislabeled):
 
-Reports, in ONE JSON line:
-- value:        raw-step decode tok/s/chip (batch 64, ctx 512, width 16)
-- mfu:          model FLOPs utilisation of that loop (bf16 peak)
-- serving_tok_s: tok/s through the FULL EngineCore path (scheduler, page
-                 growth, on-device sampling, host loop) — the number a
-                 worker actually delivers
-- prefill_tok_s: batched-prefill throughput, 8 prompts x 512 tokens in one
-                 dispatch per chunk bucket
+- On the tunneled "axon" TPU backend, `block_until_ready` returns before
+  execution finishes and a host↔device round-trip costs ~160 ms.  Every
+  timing here therefore ends with a `jax.device_get` of a value that
+  depends on the full computation chain, and per-step figures come from
+  the SLOPE between two run lengths (N1, N2), which cancels the fixed
+  round-trip tax out of the per-step cost.
+- Peak FLOP/s is measured, not read off the device_kind string: a
+  dependent-chain bf16 matmul calibrates the achievable ceiling at bench
+  start (r2 trusted "TPU v5 lite" → 197e12 while reporting mfu 1.31).
+- MFU is asserted < 1 before printing.
+- Prefill is reported steady-state (post-compile), and compile time is
+  reported separately.
+- No `vs_baseline` against the H100 ladder row: a 1B model on one chip vs
+  70B-TP4-per-GPU is noise.  `vs_baseline` is the serving-path fraction of
+  the raw loop (the number VERDICT r3 asks to push ≥ 0.5).
+
+The TPU analog of the reference's decode profiling row
+(`docs/architecture/pre_deployment_profiling.md:38` — 51.22 tok/s/GPU,
+ITL 4.83 ms, Llama-70B TP=4 on H100-class).
 """
 
 import json
+import os
 import time
 
 import jax
@@ -34,30 +33,53 @@ import numpy as np
 
 from dynamo_tpu.engine import kv_cache as kvc
 from dynamo_tpu.engine.engine import EngineConfig, EngineCore
-from dynamo_tpu.engine.sampling import SamplingParams, greedy
+from dynamo_tpu.engine.sampling import SamplingParams
 from dynamo_tpu.engine.scheduler import SchedulerConfig
 from dynamo_tpu.models import config as mcfg
-from dynamo_tpu.models.llama import init_params, make_forward_step
-
-REFERENCE_DECODE_TOK_S_PER_DEVICE = 51.22  # pre_deployment_profiling.md:38
+from dynamo_tpu.models.llama import (
+    init_params,
+    make_decode_window,
+    make_forward_step,
+)
 
 BATCH = 64
 CTX = 512
 BLOCK = 64
 MAX_PAGES = 128            # serving geometry: 8k-token context ceiling
-DECODE_STEPS = 64
-WARMUP = 8
+WIDTH = 16                 # bucket_for_pages(ceil(576/64)=9) -> 16
 
 
-def _bf16_peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v4" in kind:
-        return 275e12
-    return 197e12  # conservative default
+def _sync(x) -> None:
+    """Force real completion: device_get a scalar that depends on x."""
+    jax.device_get(jax.tree.leaves(x)[0].ravel()[0])
+
+
+def calibrate_peak_flops(n: int = 4096, chain: int = 16) -> float:
+    """Measured bf16 matmul ceiling via a dependent chain (slope method)."""
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
+    b = jnp.eye(n, dtype=jnp.bfloat16)
+
+    @jax.jit
+    def step(a, b):
+        for _ in range(chain):
+            a = jax.lax.dot(a, b, preferred_element_type=jnp.bfloat16)
+        return a
+
+    c = step(a, b)
+    _sync(c)
+
+    def run(m):
+        c = a
+        t0 = time.perf_counter()
+        for _ in range(m):
+            c = step(c, b)
+        _sync(c)
+        return time.perf_counter() - t0
+
+    n1, n2 = 2, 8
+    t1, t2 = run(n1), run(n2)
+    per_call = max((t2 - t1) / (n2 - n1), 1e-9)
+    return chain * 2 * n**3 / per_call
 
 
 def _flops_per_token(cfg, params, ctx: int) -> float:
@@ -67,111 +89,199 @@ def _flops_per_token(cfg, params, ctx: int) -> float:
     return 2.0 * n_params + attn
 
 
-def bench_raw_step(cfg, params, use_pallas_decode=False):
-    """Steady-state decode loop at the width the engine dispatches for
-    ctx-512 sequences under serving geometry (page bucket 16 of 128)."""
-    width = 16  # bucket_for_pages(ceil(576/64)=9) -> 16
-    num_blocks = 1 + BATCH * width
-    cache = kvc.init_cache(kvc.KvCacheConfig.for_model(
-        cfg, num_blocks=num_blocks, block_size=BLOCK))
+def _geometry(num_blocks):
+    bt = np.zeros((BATCH, WIDTH), np.int32)
+    for i in range(BATCH):
+        bt[i] = np.arange(1 + i * WIDTH, 1 + (i + 1) * WIDTH)
+    return jnp.asarray(bt)
+
+
+def bench_raw_step(cfg, params, use_pallas_decode):
+    """Per-step device time of the single-step decode program, with
+    on-device greedy feedback (the program the engine's non-window path
+    dispatches), slope-measured."""
+    num_blocks = 1 + BATCH * WIDTH
     step = jax.jit(
         make_forward_step(cfg, BLOCK, use_pallas_decode=use_pallas_decode),
         donate_argnums=(1,))
+    bt = _geometry(num_blocks)
+    sp = jnp.zeros((BATCH,), jnp.int32)
 
-    bt = np.zeros((BATCH, width), np.int32)
-    for i in range(BATCH):
-        bt[i] = np.arange(1 + i * width, 1 + (i + 1) * width)
-    bt = jnp.asarray(bt)
-    tokens = jnp.ones((BATCH, 1), jnp.int32)
+    def one(state):
+        cache, toks, t = state
+        logits, cache = step(params, cache, toks, t[:, None], t + 1, bt, sp)
+        return cache, jnp.argmax(logits, -1).astype(jnp.int32)[:, None], t + 1
 
-    sample_pos = jnp.zeros((BATCH,), jnp.int32)
+    def fresh():
+        return (kvc.init_cache(kvc.KvCacheConfig.for_model(
+                    cfg, num_blocks=num_blocks, block_size=BLOCK)),
+                jnp.ones((BATCH, 1), jnp.int32),
+                jnp.full((BATCH,), CTX, jnp.int32))
 
-    def decode_step(cache, tokens, t):
-        positions = jnp.full((BATCH, 1), t, jnp.int32)
-        seq_lens = jnp.full((BATCH,), t + 1, jnp.int32)
-        logits, cache = step(params, cache, tokens, positions, seq_lens, bt,
-                             sample_pos)
-        return cache, greedy(logits)[:, None]
+    def run(n):
+        st = fresh()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            st = one(st)
+        _sync(st[1])
+        return time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    for i in range(WARMUP):
-        cache, tokens = decode_step(cache, tokens, CTX + i)
-    tokens.block_until_ready()
+    run(1)  # compile
     compile_s = time.perf_counter() - t0
-
-    t0 = time.perf_counter()
-    for i in range(DECODE_STEPS):
-        cache, tokens = decode_step(cache, tokens, CTX + WARMUP + i)
-    tokens.block_until_ready()
-    elapsed = time.perf_counter() - t0
-    return BATCH * DECODE_STEPS / elapsed, elapsed / DECODE_STEPS, compile_s
+    n1, n2 = 4, 20
+    t1, t2 = run(n1), run(n2)
+    step_s = max((t2 - t1) / (n2 - n1), 1e-9)
+    return BATCH / step_s, step_s, compile_s
 
 
-def bench_serving_path(cfg, params):
-    """Tok/s through the full EngineCore: admission, batched prefill,
-    page growth, bucketed decode, on-device sampling, host loop."""
+def bench_window(cfg, params, window: int):
+    """Per-token device time inside the fused K-step decode window."""
+    num_blocks = 1 + BATCH * WIDTH
+    win = jax.jit(
+        make_decode_window(cfg, BLOCK, window, use_pallas_decode=True,
+                           greedy_only=True),
+        donate_argnums=(1,))
+    bt = _geometry(num_blocks)
+    z = jnp.zeros((BATCH,), jnp.float32)
+    zi = jnp.zeros((BATCH,), jnp.int32)
+    ones = jnp.ones((BATCH,), jnp.float32)
+    keys = jax.random.split(jax.random.key(0), BATCH)
+
+    def one(state):
+        cache, last = state
+        cache, out = win(params, cache, last,
+                         jnp.full((BATCH,), CTX, jnp.int32),
+                         jnp.full((BATCH,), CTX + 1, jnp.int32),
+                         bt, z, zi, ones, keys, zi)
+        return cache, out[window - 1]
+
+    def fresh():
+        return (kvc.init_cache(kvc.KvCacheConfig.for_model(
+                    cfg, num_blocks=num_blocks, block_size=BLOCK)),
+                jnp.ones((BATCH,), jnp.int32))
+
+    def run(n):
+        st = fresh()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            st = one(st)
+        _sync(st[1])
+        return time.perf_counter() - t0
+
+    run(1)  # compile
+    n1, n2 = 2, 6
+    t1, t2 = run(n1), run(n2)
+    win_s = max((t2 - t1) / (n2 - n1), 1e-9)
+    return BATCH * window / win_s, win_s / window
+
+
+def bench_serving_path(cfg, params, decode_window):
+    """Tok/s through the full EngineCore: admission, batched prefill, page
+    growth, bucketed decode, pipelined windows with async host fetch.
+    Wall-clock includes every real sync the engine performs."""
+    n_out = 256
     core = EngineCore(
         EngineConfig(
             model=cfg,
             num_blocks=1 + BATCH * (MAX_PAGES // 8),
             enable_prefix_cache=False,  # distinct prompts; skip hash cost
+            decode_window=decode_window,
             scheduler=SchedulerConfig(
                 max_seqs=BATCH, block_size=BLOCK,
                 max_pages_per_seq=MAX_PAGES,
                 max_prefill_chunk=512, max_batched_tokens=8192,
-                # 16 = prefill-batch row bucket (8192/512 chunks per step),
-                # 64 = steady-state decode bucket.
                 decode_buckets=(16, 64), prefill_buckets=(512,)),
         ),
         params=params,
     )
     rng = np.random.default_rng(0)
-    n_out = WARMUP + DECODE_STEPS
     for i in range(BATCH):
         prompt = rng.integers(1, cfg.vocab_size, size=CTX).tolist()
         core.add_request(f"r{i}", prompt, SamplingParams(max_tokens=n_out))
 
-    # Prefill all prompts (batched), then the first decode steps compile.
+    # Prefill all prompts (compiles the prefill buckets on first touch).
     t0 = time.perf_counter()
     while any(r.state.value in ("waiting", "prefill")
               for r in core._requests.values()):
         core.step()
-    prefill_s = time.perf_counter() - t0
-    for _ in range(WARMUP - 1):
-        core.step()
+    prefill_wall_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
+    rng2 = np.random.default_rng(1)  # steady-state prefill pass, below
+
+    # Decode through to completion; first window dispatch compiles.
     produced = 0
-    for _ in range(DECODE_STEPS):
-        produced += len(core.step())
-    elapsed = time.perf_counter() - t0
-    serving_tok_s = produced / elapsed
-    prefill_tok_s = BATCH * CTX / prefill_s  # includes prefill compiles
-    return serving_tok_s, prefill_tok_s
+    t0 = time.perf_counter()
+    deadline = t0 + 600
+    while core.has_work and time.perf_counter() < deadline:
+        produced += sum(len(d.token_ids) for d in core.step())
+    decode_wall_s = time.perf_counter() - t0
+    serving_tok_s = produced / decode_wall_s if decode_wall_s else 0.0
+
+    # Steady-state prefill pass (shapes now compiled).
+    t0 = time.perf_counter()
+    for i in range(BATCH):
+        prompt = rng2.integers(1, cfg.vocab_size, size=CTX).tolist()
+        core.add_request(f"s{i}", prompt, SamplingParams(max_tokens=1))
+    while any(r.state.value in ("waiting", "prefill")
+              for r in core._requests.values()):
+        core.step()
+    steady_prefill_s = time.perf_counter() - t0
+    for _ in range(20):
+        if not core.has_work:
+            break
+        core.step()
+    return (serving_tok_s, BATCH * CTX / prefill_wall_s,
+            BATCH * CTX / steady_prefill_s)
 
 
 def main():
+    # Persistent compilation cache: pay each XLA compile once per geometry,
+    # not once per process (VERDICT r2 #4; reference analog is the engines'
+    # own executable caches, SURVEY §5 checkpoint/artifacts).
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                               "/tmp/dynamo_tpu_xla_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     cfg = mcfg.get_config("llama-3-1b")
     params = init_params(cfg, jax.random.key(0))
     dev = jax.devices()[0]
-
     on_tpu = jax.default_backend() == "tpu"
-    tok_s_xla, _, compile_s = bench_raw_step(cfg, params,
-                                             use_pallas_decode=False)
-    tok_s, step_s, _ = bench_raw_step(cfg, params, use_pallas_decode=on_tpu)
-    mfu = tok_s * _flops_per_token(cfg, params, CTX) / _bf16_peak_flops(dev)
-    serving_tok_s, prefill_tok_s = bench_serving_path(cfg, params)
+
+    peak = calibrate_peak_flops()
+    tok_s_single, step_s, compile_s = bench_raw_step(
+        cfg, params, use_pallas_decode=on_tpu)
+    window = 8
+    tok_s_win, win_step_s = bench_window(cfg, params, window)
+    raw = max(tok_s_single, tok_s_win)
+    mfu = raw * _flops_per_token(cfg, params, CTX) / peak
+    assert mfu < 1.0, f"impossible MFU {mfu:.3f} (peak {peak/1e12:.0f}e12)"
+
+    serving_tok_s, prefill_cold, prefill_steady = bench_serving_path(
+        cfg, params, decode_window=window)
+    serving_mfu = (serving_tok_s * _flops_per_token(cfg, params, CTX) / peak)
 
     print(json.dumps({
         "metric": "decode_throughput_llama1b_b64_ctx512_serving_geom",
-        "value": round(tok_s, 2),
+        "value": round(raw, 2),
         "unit": "tok/s/chip",
-        "vs_baseline": round(tok_s / REFERENCE_DECODE_TOK_S_PER_DEVICE, 3),
-        "itl_ms": round(1000.0 * step_s, 3),
+        "vs_baseline": round(serving_tok_s / raw, 3) if raw else 0.0,
+        # Per-sequence inter-token latency: every sequence advances one
+        # token per step, so ITL = the step time itself (NOT step/BATCH —
+        # that's 1/throughput, a 64x understatement).
+        "itl_ms": round(1000.0 * min(step_s, win_step_s), 3),
+        "single_step_ms": round(1000.0 * step_s, 3),
+        "window_step_ms": round(1000.0 * win_step_s, 3),
         "mfu": round(mfu, 4),
-        "xla_gather_tok_s": round(tok_s_xla, 2),
         "serving_tok_s": round(serving_tok_s, 2),
-        "prefill_tok_s": round(prefill_tok_s, 2),
+        "serving_mfu": round(serving_mfu, 4),
+        "prefill_tok_s_cold": round(prefill_cold, 2),
+        "prefill_tok_s": round(prefill_steady, 2),
+        "peak_flops_measured": round(peak / 1e12, 1),
         "max_pages_per_seq": MAX_PAGES,
         "warmup_s": round(compile_s, 1),
         "device": str(dev),

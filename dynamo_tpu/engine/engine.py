@@ -102,9 +102,14 @@ class EngineConfig:
     # Fused decode window: K tokens per device dispatch with on-device
     # token feedback, host syncs lagging `pipeline_depth` windows behind.
     # 1 disables (single-step host loop).  Eliminates the per-token
-    # host↔device round-trip (SURVEY §7 decode hard part).
+    # host↔device round-trip (SURVEY §7 decode hard part).  The host→device
+    # sync itself is ASYNC: the token block's device→host copy starts at
+    # dispatch time on a fetch thread, so as long as
+    # pipeline_depth × window × step_time exceeds the transfer round-trip
+    # latency (~160 ms through a tunneled TPU), syncs cost ~0 — r2 synced
+    # in-line and the round-trip swallowed 98% of serving wall-clock.
     decode_window: int = 8
-    window_pipeline_depth: int = 2
+    window_pipeline_depth: int = 8
 
 
 class EngineCore:
@@ -128,23 +133,43 @@ class EngineCore:
 
         if params is None:
             params = init_params(cfg, jax.random.key(config.seed))
+        self._moe = cfg.is_moe
         if self.mesh is not None:
-            params = shard_pytree(params, param_pspecs(cfg), self.mesh)
-            self._step = make_sharded_step(cfg, self.block_size, self.mesh)
+            from dynamo_tpu.parallel.sharding import resolve_moe_mode
+
+            moe_mode = resolve_moe_mode(cfg, self.mesh)
+            params = shard_pytree(params, param_pspecs(cfg, moe_mode),
+                                  self.mesh)
+            self._step = make_sharded_step(
+                cfg, self.block_size, self.mesh, moe_mode,
+                with_expert_load=self._moe)
             cache = shard_pytree(
-                kvc.init_cache(self.cache_cfg), cache_pspecs(), self.mesh)
+                kvc.init_cache(self.cache_cfg),
+                cache_pspecs(cfg.num_layers), self.mesh)
         else:
             pallas = config.use_pallas_decode
             if pallas is None:
                 pallas = jax.default_backend() == "tpu"
             self._step = jax.jit(
                 make_forward_step(cfg, self.block_size,
-                                  use_pallas_decode=pallas),
+                                  use_pallas_decode=pallas,
+                                  with_expert_load=self._moe),
                 donate_argnums=(1,))
             self._use_pallas = pallas
             cache = kvc.init_cache(self.cache_cfg)
+        # Cumulative per-expert assignment counts (MoE telemetry the
+        # worker publishes; reference `base_handlers.py:40-62`).
+        self.expert_load = (np.zeros((cfg.num_experts,), np.int64)
+                            if self._moe else None)
+        self._load_dev = None  # device-side accumulator (lazy sync)
         self._window_fns: Dict[bool, Callable] = {}
         self._inflight: List = []  # dispatched-unsynced decode windows
+        # One thread: fetches are sequential anyway (window N-1 finishes
+        # on device before window N), and ordering keeps _sync_one_window
+        # trivially FIFO.
+        from concurrent.futures import ThreadPoolExecutor
+        self._fetch_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kv-window-fetch")
         self.params = params
         self.cache = cache
 
@@ -272,8 +297,11 @@ class EngineCore:
         return deltas
 
     def _window_eligible(self, plan) -> bool:
+        # MoE models take the single-step path: the window's fori_loop
+        # doesn't thread the expert-load aux (telemetry would go dark).
         return (self.config.decode_window > 1
                 and self.mesh is None
+                and not self._moe
                 and plan.decode is not None
                 and plan.prefill is None
                 and not self.scheduler.waiting)
@@ -297,8 +325,37 @@ class EngineCore:
         ks.kv_active_blocks = (self.allocator.num_blocks - 1
                                - self.allocator.free_blocks)
         ks.gpu_cache_usage_perc = self.allocator.usage
+        if self._moe and self.step_count % 32 == 0:
+            # Periodic (not per-step: each snapshot syncs the device).
+            self.metrics.expert_load = [
+                int(x) for x in self.snapshot_expert_load()]
 
     # -- internals --------------------------------------------------------
+
+    def _run_step(self, tokens, positions, seq_lens, bts, sample_pos):
+        """One device step; accumulates the MoE expert-load aux (when
+        present) ON DEVICE — a per-step device_get here would cost a
+        host↔device round-trip per step.  `snapshot_expert_load()` syncs
+        on demand (metrics pump cadence)."""
+        out = self._step(self.params, self.cache, tokens, positions,
+                         seq_lens, bts, sample_pos)
+        if self._moe:
+            logits, cache, load = out
+            self._load_dev = (load if self._load_dev is None
+                              else self._load_dev + load)
+            return logits, cache
+        return out
+
+    def snapshot_expert_load(self) -> Optional[np.ndarray]:
+        """Cumulative per-expert assignment counts (None for dense
+        models).  Syncs the device accumulator once per call."""
+        if not self._moe:
+            return None
+        if self._load_dev is not None:
+            self.expert_load += np.asarray(jax.device_get(self._load_dev),
+                                           dtype=np.int64)
+            self._load_dev = None
+        return self.expert_load
 
     def _run_prefill_batch(self, batch: PrefillBatch) -> List[TokenDelta]:
         """One device call for ALL scheduled prefill chunks (ragged rows
@@ -322,8 +379,7 @@ class EngineCore:
             n = min(len(req.pages), P)
             bts[i, :n] = req.pages[:n]
 
-        logits, self.cache = self._step(
-            self.params, self.cache,
+        logits, self.cache = self._run_step(
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(seq_lens), jnp.asarray(bts),
             jnp.asarray(sample_pos))
@@ -376,8 +432,7 @@ class EngineCore:
         if not live:
             return []
 
-        logits, self.cache = self._step(
-            self.params, self.cache,
+        logits, self.cache = self._run_step(
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(seq_lens), jnp.asarray(bts),
             jnp.zeros((bucket,), jnp.int32))
@@ -477,6 +532,10 @@ class EngineCore:
             "rids": [r.request_id for r in reqs],
             "reqs": list(reqs),
             "out": out,
+            # Start the device→host copy NOW, off-thread; by the time this
+            # window is synced (pipeline_depth dispatches later) the bytes
+            # have already crossed the wire.
+            "fetch": self._fetch_pool.submit(np.asarray, out),
         })
         if len(self._inflight) > self.config.window_pipeline_depth:
             return self._sync_one_window()
@@ -484,7 +543,7 @@ class EngineCore:
 
     def _sync_one_window(self) -> List[TokenDelta]:
         entry = self._inflight.pop(0)
-        tokens = np.asarray(jax.device_get(entry["out"]))  # [K, bucket]
+        tokens = entry["fetch"].result()                   # [K, bucket]
         deltas: List[TokenDelta] = []
         for i in range(tokens.shape[0]):
             for j, req in enumerate(entry["reqs"]):

@@ -6,10 +6,17 @@ around but does not implement; its block bookkeeping lives in
 page_size, inner_dim, dtype}).  On TPU the cache must be a *static-shape*
 array XLA can reason about, so:
 
-- storage is `[num_layers, num_blocks * block_size, num_kv_heads, head_dim]`
-  per K and V — a flat "slot" axis rather than a blocked one, so both the
-  scatter (write new tokens) and gather (read context) are single
-  `take`/`scatter` ops with precomputed flat indices;
+- storage is PER-LAYER arrays `[num_blocks * block_size, num_kv_heads,
+  head_dim]` for K and V — a flat "slot" axis rather than a blocked one,
+  so both the scatter (write new tokens) and gather (read context) are
+  single `take`/`scatter` ops with precomputed flat indices.  Layers are
+  separate buffers, NOT one stacked [L, S, H, D] array: each layer's
+  update is then an independent in-place scatter XLA can alias under
+  donation and inside `fori_loop` carries, and the Pallas decode kernel
+  reads the layer buffer directly in HBM.  (r2 stacked the layers; every
+  layer update sliced + wrote back the whole array and every kernel call
+  materialised its layer slice — the decode step ran ~15x over its HBM
+  floor.);
 - block 0 is reserved as the *null block*: padded block-table entries point
   at it, and its contents are never read unmasked;
 - sharding: `num_kv_heads` over the `tp` mesh axis (head-sharded cache means
@@ -77,11 +84,13 @@ class KvCacheConfig:
 
 
 def init_cache(cfg: KvCacheConfig) -> dict:
-    """Allocate the cache pytree: {'k': [L, S, H, D], 'v': [L, S, H, D]}."""
-    shape = (cfg.num_layers, cfg.num_slots, cfg.num_kv_heads, cfg.head_dim)
+    """Allocate the cache pytree: {'k': [L x [S, H, D]], 'v': [L x [S, H, D]]}
+    — per-layer buffers (see module docstring for why not one stacked
+    array)."""
+    shape = (cfg.num_slots, cfg.num_kv_heads, cfg.head_dim)
     return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+        "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
+        "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
     }
 
 
@@ -152,18 +161,24 @@ def make_block_ops(block_size: int):
 
     def extract(cache: dict, page: jax.Array) -> jax.Array:
         start = page * block_size
-        k = jax.lax.dynamic_slice_in_dim(cache["k"], start, block_size, axis=1)
-        v = jax.lax.dynamic_slice_in_dim(cache["v"], start, block_size, axis=1)
+        k = jnp.stack([
+            jax.lax.dynamic_slice_in_dim(layer, start, block_size, axis=0)
+            for layer in cache["k"]])
+        v = jnp.stack([
+            jax.lax.dynamic_slice_in_dim(layer, start, block_size, axis=0)
+            for layer in cache["v"]])
         return jnp.stack([k, v])
 
     def inject(cache: dict, page: jax.Array, data: jax.Array) -> dict:
         start = page * block_size
-        data = data.astype(cache["k"].dtype)
+        data = data.astype(cache["k"][0].dtype)
         return {
-            "k": jax.lax.dynamic_update_slice_in_dim(
-                cache["k"], data[0], start, axis=1),
-            "v": jax.lax.dynamic_update_slice_in_dim(
-                cache["v"], data[1], start, axis=1),
+            "k": [jax.lax.dynamic_update_slice_in_dim(
+                      layer, data[0, i], start, axis=0)
+                  for i, layer in enumerate(cache["k"])],
+            "v": [jax.lax.dynamic_update_slice_in_dim(
+                      layer, data[1, i], start, axis=0)
+                  for i, layer in enumerate(cache["v"])],
         }
 
     return jax.jit(extract), jax.jit(inject, donate_argnums=(0,))

@@ -1,0 +1,250 @@
+"""Disaggregated prefill/decode orchestration.
+
+The reference's headline feature (`docs/architecture/disagg_serving.md:
+12-64`): long prefills run on dedicated prefill workers so decode batches
+never stall behind them; KV crosses workers on the data plane.  The
+components, mapped onto our runtime:
+
+- **DisaggRouter** (decode-side admission) — the conditional local/remote
+  decision with a control-plane-watched threshold, the analog of
+  `lib/llm/src/disagg_router.rs:25-50` (`DisaggRouterConf
+  {max_local_prefill_length}` read + hot-reloaded from etcd).
+- **Prefill queue** — an acked work queue on the control plane (the
+  reference's NATS JetStream `NatsQueue`, `transports/nats.rs:360`):
+  at-least-once, so a prefill worker dying mid-job redelivers rather than
+  losing the request.
+- **prefill_worker_loop** — pops jobs, runs the prompt through the local
+  engine (one token, discarded), which seals + registers the prompt's KV
+  blocks; then announces completion with its RPC address.
+- **DisaggDecodeClient** — decode-side EngineClient wrapper: long prompts
+  are enqueued for remote prefill, completion is awaited, the sealed
+  blocks are pulled over the kv_blocks data plane
+  (block_manager/transfer.py `pull_prefix`), and only then does the local
+  engine run — whose prefix-cache match skips everything but the last
+  partial block.  Remote failure (timeout, dead prefill worker) falls
+  back to local prefill: disagg is an optimisation, never a correctness
+  dependency (the reference decode handler behaves the same,
+  `components/backends/vllm/src/dynamo/vllm/handlers.py:113-146`).
+
+Streaming TTFT is preserved: the decode worker's stream opens immediately;
+the first token arrives after remote-prefill + pull, which replaces the
+(longer) local prefill the client would otherwise wait on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import AsyncIterator, Dict, Optional
+
+from dynamo_tpu.engine.engine import TokenDelta
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.llm.block_manager.transfer import pull_prefix
+from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime.rpc import RpcClient, RpcError
+
+logger = logging.getLogger(__name__)
+
+PREFILL_DONE_SUBJECT = "prefill_done"
+
+
+def prefill_queue_name(namespace: str) -> str:
+    return f"{namespace}/prefill_queue"
+
+
+def disagg_config_key(namespace: str) -> str:
+    return f"disagg/{namespace}/config"
+
+
+@dataclass
+class DisaggConfig:
+    """`max_local_prefill_length`: prompts longer than this (in tokens)
+    prefill remotely; None disables disagg (reference DisaggRouterConf)."""
+
+    max_local_prefill_length: Optional[int] = None
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> "DisaggConfig":
+        if not d:
+            return DisaggConfig()
+        return DisaggConfig(
+            max_local_prefill_length=d.get("max_local_prefill_length"))
+
+
+class DisaggRouter:
+    """Decode-side local/remote prefill decision, hot-reloaded from the
+    control plane (the reference watches the etcd key,
+    `disagg_router.rs:38-60`)."""
+
+    def __init__(self, cp, namespace: str) -> None:
+        self.cp = cp
+        self.namespace = namespace
+        self.config = DisaggConfig()
+        self._watch = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        key = disagg_config_key(self.namespace)
+        self.config = DisaggConfig.from_dict(await self.cp.get(key))
+        self._watch = await self.cp.watch_prefix(key)
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._watch:
+            self._watch.cancel()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _loop(self) -> None:
+        async for ev in self._watch:
+            self.config = DisaggConfig.from_dict(
+                ev.value if ev.kind == "put" else None)
+            logger.info("disagg config now %s", self.config)
+
+    def prefill_remotely(self, prompt_len: int) -> bool:
+        limit = self.config.max_local_prefill_length
+        return limit is not None and prompt_len > limit
+
+
+async def prefill_worker_loop(cp, namespace: str, engine_client,
+                              address: str, *,
+                              visibility_timeout: float = 60.0) -> None:
+    """The prefill worker's service loop (role=prefill).
+
+    Pop → prefill (max_tokens=1, output discarded; the engine seals and
+    registers every full prompt block) → announce → ack.  Ack comes LAST:
+    a crash mid-prefill redelivers the job to a surviving prefill worker
+    (at-least-once; re-prefilling an already-sealed prompt is a cheap
+    prefix-cache hit)."""
+    queue = prefill_queue_name(namespace)
+    while True:
+        # The whole iteration is guarded: an unhandled exception here
+        # (control-plane hiccup during pop/publish/ack) would silently
+        # kill the create_task'd loop and orphan the queue forever.
+        try:
+            msg_id, job = await cp.queue_pop(queue, visibility_timeout)
+            rid = job["request_id"]
+            t0 = time.monotonic()
+            try:
+                req = PreprocessedRequest(
+                    request_id=f"prefill-{rid}",
+                    model=job.get("model", ""),
+                    token_ids=list(job["token_ids"]),
+                    sampling=SamplingParams(max_tokens=1),
+                )
+                async for _ in engine_client.generate(req):
+                    pass
+            except Exception:
+                logger.exception("prefill job %s failed (will redeliver)",
+                                 rid)
+                continue  # no ack: redelivery after visibility timeout
+            await cp.publish(PREFILL_DONE_SUBJECT, {
+                "request_id": rid,
+                "address": address,
+                "prefill_s": time.monotonic() - t0,
+            })
+            await cp.queue_ack(queue, msg_id)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("prefill loop: control-plane error; retrying")
+            await asyncio.sleep(1.0)
+
+
+class DisaggDecodeClient:
+    """EngineClient for a decode-role worker: remote-prefill admission in
+    front of the local engine."""
+
+    def __init__(self, inner, engine, cp, namespace: str,
+                 block_size: int, *,
+                 prefill_timeout: float = 120.0) -> None:
+        """`inner`: the local EngineClient; `engine`: the InferenceEngine
+        (import_blocks side of the data plane)."""
+        self.inner = inner
+        self.engine = engine
+        self.cp = cp
+        self.namespace = namespace
+        self.block_size = block_size
+        self.prefill_timeout = prefill_timeout
+        self._waiters: Dict[str, asyncio.Future] = {}
+        self._rpc_clients: Dict[str, RpcClient] = {}
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+        self.router = DisaggRouter(cp, namespace)
+        # Observability: how disagg admission went (metrics + tests).
+        self.remote_prefills = 0
+        self.local_fallbacks = 0
+        self.tokens_onboarded = 0
+
+    async def start(self) -> None:
+        await self.router.start()
+        self._sub = await self.cp.subscribe(PREFILL_DONE_SUBJECT)
+        self._task = asyncio.create_task(self._done_loop())
+
+    async def stop(self) -> None:
+        await self.router.stop()
+        if self._sub:
+            self._sub.cancel()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        for c in self._rpc_clients.values():
+            await c.close()
+
+    async def _done_loop(self) -> None:
+        async for msg in self._sub:
+            fut = self._waiters.pop(msg.get("request_id", ""), None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+
+    def _rpc(self, address: str) -> RpcClient:
+        client = self._rpc_clients.get(address)
+        if client is None:
+            client = self._rpc_clients[address] = RpcClient(address)
+        return client
+
+    async def _remote_prefill(self, request: PreprocessedRequest) -> None:
+        rid = request.request_id
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters[rid] = fut
+        try:
+            await self.cp.queue_push(prefill_queue_name(self.namespace), {
+                "request_id": rid,
+                "model": request.model,
+                "token_ids": list(request.token_ids),
+            })
+            done = await asyncio.wait_for(fut, self.prefill_timeout)
+            onboarded = await pull_prefix(
+                self.engine, self._rpc(done["address"]),
+                list(request.token_ids), self.block_size)
+            self.remote_prefills += 1
+            self.tokens_onboarded += onboarded
+            logger.info("remote prefill %s: %d tokens onboarded from %s",
+                        rid, onboarded, done["address"])
+        except (asyncio.TimeoutError, ConnectionError, OSError,
+                RpcError) as e:
+            # RpcError: the peer's kv_blocks handler failed (e.g. blocks
+            # evicted between announce and pull) — disagg is an
+            # optimisation, never a correctness dependency.
+            self.local_fallbacks += 1
+            logger.warning("remote prefill %s failed (%s); prefilling "
+                           "locally", rid, e)
+        finally:
+            self._waiters.pop(rid, None)
+
+    async def generate(
+        self, request: PreprocessedRequest
+    ) -> AsyncIterator[TokenDelta]:
+        if self.router.prefill_remotely(len(request.token_ids)):
+            await self._remote_prefill(request)
+        async for delta in self.inner.generate(request):
+            yield delta

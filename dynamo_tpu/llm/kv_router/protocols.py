@@ -128,11 +128,15 @@ class SpecDecodeStats:
 @dataclass
 class ForwardPassMetrics:
     """Per-forward-pass load snapshot published by every worker
-    (reference `publisher.rs` ForwardPassMetrics)."""
+    (reference `publisher.rs` ForwardPassMetrics).  `expert_load` carries
+    the cumulative per-expert token-assignment counts for MoE engines
+    (the expert-distribution surface of reference
+    `sglang/common/base_handlers.py:40-62`); None for dense models."""
 
     worker_stats: WorkerStats = field(default_factory=WorkerStats)
     kv_stats: KvStats = field(default_factory=KvStats)
     spec_decode_stats: Optional[SpecDecodeStats] = None
+    expert_load: Optional[List[int]] = None
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -144,4 +148,5 @@ class ForwardPassMetrics:
             worker_stats=WorkerStats(**d.get("worker_stats", {})),
             kv_stats=KvStats(**d.get("kv_stats", {})),
             spec_decode_stats=SpecDecodeStats(**spec) if spec else None,
+            expert_load=d.get("expert_load"),
         )

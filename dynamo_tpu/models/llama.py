@@ -23,11 +23,9 @@ Forward contract (unified prefill/decode, see dynamo_tpu/ops/attention.py):
   attends to all cached context with an absolute-position causal mask, so
   the same compiled function serves prefill, chunked prefill and decode.
 
-MoE layers use expert-sharded dense compute: every device runs its local
-experts on all tokens and combines with top-k gate weights (zero for
-non-selected experts); under an `ep` mesh axis the expert dimension shards
-and the combine is a `psum`.  (All-to-all token dispatch is the planned
-refinement — see dynamo_tpu/parallel.)
+MoE layers run either exact dense compute (oracle / single chip) or
+all-to-all token dispatch over the `ep` mesh axis (ops/moe.py) — see
+`_moe_block`.
 """
 
 from __future__ import annotations
@@ -130,7 +128,6 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 def _attention_block(
     cfg: ModelConfig,
     p_attn: Params,
-    layer_idx: int,
     x: jax.Array,            # [B, T, H]
     positions: jax.Array,    # [B, T]
     seq_lens: jax.Array,     # [B]
@@ -139,8 +136,12 @@ def _attention_block(
     kv_positions,            # [B, C], or None
     block_tables: jax.Array, # [B, P]
     block_size: int,
-    cache: Dict,
-) -> Tuple[jax.Array, Dict]:
+    k_cache: jax.Array,      # [S, Hkv, D] this layer's cache buffer
+    v_cache: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (attn_out, k_cache', v_cache').  The layer cache buffers are
+    standalone arrays (not slices of a stacked cache) so the scatter in
+    `write_kv` aliases in place under donation / loop carries."""
     B, T, _ = x.shape
     q = (x @ p_attn["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
     k = (x @ p_attn["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
@@ -150,16 +151,12 @@ def _attention_block(
     k = rope(k, positions, cfg.rope_theta)
 
     k_layer, v_layer = kvc.write_kv(
-        cache["k"][layer_idx],
-        cache["v"][layer_idx],
+        k_cache,
+        v_cache,
         write_slots,
         k.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
         v.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
     )
-    cache = {
-        "k": cache["k"].at[layer_idx].set(k_layer),
-        "v": cache["v"].at[layer_idx].set(v_layer),
-    }
 
     if ctx_slots is None:
         # Decode hot path: stream pages via the Pallas kernel — no
@@ -176,33 +173,42 @@ def _attention_block(
         out = paged_attention(q, k_ctx, v_ctx, positions, kv_positions,
                               seq_lens)
     out = out.reshape(B, T, cfg.q_size) @ p_attn["wo"]
-    return out, cache
+    return out, k_layer, v_layer
 
 
 def _dense_mlp(p: Params, x: jax.Array) -> jax.Array:
     return (jax.nn.silu(x @ p["w_gate"]) * (x @ p["w_up"])) @ p["w_down"]
 
 
-def _moe_mlp(cfg: ModelConfig, p: Params, x: jax.Array) -> jax.Array:
-    """Top-k gated MoE, expert-sharded dense compute.
+def _moe_block(cfg: ModelConfig, p: Params, x: jax.Array,
+               moe_mode: str, mesh) -> Tuple[jax.Array, jax.Array]:
+    """One MoE layer → (out, expert_load [E]).
 
-    gates: [B, T, E] with zeros outside the top-k, renormalised over the
-    selected experts (Mixtral convention).  Expert matmuls carry an explicit
-    E axis so an `ep` mesh axis shards them; the final einsum contracts E
-    (→ psum under shard_map).
-    """
-    B, T, H = x.shape
-    logits = (x @ p["router"]).astype(jnp.float32)          # [B, T, E]
-    k = cfg.num_experts_per_token
-    top_vals, _ = jax.lax.top_k(logits, k)
-    kth = top_vals[..., -1:]
-    masked = jnp.where(logits >= kth, logits, -jnp.inf)
-    gates = jax.nn.softmax(masked, axis=-1).astype(x.dtype)  # [B, T, E]
+    moe_mode "dense": exact dense-compute (oracle; expert einsums carry an
+    explicit E axis so an `ep` mesh axis can shard them under GSPMD).
+    moe_mode "dispatch": all-to-all token dispatch under shard_map over the
+    mesh's dp/ep axes (ops/moe.py) — the E/k FLOP waste of dense compute
+    goes away; requires tp == 1 (validated in parallel/sharding.py)."""
+    from dynamo_tpu.ops import moe as moe_ops
 
-    hidden = jax.nn.silu(jnp.einsum("bth,ehf->betf", x, p["w_gate"]))
-    hidden = hidden * jnp.einsum("bth,ehf->betf", x, p["w_up"])
-    expert_out = jnp.einsum("betf,efh->beth", hidden, p["w_down"])
-    return jnp.einsum("beth,bte->bth", expert_out, gates)
+    if moe_mode == "dense" or mesh is None:
+        return moe_ops.moe_dense(cfg, p, x)
+
+    from jax.sharding import PartitionSpec as P
+
+    wrapped = jax.shard_map(
+        lambda xs, ps: moe_ops.moe_dispatch(
+            cfg, ps, xs, ep_axis="ep", load_psum_axes=("dp", "ep")),
+        mesh=mesh,
+        in_specs=(P(("dp", "ep"), None, None),
+                  {"router": P(None, None),
+                   "w_gate": P("ep", None, None),
+                   "w_up": P("ep", None, None),
+                   "w_down": P("ep", None, None)}),
+        out_specs=(P(("dp", "ep"), None, None), P(None)),
+        check_vma=False,
+    )
+    return wrapped(x, p)
 
 
 # ---------------------------------------------------------------------------
@@ -268,7 +274,10 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
 
 
 def make_forward_step(cfg: ModelConfig, block_size: int,
-                      use_pallas_decode: bool = False):
+                      use_pallas_decode: bool = False,
+                      moe_mode: str = "dense",
+                      mesh=None,
+                      with_expert_load: bool = False):
     """Build the jitted unified step for a given cache geometry.
 
     Separate factory (rather than passing block_size as a traced value)
@@ -277,6 +286,12 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
     attention through the Pallas paged-decode kernel instead of the
     gathered-context XLA path (chunk length is static at trace time, so
     the same factory serves both prefill and decode compilations).
+
+    MoE: `moe_mode` "dense" (exact oracle) or "dispatch" (all-to-all over
+    the mesh's ep axis — needs `mesh`).  `with_expert_load=True` makes the
+    step return (logits, cache, expert_load[E]) — the telemetry the
+    reference exposes per worker (`base_handlers.py:40-62`); the default
+    2-tuple return keeps every non-MoE call site unchanged.
     """
     cfg.validate()
 
@@ -306,18 +321,24 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                 block_tables, ctx_positions, block_size)
 
         x = jnp.take(params["embed"], tokens, axis=0)
+        k_layers = list(cache["k"])
+        v_layers = list(cache["v"])
+        expert_load = jnp.zeros((max(cfg.num_experts, 1),), jnp.int32)
         for i, layer in enumerate(params["layers"]):
-            attn_out, cache = _attention_block(
-                cfg, layer["attn"], i,
+            attn_out, k_layers[i], v_layers[i] = _attention_block(
+                cfg, layer["attn"],
                 rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps),
                 positions, seq_lens, write_slots, ctx_slots, ctx_positions,
                 block_tables, block_size,
-                cache,
+                k_layers[i], v_layers[i],
             )
             x = x + attn_out
             h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
             if cfg.is_moe:
-                x = x + _moe_mlp(cfg, layer["moe"], h)
+                moe_out, load = _moe_block(cfg, layer["moe"], h,
+                                           moe_mode, mesh)
+                x = x + moe_out
+                expert_load = expert_load + load
             else:
                 x = x + _dense_mlp(layer["mlp"], h)
 
@@ -334,6 +355,9 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
         if head is None:
             head = params["embed"].T
         logits = (x @ head).astype(jnp.float32)
-        return logits, cache
+        new_cache = {"k": k_layers, "v": v_layers}
+        if with_expert_load:
+            return logits, new_cache, expert_load
+        return logits, new_cache
 
     return step

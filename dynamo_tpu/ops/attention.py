@@ -57,12 +57,16 @@ def paged_attention(
     # the reshape keeps kv as the SLOW axis.  (An interleaved reshape is
     # self-consistent for random weights but silently wrong for real
     # checkpoints.)
-    qg = q.reshape(B, T, Hkv, G, D).astype(jnp.float32)
-    kf = k_ctx.astype(jnp.float32)
-    vf = v_ctx.astype(jnp.float32)
+    #
+    # K/V stay in cache dtype (bf16 on TPU) with f32 MXU accumulation —
+    # casting the gathered context to f32 (r2) materialised 2x the bytes
+    # per layer per step for no accuracy the f32 accumulator doesn't
+    # already provide.  Softmax itself runs in f32.
+    qg = q.reshape(B, T, Hkv, G, D)
 
     # [B, Hkv, G, T, C]
-    scores = jnp.einsum("btkgd,bckd->bkgtc", qg, kf) * scale
+    scores = jnp.einsum("btkgd,bckd->bkgtc", qg, k_ctx,
+                        preferred_element_type=jnp.float32) * scale
     if soft_cap is not None:
         scores = soft_cap * jnp.tanh(scores / soft_cap)
 
@@ -75,7 +79,8 @@ def paged_attention(
     # Fully-masked rows (padding queries) produce uniform probs over junk;
     # callers discard padding-token outputs, so no NaN guard is needed
     # beyond softmax's own max-subtraction.
-    out = jnp.einsum("bkgtc,bckd->btkgd", probs, vf)
+    out = jnp.einsum("bkgtc,bckd->btkgd", probs.astype(v_ctx.dtype), v_ctx,
+                     preferred_element_type=jnp.float32)
     return out.reshape(B, T, Hq, D).astype(q.dtype)
 
 

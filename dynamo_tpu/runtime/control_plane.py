@@ -13,8 +13,11 @@ is the contract:
   `instances/{namespace}/{component}/{endpoint}:{lease}` exactly like the
   reference's path scheme (`component.rs:72-75`).
 - **Pub/sub**: fire-and-forget subjects (KV events, metrics).
-- **Work queues**: at-most-once pop with blocking waiters (prefill queue,
-  `disagg_serving.md:62-64`).
+- **Work queues**: at-least-once delivery with acks (the JetStream
+  `NatsQueue` semantics the disagg prefill queue rides on,
+  `disagg_serving.md:62-64`): `queue_pop` leases an item to the consumer
+  under a visibility timeout; `queue_ack` settles it; an un-acked item
+  (consumer died mid-prefill) is redelivered to the next popper.
 
 Two transports share `ControlPlaneState` (the single source of truth):
 `InProcessControlPlane` binds it directly (single-process serving, tests);
@@ -61,6 +64,9 @@ class ControlPlaneState:
         self._watchers: List[Tuple[str, asyncio.Queue]] = []  # (prefix, q)
         self._subs: Dict[str, List[asyncio.Queue]] = {}       # subject → qs
         self._queues: Dict[str, asyncio.Queue] = {}           # work queues
+        self._queue_msg_seq = itertools.count(1)
+        # (queue, msg_id) → (payload, redelivery deadline)
+        self._inflight_msgs: Dict[Tuple[str, int], Tuple[dict, float]] = {}
         self._reaper: Optional[asyncio.Task] = None
 
     # -- leases -----------------------------------------------------------
@@ -96,6 +102,7 @@ class ControlPlaneState:
         while True:
             await asyncio.sleep(interval)
             self.expire_leases()
+            self.redeliver_expired()
 
     # -- kv ---------------------------------------------------------------
 
@@ -159,11 +166,37 @@ class ControlPlaneState:
 
     # -- work queues ------------------------------------------------------
 
-    def queue_push(self, name: str, payload: dict) -> None:
-        self._queues.setdefault(name, asyncio.Queue()).put_nowait(payload)
+    def _queue(self, name: str) -> asyncio.Queue:
+        return self._queues.setdefault(name, asyncio.Queue())
 
-    async def queue_pop(self, name: str) -> dict:
-        return await self._queues.setdefault(name, asyncio.Queue()).get()
+    def queue_push(self, name: str, payload: dict) -> None:
+        msg_id = next(self._queue_msg_seq)
+        self._queue(name).put_nowait((msg_id, payload))
+
+    async def queue_pop(self, name: str,
+                        visibility_timeout: float = 30.0) -> Tuple[int, dict]:
+        """Lease the next item: (msg_id, payload).  The caller must
+        `queue_ack(name, msg_id)` before the visibility timeout or the
+        item is redelivered (at-least-once; reference `NatsQueue` ack
+        model, `transports/nats.rs:360`)."""
+        msg_id, payload = await self._queue(name).get()
+        self._inflight_msgs[(name, msg_id)] = (
+            payload, time.monotonic() + visibility_timeout)
+        return msg_id, payload
+
+    def queue_ack(self, name: str, msg_id: int) -> bool:
+        return self._inflight_msgs.pop((name, msg_id), None) is not None
+
+    def redeliver_expired(self) -> int:
+        now = time.monotonic()
+        expired = [k for k, (_, dl) in self._inflight_msgs.items()
+                   if dl < now]
+        for name, msg_id in expired:
+            payload, _ = self._inflight_msgs.pop((name, msg_id))
+            logger.warning("queue %s: redelivering un-acked msg %d",
+                           name, msg_id)
+            self._queue(name).put_nowait((msg_id, payload))
+        return len(expired)
 
     def queue_len(self, name: str) -> int:
         q = self._queues.get(name)
@@ -251,8 +284,12 @@ class InProcessControlPlane:
     async def queue_push(self, name: str, payload: dict) -> None:
         self.state.queue_push(name, payload)
 
-    async def queue_pop(self, name: str) -> dict:
-        return await self.state.queue_pop(name)
+    async def queue_pop(self, name: str,
+                        visibility_timeout: float = 30.0) -> Tuple[int, dict]:
+        return await self.state.queue_pop(name, visibility_timeout)
+
+    async def queue_ack(self, name: str, msg_id: int) -> bool:
+        return self.state.queue_ack(name, msg_id)
 
     async def queue_len(self, name: str) -> int:
         return self.state.queue_len(name)

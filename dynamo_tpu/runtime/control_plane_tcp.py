@@ -146,11 +146,17 @@ class ControlPlaneServer:
                         res = {}
                     elif op == "queue_pop":
                         # Async pop: reply comes whenever an item arrives.
-                        async def do_pop(mid=mid, name=msg["queue"]):
-                            item = await st.queue_pop(name)
-                            await send({"id": mid, "ok": True, "payload": item})
+                        async def do_pop(mid=mid, name=msg["queue"],
+                                         vt=msg.get("visibility_timeout",
+                                                    30.0)):
+                            msg_id, item = await st.queue_pop(name, vt)
+                            await send({"id": mid, "ok": True,
+                                        "msg_id": msg_id, "payload": item})
                         pumps.append(asyncio.create_task(do_pop()))
                         continue
+                    elif op == "queue_ack":
+                        res = {"acked": st.queue_ack(msg["queue"],
+                                                     msg["msg_id"])}
                     elif op == "queue_len":
                         res = {"n": st.queue_len(msg["queue"])}
                     else:
@@ -382,8 +388,15 @@ class ControlPlaneClient:
     async def queue_push(self, name: str, payload: dict) -> None:
         await self._call("queue_push", queue=name, payload=payload)
 
-    async def queue_pop(self, name: str) -> dict:
-        return (await self._call("queue_pop", queue=name))["payload"]
+    async def queue_pop(self, name: str,
+                        visibility_timeout: float = 30.0):
+        msg = await self._call("queue_pop", queue=name,
+                               visibility_timeout=visibility_timeout)
+        return msg["msg_id"], msg["payload"]
+
+    async def queue_ack(self, name: str, msg_id: int) -> bool:
+        return (await self._call("queue_ack", queue=name,
+                                 msg_id=msg_id))["acked"]
 
     async def queue_len(self, name: str) -> int:
         return (await self._call("queue_len", queue=name))["n"]

@@ -35,6 +35,17 @@ def parse_args(argv=None):
     p.add_argument("--component", default="backend")
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--model-name", default="dynamo-tpu")
+    p.add_argument("--role", choices=("both", "prefill", "decode"),
+                   default="both",
+                   help="disaggregated P/D role: 'prefill' serves the "
+                        "prefill queue only (no model registration); "
+                        "'decode' registers the model and sends long "
+                        "prompts to the prefill queue; 'both' = aggregated")
+    p.add_argument("--max-local-prefill", type=int, default=None,
+                   help="decode role: write the disagg threshold (tokens) "
+                        "to the control plane at startup; prompts longer "
+                        "than this prefill remotely.  The key is watched, "
+                        "so operators can retune it live.")
     p.add_argument("--mocker", action="store_true")
     p.add_argument("--model", default=None,
                    help="model preset name (random weights) or HF-layout "
@@ -89,8 +100,14 @@ async def run(args) -> None:
     cp = ControlPlaneClient(*_split(args.control_plane))
     await cp.start()
     runtime = DistributedRuntime(cp)
+    # Prefill workers live under their own component so the frontend's
+    # per-model clients (which watch the decode endpoint's instance
+    # prefix) never route decode traffic to them — the reference's
+    # separate prefill component (disagg_serving.md:62-64).
+    component = (f"{args.component}-prefill" if args.role == "prefill"
+                 else args.component)
     endpoint = (runtime.namespace(args.namespace)
-                .component(args.component).endpoint(args.endpoint))
+                .component(component).endpoint(args.endpoint))
 
     loop = asyncio.get_running_loop()
     pending_events: list = []
@@ -107,13 +124,46 @@ async def run(args) -> None:
 
         runtime.rpc.register(KV_BLOCKS_ENDPOINT,
                              make_kv_blocks_handler(transfer_engine))
-    instance = await endpoint.serve(engine_wire_handler(engine))
-    card = ModelDeploymentCard(name=args.model_name,
-                               kv_block_size=args.block_size,
-                               **card_fields)
-    await register_llm(endpoint, instance, card)
-    print(f"worker instance {instance.instance_id} serving "
-          f"{args.model_name!r} at {instance.address}", flush=True)
+
+    disagg_client = None
+    prefill_task = None
+    if args.role != "both" and transfer_engine is None:
+        # The mocker has no real KV bytes to serve or pull — disagg roles
+        # are meaningless for it.  Refuse loudly rather than serve
+        # aggregated while the operator believes disagg is on.
+        raise SystemExit(
+            f"--role {args.role} requires a real engine (the mocker has "
+            "no KV data plane); drop --role or --mocker")
+    if args.role == "decode":
+        from dynamo_tpu.llm.disagg import DisaggDecodeClient, disagg_config_key
+
+        if args.max_local_prefill is not None:
+            await cp.put(disagg_config_key(args.namespace),
+                         {"max_local_prefill_length": args.max_local_prefill})
+        disagg_client = DisaggDecodeClient(
+            engine, transfer_engine, cp, args.namespace, args.block_size)
+        await disagg_client.start()
+        serve_client = disagg_client
+    else:
+        serve_client = engine
+
+    instance = await endpoint.serve(engine_wire_handler(serve_client))
+    if args.role == "prefill":
+        # Prefill workers serve the queue, not the routed model: no
+        # register_llm, so frontends never route decode traffic here
+        # (reference prefill workers register under their own component,
+        # disagg_serving.md:62-64).
+        from dynamo_tpu.llm.disagg import prefill_worker_loop
+
+        prefill_task = asyncio.create_task(prefill_worker_loop(
+            cp, args.namespace, engine, instance.address))
+    else:
+        card = ModelDeploymentCard(name=args.model_name,
+                                   kv_block_size=args.block_size,
+                                   **card_fields)
+        await register_llm(endpoint, instance, card)
+    print(f"worker instance {instance.instance_id} role={args.role} "
+          f"serving {args.model_name!r} at {instance.address}", flush=True)
 
     async def pump_events():
         while True:
@@ -145,6 +195,10 @@ async def run(args) -> None:
         await asyncio.sleep(0.05)
     for t in pumps:
         t.cancel()
+    if prefill_task:
+        prefill_task.cancel()
+    if disagg_client is not None:
+        await disagg_client.stop()
     await shutdown()
     await runtime.shutdown()
     await cp.close()

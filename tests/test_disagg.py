@@ -1,0 +1,179 @@
+"""Disaggregated P/D e2e: prefill worker + decode worker + acked queue.
+
+The round-3 milestone VERDICT asked for: long prompts measurably skip
+decode-side prefill (asserted via the decode engine's onboard/hit
+counters), short prompts stay local, remote failure falls back to local
+prefill, and the threshold hot-reloads from the control plane.  Mirrors
+`/root/reference/docs/architecture/disagg_serving.md:20-64`.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.engine import EngineConfig, EngineCore, InferenceEngine
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.llm.block_manager.transfer import (
+    KV_BLOCKS_ENDPOINT,
+    make_kv_blocks_handler,
+)
+from dynamo_tpu.llm.disagg import (
+    DisaggDecodeClient,
+    disagg_config_key,
+    prefill_queue_name,
+    prefill_worker_loop,
+)
+from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+from dynamo_tpu.llm.service import LocalEngineClient
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.runtime.control_plane import InProcessControlPlane
+from dynamo_tpu.runtime.rpc import RpcServer
+
+TINY = mcfg.get_config("tiny-test")
+BS = 8
+NS = "test-disagg"
+
+
+def _core():
+    return EngineCore(EngineConfig(
+        model=TINY, num_blocks=64,
+        scheduler=SchedulerConfig(
+            max_seqs=4, block_size=BS, max_pages_per_seq=8,
+            max_prefill_chunk=16,
+            decode_buckets=(1, 2, 4), prefill_buckets=(8, 16))))
+
+
+class _Worker:
+    """One in-process worker: engine + RPC server with kv_blocks."""
+
+    async def start(self):
+        self.engine = InferenceEngine(_core())
+        await self.engine.start()
+        self.client = LocalEngineClient(self.engine)
+        self.rpc = RpcServer()
+        self.rpc.register(KV_BLOCKS_ENDPOINT,
+                          make_kv_blocks_handler(self.engine))
+        self.address = await self.rpc.start()
+        return self
+
+    async def stop(self):
+        await self.rpc.stop()
+        await self.engine.stop()
+
+
+async def _collect(client, rid, prompt, n=4):
+    req = PreprocessedRequest(request_id=rid, model="m",
+                              token_ids=list(prompt),
+                              sampling=SamplingParams(max_tokens=n))
+    out = []
+    async for d in client.generate(req):
+        out.extend(d.token_ids)
+        if d.finished:
+            break
+    return out
+
+
+def _run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+def test_disagg_long_prompt_skips_decode_prefill():
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        await cp.put(disagg_config_key(NS), {"max_local_prefill_length": 12})
+
+        prefill = await _Worker().start()
+        decode = await _Worker().start()
+        ploop = asyncio.create_task(prefill_worker_loop(
+            cp, NS, prefill.client, prefill.address))
+
+        dec = DisaggDecodeClient(decode.client, decode.engine, cp, NS, BS)
+        await dec.start()
+        try:
+            # Reference output: same prompt served aggregated on a fresh
+            # engine (prefill + decode in one place).
+            ref = await _Worker().start()
+            long_prompt = list(range(1, 28))  # 3 sealed blocks + tail
+            want = await _collect(ref.client, "ref", long_prompt)
+            await ref.stop()
+
+            got = await _collect(dec, "r1", long_prompt)
+            assert got == want
+            assert dec.remote_prefills == 1 and dec.local_fallbacks == 0
+            # 3 sealed blocks pulled from the prefill worker.
+            assert dec.tokens_onboarded == 24
+            mgr = decode.engine.core.allocator.manager
+            assert mgr.onboarded_blocks == 3
+            # Decode-side prefix hit: only the tail was prefilled locally.
+            assert mgr.device.hits >= 3
+            # The queue item was acked (nothing left in flight).
+            assert await cp.queue_len(prefill_queue_name(NS)) == 0
+            assert not cp.state._inflight_msgs
+
+            # Short prompt: stays local, no extra remote prefill.
+            short = list(range(100, 108))
+            got_short = await _collect(dec, "r2", short)
+            ref2 = await _Worker().start()
+            assert got_short == await _collect(ref2.client, "ref2", short)
+            await ref2.stop()
+            assert dec.remote_prefills == 1  # unchanged
+        finally:
+            ploop.cancel()
+            await dec.stop()
+            await prefill.stop()
+            await decode.stop()
+            await cp.close()
+
+    _run(main())
+
+
+def test_disagg_falls_back_when_no_prefill_worker():
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        await cp.put(disagg_config_key(NS), {"max_local_prefill_length": 12})
+        decode = await _Worker().start()
+        dec = DisaggDecodeClient(decode.client, decode.engine, cp, NS, BS,
+                                 prefill_timeout=0.3)
+        await dec.start()
+        try:
+            long_prompt = list(range(1, 28))
+            ref = await _Worker().start()
+            want = await _collect(ref.client, "ref", long_prompt)
+            await ref.stop()
+            got = await _collect(dec, "r1", long_prompt)
+            assert got == want
+            assert dec.local_fallbacks == 1
+        finally:
+            await dec.stop()
+            await decode.stop()
+            await cp.close()
+
+    _run(main())
+
+
+def test_disagg_threshold_hot_reload():
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        decode = await _Worker().start()
+        dec = DisaggDecodeClient(decode.client, decode.engine, cp, NS, BS)
+        await dec.start()
+        try:
+            assert not dec.router.prefill_remotely(1000)  # disagg off
+            await cp.put(disagg_config_key(NS),
+                         {"max_local_prefill_length": 16})
+            await asyncio.sleep(0.05)  # watch delivery
+            assert dec.router.prefill_remotely(17)
+            assert not dec.router.prefill_remotely(16)
+            await cp.delete(disagg_config_key(NS))
+            await asyncio.sleep(0.05)
+            assert not dec.router.prefill_remotely(1000)
+        finally:
+            await dec.stop()
+            await decode.stop()
+            await cp.close()
+
+    _run(main())

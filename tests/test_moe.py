@@ -1,0 +1,97 @@
+"""MoE all-to-all dispatch vs the dense oracle (VERDICT r2 item 7).
+
+Dense compute is exact by construction; dispatch with exact capacity must
+reproduce it — standalone, and sharded over the 8-device CPU mesh's
+dp×ep axes through the full forward step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import kv_cache as kvc
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.models.llama import init_params, make_forward_step
+from dynamo_tpu.ops import moe as moe_ops
+from dynamo_tpu.parallel import (
+    MeshConfig,
+    cache_pspecs,
+    make_mesh,
+    make_sharded_step,
+    param_pspecs,
+    shard_pytree,
+)
+
+CFG = mcfg.get_config("tiny-moe")
+BLOCK = 8
+
+
+def _moe_params(key=0):
+    p = init_params(CFG, jax.random.key(key), dtype=jnp.float32)
+    return p["layers"][0]["moe"]
+
+
+def test_dispatch_matches_dense_standalone():
+    p = _moe_params()
+    x = jax.random.normal(jax.random.key(1), (4, 16, CFG.hidden_size),
+                          jnp.float32)
+    want, load_d = moe_ops.moe_dense(CFG, p, x)
+    got, load = moe_ops.moe_dispatch(CFG, p, x)  # exact capacity default
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=2e-5, atol=2e-5)
+    # Same routing → same per-expert counts; totals = N*k.
+    np.testing.assert_array_equal(np.asarray(load), np.asarray(load_d))
+    assert int(load.sum()) == 4 * 16 * CFG.num_experts_per_token
+
+
+def test_dispatch_capacity_drops_overflow():
+    """Tiny capacity must drop assignments (gate mass lost), not crash or
+    corrupt other tokens."""
+    p = _moe_params()
+    x = jax.random.normal(jax.random.key(2), (2, 8, CFG.hidden_size),
+                          jnp.float32)
+    got, _ = moe_ops.moe_dispatch(CFG, p, x, capacity=1)
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_sharded_dispatch_step_matches_dense_reference():
+    """Full forward step, dp=2 x ep=4 (tp=1): dispatch path output equals
+    the single-device dense step."""
+    params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    batch, T = 8, 16  # batch divisible by dp*ep
+    tokens = jax.random.randint(jax.random.key(5), (batch, T), 0,
+                                CFG.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (batch, T))
+    bt = np.zeros((batch, 8), np.int32)
+    for i in range(batch):
+        bt[i, :4] = np.arange(1 + 4 * i, 5 + 4 * i)
+    seq_lens = jnp.full((batch,), T, jnp.int32)
+    inputs = (tokens, positions, seq_lens, jnp.asarray(bt))
+    sample_pos = jnp.full((batch,), T - 1, jnp.int32)
+
+    ref_step = make_forward_step(CFG, BLOCK)
+    ref_cache = kvc.init_cache(kvc.KvCacheConfig.for_model(
+        CFG, num_blocks=64, block_size=BLOCK, dtype=jnp.float32))
+    want, _ = ref_step(params, ref_cache, *inputs, sample_pos)
+
+    mesh = make_mesh(MeshConfig(dp=2, ep=4), jax.devices())
+    sharded = shard_pytree(params, param_pspecs(CFG, "dispatch"), mesh)
+    cache = shard_pytree(
+        kvc.init_cache(kvc.KvCacheConfig.for_model(
+            CFG, num_blocks=64, block_size=BLOCK, dtype=jnp.float32)),
+        cache_pspecs(CFG.num_layers), mesh)
+    step = make_sharded_step(CFG, BLOCK, mesh, moe_mode="dispatch",
+                             with_expert_load=True)
+    got, _, load = step(sharded, cache, *inputs, sample_pos)
+
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=5e-4, atol=5e-4)
+    assert int(np.asarray(load).sum()) == (
+        batch * T * CFG.num_experts_per_token * CFG.num_layers)
+
+
+def test_dispatch_requires_tp1():
+    mesh = make_mesh(MeshConfig(dp=2, ep=2, tp=2), jax.devices())
+    with pytest.raises(ValueError, match="tp == 1"):
+        make_sharded_step(CFG, BLOCK, mesh, moe_mode="dispatch")

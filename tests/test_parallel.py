@@ -66,13 +66,14 @@ def test_sharded_step_matches_unsharded(cfg_name, mesh_cfg):
     cache = shard_pytree(
         kvc.init_cache(kvc.KvCacheConfig.for_model(
             cfg, num_blocks=64, block_size=BLOCK, dtype=jnp.float32)),
-        cache_pspecs(), mesh)
+        cache_pspecs(cfg.num_layers), mesh)
     step = make_sharded_step(cfg, BLOCK, mesh)
     got, cache2 = step(sharded, cache, *inputs, sample_pos)
 
     np.testing.assert_allclose(want, np.asarray(got), rtol=5e-4, atol=5e-4)
     # Cache sharding must survive the step (donation keeps layout).
-    assert cache2["k"].sharding.spec == cache_pspecs()["k"]
+    assert (cache2["k"][0].sharding.spec
+            == cache_pspecs(cfg.num_layers)["k"][0])
 
 
 def test_mesh_validation():
@@ -104,7 +105,7 @@ def test_decode_after_sharded_prefill():
     cache = shard_pytree(
         kvc.init_cache(kvc.KvCacheConfig.for_model(
             cfg, num_blocks=64, block_size=BLOCK, dtype=jnp.float32)),
-        cache_pspecs(), mesh)
+        cache_pspecs(cfg.num_layers), mesh)
 
     split = T - 1
     _, cache = step(sharded, cache, tokens[:, :split], positions[:, :split],

@@ -75,7 +75,17 @@ def test_pubsub_and_queue():
 
             await cp.queue_push("prefill", {"req": "a"})
             assert await cp.queue_len("prefill") == 1
-            assert await cp.queue_pop("prefill") == {"req": "a"}
+            mid, item = await cp.queue_pop("prefill")
+            assert item == {"req": "a"}
+            assert await cp.queue_ack("prefill", mid)
+            # Un-acked items redeliver after the visibility timeout.
+            await cp.queue_push("prefill", {"req": "b"})
+            mid2, _ = await cp.queue_pop("prefill", visibility_timeout=0.05)
+            await asyncio.sleep(0.1)
+            assert cp.state.redeliver_expired() == 1
+            mid3, item3 = await cp.queue_pop("prefill")
+            assert item3 == {"req": "b"} and mid3 == mid2
+            assert await cp.queue_ack("prefill", mid3)
         finally:
             await cp.close()
 
@@ -117,7 +127,9 @@ def test_tcp_control_plane_roundtrip():
             pop = asyncio.create_task(c2.queue_pop("q"))
             await asyncio.sleep(0.05)
             await c1.queue_push("q", {"job": 1})
-            assert await pop == {"job": 1}
+            mid, item = await pop
+            assert item == {"job": 1}
+            assert await c2.queue_ack("q", mid)
         finally:
             await c1.close()
             await c2.close()
