@@ -299,12 +299,22 @@ class EngineCore:
     def _window_eligible(self, plan) -> bool:
         # MoE models take the single-step path: the window's fori_loop
         # doesn't thread the expert-load aux (telemetry would go dark).
-        return (self.config.decode_window > 1
+        if not (self.config.decode_window > 1
                 and self.mesh is None
                 and not self._moe
                 and plan.decode is not None
                 and plan.prefill is None
-                and not self.scheduler.waiting)
+                and not self.scheduler.waiting):
+            return False
+        # End-of-life guard: if every request's max_tokens budget is
+        # already covered by in-flight windows, another dispatch would be
+        # 100% discarded tokens — drain instead.  (Stop-token finishes are
+        # unpredictable; the max_tokens bound is the static one.)
+        lookahead = len(self._inflight) * self.config.decode_window
+        return any(
+            (r.sampling.max_tokens - r.prior_output - len(r.output_tokens)
+             - lookahead) > 0
+            for r in plan.decode.requests)
 
     def _same_reqs(self, reqs: List[Request]) -> bool:
         return [r.request_id for r in reqs] == self._inflight[-1]["rids"]

@@ -25,11 +25,13 @@ from typing import AsyncIterator, Optional
 from dynamo_tpu.engine.engine import TokenDelta
 from dynamo_tpu.llm.kv_router.protocols import RouterEvent
 from dynamo_tpu.llm.kv_router.router import KvRouter, KvRouterConfig
+from dynamo_tpu.llm.kv_router.watcher import LoadMetricsWatcher
 from dynamo_tpu.llm.preprocessor import PreprocessedRequest
 
 logger = logging.getLogger(__name__)
 
 KV_EVENTS_SUBJECT = "kv_events"
+HIT_RATE_SUBJECT = "kv_hit_rate"
 
 
 class KvRoutedEngineClient:
@@ -43,9 +45,15 @@ class KvRoutedEngineClient:
         self._from_wire = delta_from_wire
         self.client = client          # runtime Client (instance watcher)
         self.runtime = runtime
-        self.router = KvRouter(config or KvRouterConfig(block_size=block_size))
+        # Hit-rate events ride pub/sub to the namespace aggregator
+        # (reference KVHitRateEvent → `components/metrics`).
+        self.router = KvRouter(config or KvRouterConfig(block_size=block_size),
+                               on_hit_rate_event=self._queue_hit_rate_event)
         self._event_task: Optional[asyncio.Task] = None
         self._sub = None
+        # Worker-published ForwardPassMetrics, merged into selection cost
+        # (r2 published these every second and routed on none of it).
+        self._metrics = LoadMetricsWatcher(runtime.cp, name="kv-router")
         # Penalty box: workers that just failed a connection are excluded
         # from routing until their lease expires or the TTL passes —
         # otherwise the highest-overlap (dead) worker would be re-chosen on
@@ -57,6 +65,7 @@ class KvRoutedEngineClient:
     async def start(self) -> None:
         self._sub = await self.runtime.cp.subscribe(KV_EVENTS_SUBJECT)
         self._event_task = asyncio.create_task(self._pump_events())
+        await self._metrics.start()
 
     async def stop(self) -> None:
         if self._sub:
@@ -67,6 +76,26 @@ class KvRoutedEngineClient:
                 await self._event_task
             except asyncio.CancelledError:
                 pass
+        await self._metrics.stop()
+
+    def _queue_hit_rate_event(self, ev) -> None:
+        # Sync callback from the selector: publish fire-and-forget — a
+        # telemetry publish must never add a control-plane round trip (or
+        # its failures) to the request hot path.
+        async def pub():
+            try:
+                await self.runtime.cp.publish(HIT_RATE_SUBJECT, {
+                    "worker_id": ev.worker_id,
+                    "isl_blocks": ev.isl_blocks,
+                    "overlap_blocks": ev.overlap_blocks,
+                })
+            except Exception:
+                pass  # observability is best-effort
+
+        try:
+            asyncio.get_running_loop().create_task(pub())
+        except RuntimeError:
+            pass  # no loop (sync tests): drop
 
     async def _pump_events(self) -> None:
         while True:
@@ -100,7 +129,8 @@ class KvRoutedEngineClient:
         workers = self._sync_workers()
         worker_id, overlap = self.router.find_best_match(
             request.request_id, request.token_ids, workers,
-            expected_output_tokens=request.sampling.max_tokens)
+            expected_output_tokens=request.sampling.max_tokens,
+            metrics=self._metrics.fresh())
         logger.debug("kv-routed %s → worker %s (overlap %d blocks)",
                      request.request_id, worker_id, overlap)
         first = True

@@ -96,6 +96,7 @@ class KvRouter:
         workers: Sequence[WorkerId],
         update_states: bool = True,
         expected_output_tokens: int = 0,
+        metrics: Optional[Dict[WorkerId, object]] = None,
     ) -> Tuple[WorkerId, int]:
         """Choose a worker for the request; returns (worker, overlap_blocks).
 
@@ -126,6 +127,7 @@ class KvRouter:
                 overlap_blocks=overlaps.scores.get(w, 0),
                 decode_blocks=decode_blocks.get(w, 0),
                 prefill_blocks=(prefill_tokens.get(w, 0) + bs - 1) // bs,
+                metrics=(metrics or {}).get(w),
             )
             for w in workers
         ]

@@ -93,11 +93,13 @@ class DefaultWorkerSelector:
         self,
         overlap_score_weight: float = 1.0,
         temperature: float = 0.0,
+        waiting_request_weight: float = 8.0,
         rng: Optional[random.Random] = None,
         on_hit_rate_event: Optional[Callable[[KVHitRateEvent], None]] = None,
     ) -> None:
         self.overlap_score_weight = overlap_score_weight
         self.temperature = temperature
+        self.waiting_request_weight = waiting_request_weight
         self.rng = rng or random.Random()
         self.on_hit_rate_event = on_hit_rate_event
 
@@ -112,9 +114,23 @@ class DefaultWorkerSelector:
         by_id: Dict[WorkerId, WorkerLoadSnapshot] = {}
         for c in candidates:
             potential_prefill = max(0, request_blocks - c.overlap_blocks)
+            # Decode load: router-local optimistic accounting merged with
+            # the worker's last PUBLISHED stats (reference merges scraped
+            # ForwardPassMetrics into routing via `scoring.rs`
+            # ProcessedEndpoints).  max(): local accounting reacts
+            # instantly to our own decisions; published truth covers load
+            # this router never saw (other frontends, engine-internal
+            # state) — r2 published these metrics and routed on neither.
+            decode_load = c.decode_blocks
+            waiting = 0
+            if c.metrics is not None:
+                decode_load = max(decode_load,
+                                  c.metrics.kv_stats.kv_active_blocks)
+                waiting = c.metrics.worker_stats.num_requests_waiting
             costs[c.worker_id] = (
                 self.overlap_score_weight * (potential_prefill + c.prefill_blocks)
-                + c.decode_blocks
+                + decode_load
+                + self.waiting_request_weight * waiting
             )
             by_id[c.worker_id] = c
         chosen_id = softmax_sample(costs, self.temperature, self.rng)

@@ -1,0 +1,71 @@
+"""Shared load-metrics watcher: one subscription, freshness-pruned view.
+
+Three consumers need the same machinery — the KV router's cost merge
+(client.py), the namespace aggregator (metrics_aggregator), and the
+planner's observation loop — so it lives once here: subscribe to the
+`load_metrics` subject, keep the latest ForwardPassMetrics per worker,
+and serve a freshness-filtered snapshot.  `fresh()` also PRUNES stale
+entries so worker churn (the planner spawns a new instance id per
+scale-up) can't grow the map without bound.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, Optional
+
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, WorkerId
+
+logger = logging.getLogger(__name__)
+
+METRICS_SUBJECT = "load_metrics"
+
+
+class LoadMetricsWatcher:
+    def __init__(self, cp, stale_secs: float = 10.0,
+                 name: str = "load-metrics") -> None:
+        self.cp = cp
+        self.stale_secs = stale_secs
+        self.name = name
+        self._metrics: Dict[WorkerId, tuple] = {}   # id → (metrics, ts)
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._sub = await self.cp.subscribe(METRICS_SUBJECT)
+        self._task = asyncio.create_task(self._pump())
+
+    async def stop(self) -> None:
+        if self._sub:
+            self._sub.cancel()
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def _pump(self) -> None:
+        while True:
+            try:
+                payload = await self._sub.next()
+            except ConnectionError:
+                logger.error("%s: load_metrics subscription lost", self.name)
+                return
+            try:
+                self._metrics[payload["worker_id"]] = (
+                    ForwardPassMetrics.from_dict(payload["metrics"]),
+                    time.monotonic())
+            except Exception:
+                logger.exception("%s: bad load_metrics payload", self.name)
+
+    def fresh(self) -> Dict[WorkerId, ForwardPassMetrics]:
+        """Snapshot of workers heard from within `stale_secs`; prunes the
+        rest from the map."""
+        cutoff = time.monotonic() - self.stale_secs
+        stale = [w for w, (_, ts) in self._metrics.items() if ts <= cutoff]
+        for w in stale:
+            del self._metrics[w]
+        return {w: m for w, (m, _) in self._metrics.items()}

@@ -1,0 +1,126 @@
+"""Namespace-wide metrics aggregator service.
+
+Role of the reference's `components/metrics` Rust binary
+(`components/metrics/src/main.rs:15-28`): subscribe to every worker's
+`load_metrics` publications and the routers' `kv_hit_rate` events, keep
+the latest snapshot per worker, and expose the aggregate as Prometheus
+text over HTTP — the series the planner and dashboards scrape.
+
+    python -m dynamo_tpu.metrics_aggregator --control-plane HOST:PORT \
+        [--http-port 8081]
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.llm.kv_router.watcher import LoadMetricsWatcher
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+HIT_RATE_SUBJECT = "kv_hit_rate"
+STALE_SECS = 30.0
+
+
+class MetricsAggregator:
+    """Subscribes, aggregates, exposes."""
+
+    def __init__(self, cp) -> None:
+        self.cp = cp
+        self.registry = MetricsRegistry(prefix="dynamo_aggregate")
+        self._watcher = LoadMetricsWatcher(cp, stale_secs=STALE_SECS,
+                                           name="aggregator")
+        self._tasks = []
+        self._subs = []
+        # Router-side KV hit telemetry.
+        self._hit_isl = self.registry.counter(
+            "kv_hit_isl_blocks_total", "request prefix blocks seen by router")
+        self._hit_overlap = self.registry.counter(
+            "kv_hit_overlap_blocks_total", "blocks already cached on the "
+            "chosen worker")
+        self._g_workers = self.registry.gauge(
+            "workers", "workers with fresh load_metrics")
+        self._g_active = self.registry.gauge(
+            "request_active_slots", "active request slots across workers")
+        self._g_waiting = self.registry.gauge(
+            "requests_waiting", "queued requests across workers")
+        self._g_blocks = self.registry.gauge(
+            "kv_active_blocks", "active KV blocks across workers")
+        self._g_usage = self.registry.gauge(
+            "kv_usage_mean", "mean device cache usage across workers")
+
+    async def start(self) -> None:
+        await self._watcher.start()
+        sub = await self.cp.subscribe(HIT_RATE_SUBJECT)
+        self._subs.append(sub)
+        self._tasks.append(asyncio.create_task(self._pump_hits(sub)))
+
+    async def stop(self) -> None:
+        await self._watcher.stop()
+        for s in self._subs:
+            s.cancel()
+        for t in self._tasks:
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+
+    async def _pump_hits(self, sub) -> None:
+        while True:
+            try:
+                payload = await sub.next()
+            except ConnectionError:
+                logger.error("kv_hit_rate subscription lost")
+                return
+            try:
+                self._hit_isl.inc(float(payload["isl_blocks"]))
+                self._hit_overlap.inc(float(payload["overlap_blocks"]))
+            except Exception:
+                logger.exception("bad kv_hit_rate payload")
+
+    def fresh_workers(self) -> Dict[int, ForwardPassMetrics]:
+        return self._watcher.fresh()
+
+    def _refresh_gauges(self) -> None:
+        fresh = self.fresh_workers()
+        self._g_workers.set(len(fresh))
+        self._g_active.set(sum(
+            m.worker_stats.request_active_slots for m in fresh.values()))
+        self._g_waiting.set(sum(
+            m.worker_stats.num_requests_waiting for m in fresh.values()))
+        self._g_blocks.set(sum(
+            m.kv_stats.kv_active_blocks for m in fresh.values()))
+        usages = [m.kv_stats.gpu_cache_usage_perc for m in fresh.values()]
+        self._g_usage.set(sum(usages) / len(usages) if usages else 0.0)
+
+    def expose(self) -> str:
+        self._refresh_gauges()
+        return self.registry.expose()
+
+
+async def serve(cp, host: str = "127.0.0.1", port: int = 0):
+    """Start aggregator + HTTP /metrics; returns (aggregator, runner, port)."""
+    agg = MetricsAggregator(cp)
+    await agg.start()
+
+    async def metrics(_req):
+        return web.Response(text=agg.expose(),
+                            content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", metrics)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    bound = site._server.sockets[0].getsockname()[1]
+    logger.info("metrics aggregator on %s:%d", host, bound)
+    return agg, runner, bound

@@ -1,0 +1,40 @@
+"""Load-based planner (autoscaler).
+
+Role of the reference's `components/planner`
+(`planner/utils/planner_core.py:241-318`): observe worker load, predict
+the near-term value, compute a replica target, and tell a connector to
+converge on it.  Round-3 scope is the LOAD-based planner over our
+control plane's `load_metrics` stream (the SLA planner's
+TTFT/ITL-interpolation layer builds on the same skeleton).
+
+Scaling rules (reference load-planner semantics,
+`docs/architecture/load_planner.md`):
+- scale UP by one replica when the predicted per-worker KV-cache usage
+  exceeds `kv_high` OR any requests are queued (waiting > 0);
+- scale DOWN by one when predicted usage across workers would still stay
+  under `kv_low` with one fewer replica and nothing is waiting;
+- clamp to [min_replicas, max_replicas]; one move per adjustment
+  interval (no thrash).
+
+Graceful scale-down mirrors the reference (`load_planner.md:21`): the
+connector SIGTERMs the newest worker; the worker's own drain logic
+(worker/main.py) leaves routing instantly and finishes in-flight
+streams, so no stream is dropped.
+"""
+
+from dynamo_tpu.planner.core import LoadPlanner, PlannerConfig
+from dynamo_tpu.planner.connector import LocalConnector
+from dynamo_tpu.planner.predictor import (
+    ConstantPredictor,
+    MovingAveragePredictor,
+    make_predictor,
+)
+
+__all__ = [
+    "LoadPlanner",
+    "PlannerConfig",
+    "LocalConnector",
+    "ConstantPredictor",
+    "MovingAveragePredictor",
+    "make_predictor",
+]

@@ -1,0 +1,57 @@
+"""CLI entry: `python -m dynamo_tpu.planner`.
+
+    python -m dynamo_tpu.planner --control-plane HOST:PORT \
+        --min-replicas 1 --max-replicas 4 -- --mocker --model-name m
+
+Everything after `--` is passed to each spawned worker."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from dynamo_tpu.planner import LoadPlanner, LocalConnector, PlannerConfig
+from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneClient
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("dynamo_tpu.planner")
+    p.add_argument("--control-plane", required=True, help="HOST:PORT")
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=8)
+    p.add_argument("--kv-high", type=float, default=0.8)
+    p.add_argument("--kv-low", type=float, default=0.3)
+    p.add_argument("--adjustment-interval", type=float, default=5.0)
+    p.add_argument("worker_args", nargs="*",
+                   help="args after -- go to spawned workers")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        host, port = args.control_plane.rsplit(":", 1)
+        cp = ControlPlaneClient(host, int(port))
+        await cp.start()
+        connector = LocalConnector(args.control_plane,
+                                   worker_args=args.worker_args)
+        planner = LoadPlanner(cp, connector, PlannerConfig(
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            kv_high=args.kv_high, kv_low=args.kv_low,
+            adjustment_interval=args.adjustment_interval))
+        await planner.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await planner.stop()
+        await connector.shutdown()
+        await cp.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

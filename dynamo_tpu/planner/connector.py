@@ -1,0 +1,99 @@
+"""Connectors: converge the actual worker set to the planner's target.
+
+Reference analogs: `kubernetes_connector.py:172` patches the
+DynamoGraphDeployment CRD; `circusd.py:360` manages local processes.
+`LocalConnector` is the latter for our runtime: it spawns
+`python -m dynamo_tpu.worker` subprocesses and drains them with SIGTERM
+(the worker's own handler leaves routing instantly, then finishes
+in-flight streams — worker/main.py)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class LocalConnector:
+    def __init__(self, control_plane_addr: str, *,
+                 worker_args: Optional[List[str]] = None,
+                 env: Optional[dict] = None,
+                 log_dir: str = "/tmp") -> None:
+        """`worker_args`: extra argv after `--control-plane ADDR`
+        (e.g. ["--mocker", "--model-name", "m"])."""
+        self.control_plane_addr = control_plane_addr
+        self.worker_args = list(worker_args or [])
+        self.env = dict(env if env is not None else os.environ)
+        self.log_dir = log_dir
+        self._procs: List[subprocess.Popen] = []
+        self._seq = 0
+
+    def replicas(self) -> int:
+        self._reap()
+        return len(self._procs)
+
+    @staticmethod
+    def _close_log(proc) -> None:
+        log = getattr(proc, "_logfile", None)
+        if log is not None and not log.closed:
+            log.close()
+
+    def _reap(self) -> None:
+        live = []
+        for p in self._procs:
+            if p.poll() is None:
+                live.append(p)
+            else:
+                self._close_log(p)
+        self._procs = live
+
+    async def add_worker(self) -> None:
+        self._seq += 1
+        log = open(os.path.join(
+            self.log_dir,
+            f"dynamo_planner_worker_{os.getpid()}_{self._seq}.log"), "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.worker",
+             "--control-plane", self.control_plane_addr,
+             *self.worker_args],
+            env=self.env, stdout=log, stderr=subprocess.STDOUT)
+        proc._logfile = log  # type: ignore[attr-defined]
+        self._procs.append(proc)
+        logger.info("connector: spawned worker pid %d", proc.pid)
+
+    async def remove_worker(self) -> None:
+        """Drain the newest worker: SIGTERM → it leaves routing and
+        finishes in-flight streams before exiting."""
+        self._reap()
+        if not self._procs:
+            return
+        proc = self._procs.pop()
+        logger.info("connector: draining worker pid %d", proc.pid)
+        proc.send_signal(signal.SIGTERM)
+        # Reap off-loop: the drain can take as long as its longest
+        # in-flight stream.
+        import asyncio
+
+        async def reap():
+            while proc.poll() is None:
+                await asyncio.sleep(0.5)
+            self._close_log(proc)
+
+        asyncio.get_running_loop().create_task(reap())
+
+    async def shutdown(self) -> None:
+        self._reap()
+        for p in self._procs:
+            p.send_signal(signal.SIGTERM)
+        for p in self._procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+            self._close_log(p)
+        self._procs.clear()

@@ -1,0 +1,124 @@
+"""LoadPlanner: observe → predict → target → converge.
+
+The decision skeleton of the reference's `planner_core.py:241-318`
+specialised to load-based scaling (its SLA variant swaps the target
+formula for TTFT/ITL interpolation; same loop)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.llm.kv_router.watcher import LoadMetricsWatcher
+from dynamo_tpu.planner.predictor import make_predictor
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class PlannerConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    kv_high: float = 0.8        # predicted usage above → scale up
+    kv_low: float = 0.3         # redistributable usage below → scale down
+    adjustment_interval: float = 5.0
+    metrics_stale_secs: float = 10.0
+    predictor: str = "moving_average"
+
+
+class LoadPlanner:
+    """Watches `load_metrics`, steps a replica target, drives a connector.
+
+    `connector` contract: `replicas() -> int` (current), plus
+    `add_worker()` / `remove_worker()` (one step each, async)."""
+
+    def __init__(self, cp, connector,
+                 config: Optional[PlannerConfig] = None) -> None:
+        self.cp = cp
+        self.connector = connector
+        self.config = config or PlannerConfig()
+        self._watcher = LoadMetricsWatcher(
+            cp, stale_secs=self.config.metrics_stale_secs, name="planner")
+        self._usage_pred = make_predictor(self.config.predictor)
+        self._waiting_pred = make_predictor(self.config.predictor)
+        self._tasks = []
+        self.decisions: list = []              # (ts, kind, reason) log
+
+    async def start(self) -> None:
+        await self._watcher.start()
+        self._tasks = [asyncio.create_task(self._loop())]
+
+    async def stop(self) -> None:
+        await self._watcher.stop()
+        for t in self._tasks:
+            t.cancel()
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+
+    def _observe(self):
+        fresh = list(self._watcher.fresh().values())
+        if not fresh:
+            return None
+        usage = sum(m.kv_stats.gpu_cache_usage_perc
+                    for m in fresh) / len(fresh)
+        waiting = sum(m.worker_stats.num_requests_waiting for m in fresh)
+        return len(fresh), usage, waiting
+
+    def plan_step(self) -> Optional[str]:
+        """One planning decision from current predictions; returns
+        "up" | "down" | None.  Synchronous and side-effect-free on the
+        connector (unit-testable; the loop applies it)."""
+        replicas = self.connector.replicas()
+        if replicas < self.config.min_replicas:
+            # Floor check needs no observations — it's how the fleet
+            # bootstraps (no worker yet → no metrics yet).
+            return "up"
+        obs = self._observe()
+        if obs is None:
+            return None
+        n_reporting, usage, waiting = obs
+        self._usage_pred.add_data_point(usage)
+        self._waiting_pred.add_data_point(waiting)
+        p_usage = self._usage_pred.predict_next()
+        p_waiting = self._waiting_pred.predict_next()
+        if ((p_usage > self.config.kv_high or p_waiting >= 1.0)
+                and replicas < self.config.max_replicas):
+            return "up"
+        # Scale down only if the survivors could absorb the load under
+        # kv_low: usage*n / (n-1) stays below the low-water mark.
+        if (replicas > self.config.min_replicas and p_waiting < 1.0
+                and n_reporting > 1
+                and p_usage * n_reporting / (n_reporting - 1)
+                < self.config.kv_low):
+            return "down"
+        return None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.adjustment_interval)
+            try:
+                decision = self.plan_step()
+                if decision == "up":
+                    self.decisions.append((time.monotonic(), "up",
+                                           self._reason()))
+                    logger.info("planner: scaling UP (%s)", self._reason())
+                    await self.connector.add_worker()
+                elif decision == "down":
+                    self.decisions.append((time.monotonic(), "down",
+                                           self._reason()))
+                    logger.info("planner: scaling DOWN (%s)", self._reason())
+                    await self.connector.remove_worker()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("planner: adjustment failed; continuing")
+
+    def _reason(self) -> str:
+        return (f"usage~{self._usage_pred.predict_next():.2f} "
+                f"waiting~{self._waiting_pred.predict_next():.1f} "
+                f"replicas={self.connector.replicas()}")

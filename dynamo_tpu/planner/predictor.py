@@ -1,0 +1,46 @@
+"""Load predictors (reference `planner/utils/load_predictor.py:159`).
+
+The reference ships constant / ARIMA / Prophet; the constant and
+moving-average predictors cover the load-planner's needs without the
+heavyweight deps (ARIMA/Prophet are not in this image — the predictor
+interface is where they'd slot in)."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque
+
+
+class ConstantPredictor:
+    """Next value = last observation."""
+
+    def __init__(self) -> None:
+        self._last = 0.0
+
+    def add_data_point(self, value: float) -> None:
+        self._last = float(value)
+
+    def predict_next(self) -> float:
+        return self._last
+
+
+class MovingAveragePredictor:
+    """Next value = mean of the last `window` observations."""
+
+    def __init__(self, window: int = 5) -> None:
+        self._buf: Deque[float] = deque(maxlen=window)
+
+    def add_data_point(self, value: float) -> None:
+        self._buf.append(float(value))
+
+    def predict_next(self) -> float:
+        return sum(self._buf) / len(self._buf) if self._buf else 0.0
+
+
+def make_predictor(kind: str = "moving_average", **kw):
+    if kind == "constant":
+        return ConstantPredictor()
+    if kind == "moving_average":
+        return MovingAveragePredictor(**kw)
+    raise ValueError(f"unknown predictor {kind!r} "
+                     "(have: constant, moving_average)")

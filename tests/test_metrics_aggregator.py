@@ -1,0 +1,80 @@
+"""Namespace metrics aggregator (reference components/metrics analog)."""
+
+import asyncio
+
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvStats,
+    WorkerStats,
+)
+from dynamo_tpu.metrics_aggregator import MetricsAggregator, serve
+from dynamo_tpu.runtime.control_plane import InProcessControlPlane
+
+
+def _run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 60))
+
+
+def _metrics(active, waiting, blocks, usage):
+    return ForwardPassMetrics(
+        worker_stats=WorkerStats(request_active_slots=active,
+                                 num_requests_waiting=waiting),
+        kv_stats=KvStats(kv_active_blocks=blocks,
+                         gpu_cache_usage_perc=usage)).to_dict()
+
+
+def test_aggregates_worker_metrics_and_hit_events():
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        agg = MetricsAggregator(cp)
+        await agg.start()
+        try:
+            await cp.publish("load_metrics", {
+                "worker_id": 1, "metrics": _metrics(3, 1, 100, 0.5)})
+            await cp.publish("load_metrics", {
+                "worker_id": 2, "metrics": _metrics(5, 0, 200, 0.7)})
+            await cp.publish("kv_hit_rate", {
+                "worker_id": 1, "isl_blocks": 10, "overlap_blocks": 6})
+            await asyncio.sleep(0.05)
+            text = agg.expose()
+            assert "dynamo_aggregate_workers 2" in text
+            assert "dynamo_aggregate_request_active_slots 8" in text
+            assert "dynamo_aggregate_requests_waiting 1" in text
+            assert "dynamo_aggregate_kv_active_blocks 300" in text
+            assert "dynamo_aggregate_kv_hit_isl_blocks_total 10" in text
+            assert "dynamo_aggregate_kv_hit_overlap_blocks_total 6" in text
+            # Re-publication replaces, not accumulates.
+            await cp.publish("load_metrics", {
+                "worker_id": 1, "metrics": _metrics(0, 0, 50, 0.1)})
+            await asyncio.sleep(0.05)
+            assert "dynamo_aggregate_kv_active_blocks 250" in agg.expose()
+        finally:
+            await agg.stop()
+            await cp.close()
+
+    _run(main())
+
+
+def test_http_exposition():
+    async def main():
+        import aiohttp
+
+        cp = InProcessControlPlane()
+        await cp.start()
+        agg, runner, port = await serve(cp)
+        try:
+            await cp.publish("load_metrics", {
+                "worker_id": 7, "metrics": _metrics(1, 0, 10, 0.2)})
+            await asyncio.sleep(0.05)
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"http://127.0.0.1:{port}/metrics") as r:
+                    assert r.status == 200
+                    body = await r.text()
+            assert "dynamo_aggregate_workers 1" in body
+        finally:
+            await agg.stop()
+            await runner.cleanup()
+            await cp.close()
+
+    _run(main())

@@ -1,0 +1,194 @@
+"""Planner: decision unit tests + e2e with real mocker worker processes.
+
+VERDICT r2 item 5: load spike → worker count grows; drain → shrinks; no
+dropped streams (graceful SIGTERM drain)."""
+
+import asyncio
+import os
+import sys
+import time
+
+import pytest
+
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvStats,
+    WorkerStats,
+)
+from dynamo_tpu.planner import LoadPlanner, LocalConnector, PlannerConfig
+from dynamo_tpu.runtime.control_plane import InProcessControlPlane
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _metrics(waiting=0, usage=0.0):
+    return ForwardPassMetrics(
+        worker_stats=WorkerStats(num_requests_waiting=waiting),
+        kv_stats=KvStats(gpu_cache_usage_perc=usage)).to_dict()
+
+
+class FakeConnector:
+    def __init__(self, n=1):
+        self.n = n
+        self.calls = []
+
+    def replicas(self):
+        return self.n
+
+    async def add_worker(self):
+        self.n += 1
+        self.calls.append("up")
+
+    async def remove_worker(self):
+        self.n -= 1
+        self.calls.append("down")
+
+
+def _run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 120))
+
+
+def test_plan_step_decisions():
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        conn = FakeConnector(n=1)
+        planner = LoadPlanner(cp, conn, PlannerConfig(
+            min_replicas=1, max_replicas=3, kv_high=0.8, kv_low=0.3,
+            predictor="constant"))
+        try:
+            # No observations → no decision.
+            assert planner.plan_step() is None
+            # Saturated usage → up.
+            planner._watcher._metrics[1] = (
+                ForwardPassMetrics.from_dict(_metrics(usage=0.95)),
+                time.monotonic())
+            assert planner.plan_step() == "up"
+            # Queued requests → up even at low usage.
+            planner._watcher._metrics[1] = (
+                ForwardPassMetrics.from_dict(_metrics(waiting=3, usage=0.1)),
+                time.monotonic())
+            assert planner.plan_step() == "up"
+            # Max replicas clamp.
+            conn.n = 3
+            assert planner.plan_step() is None
+            # Idle two-worker fleet → down (survivor stays under kv_low).
+            conn.n = 2
+            planner._watcher._metrics[1] = (
+                ForwardPassMetrics.from_dict(_metrics(usage=0.05)),
+                time.monotonic())
+            planner._watcher._metrics[2] = (
+                ForwardPassMetrics.from_dict(_metrics(usage=0.05)),
+                time.monotonic())
+            assert planner.plan_step() == "down"
+            # Min replicas clamp.
+            conn.n = 1
+            planner._watcher._metrics.pop(2)
+            assert planner.plan_step() is None
+            # Stale metrics are ignored entirely.
+            planner._watcher._metrics[1] = (
+                ForwardPassMetrics.from_dict(_metrics(usage=0.95)),
+                time.monotonic() - 1e6)
+            assert planner.plan_step() is None
+        finally:
+            await cp.close()
+
+    _run(main())
+
+
+@pytest.mark.e2e
+def test_planner_e2e_scales_mocker_fleet():
+    """Real control-plane server + LocalConnector spawning real mocker
+    workers.  Load spike (published saturation) grows the fleet; idle
+    shrinks it; a stream in flight during the drain completes."""
+    from dynamo_tpu.runtime.control_plane_tcp import (
+        ControlPlaneClient,
+        ControlPlaneServer,
+    )
+
+    async def main():
+        srv = ControlPlaneServer()
+        port = await srv.start()
+        cp = ControlPlaneClient("127.0.0.1", port)
+        await cp.start()
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        connector = LocalConnector(
+            f"127.0.0.1:{port}",
+            worker_args=["--mocker", "--model-name", "m",
+                         "--block-size", "8", "--metrics-interval", "10"],
+            env=env)
+        planner = LoadPlanner(cp, connector, PlannerConfig(
+            min_replicas=1, max_replicas=2, kv_high=0.8, kv_low=0.3,
+            adjustment_interval=0.3, predictor="constant"))
+        await planner.start()
+
+        async def instances():
+            return len(await cp.get_prefix("instances/"))
+
+        try:
+            # min_replicas bootstraps the first worker.
+            deadline = time.monotonic() + 30
+            while connector.replicas() < 1 and time.monotonic() < deadline:
+                await asyncio.sleep(0.1)
+            assert connector.replicas() == 1
+            while await instances() < 1 and time.monotonic() < deadline:
+                await asyncio.sleep(0.2)
+            assert await instances() == 1
+
+            # Load spike: publish saturation (the metrics pump cadence in
+            # the workers is slowed so these synthetic points dominate).
+            for _ in range(4):
+                await cp.publish("load_metrics", {
+                    "worker_id": 1, "metrics": _metrics(waiting=5,
+                                                        usage=0.95)})
+                await asyncio.sleep(0.2)
+            deadline = time.monotonic() + 30
+            while connector.replicas() < 2 and time.monotonic() < deadline:
+                await asyncio.sleep(0.2)
+            assert connector.replicas() == 2
+            while await instances() < 2 and time.monotonic() < deadline:
+                await asyncio.sleep(0.2)
+            assert await instances() == 2
+
+            # Open a stream against the soon-to-be-drained fleet, then go
+            # idle: scale-down must not drop it.
+            from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+            runtime = DistributedRuntime(cp)
+            endpoint = (runtime.namespace("dynamo").component("backend")
+                        .endpoint("generate"))
+            client = await endpoint.client("round_robin")
+            await client.wait_for_instances()
+
+            async def one_stream():
+                toks = []
+                async for d in client.round_robin({
+                        "request_id": "s1", "token_ids": list(range(24)),
+                        "sampling": {"max_tokens": 24}}):
+                    toks.extend(d.get("token_ids", []))
+                return toks
+
+            stream_task = asyncio.create_task(one_stream())
+            await asyncio.sleep(0.05)
+            for _ in range(4):
+                await cp.publish("load_metrics", {
+                    "worker_id": 1, "metrics": _metrics(usage=0.02)})
+                await cp.publish("load_metrics", {
+                    "worker_id": 2, "metrics": _metrics(usage=0.02)})
+                await asyncio.sleep(0.2)
+            deadline = time.monotonic() + 30
+            while connector.replicas() > 1 and time.monotonic() < deadline:
+                await asyncio.sleep(0.2)
+            assert connector.replicas() == 1
+
+            toks = await asyncio.wait_for(stream_task, 30)
+            assert len(toks) == 24  # stream survived the drain
+            await client.stop()
+            await runtime.shutdown()
+        finally:
+            await planner.stop()
+            await connector.shutdown()
+            await cp.close()
+            await srv.stop()
+
+    _run(main())
