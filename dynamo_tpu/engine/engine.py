@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine import kv_cache as kvc
-from dynamo_tpu.engine.sampling import SamplingParams, sample
+from dynamo_tpu.engine.sampling import SamplingParams, chosen_logprobs, sample
 from dynamo_tpu.engine.sampling import greedy as greedy_sample
 from dynamo_tpu.engine.scheduler import (
     BlockAllocator,
@@ -80,6 +80,9 @@ class TokenDelta:
     token_ids: List[int]
     finished: bool = False
     finish_reason: Optional[FinishReason] = None
+    # log p(token) per entry of token_ids; only populated for requests
+    # with sampling.logprobs set.
+    logprobs: Optional[List[float]] = None
 
 
 @dataclass(frozen=True)
@@ -162,6 +165,7 @@ class EngineCore:
         self.expert_load = (np.zeros((cfg.num_experts,), np.int64)
                             if self._moe else None)
         self._load_dev = None  # device-side accumulator (lazy sync)
+        self._embed_step = None  # lazily compiled (embeddings route)
         self._window_fns: Dict[bool, Callable] = {}
         self._inflight: List = []  # dispatched-unsynced decode windows
         # One thread: fetches are sequential anyway (window N-1 finishes
@@ -306,6 +310,10 @@ class EngineCore:
                 and plan.prefill is None
                 and not self.scheduler.waiting):
             return False
+        # Logprob requests take the single-step path too (the window's
+        # fori_loop doesn't thread the per-token logprob aux).
+        if any(r.sampling.logprobs for r in plan.decode.requests):
+            return False
         # End-of-life guard: if every request's max_tokens budget is
         # already covered by in-flight windows, another dispatch would be
         # 100% discarded tokens — drain instead.  (Stop-token finishes are
@@ -406,9 +414,11 @@ class EngineCore:
             # already point at each row's last real chunk position).
             sel = logits[jnp.asarray(done_rows)]
             reqs = [batch.items[i].request for i in done_rows]
-            sampled = self._sample_rows(sel, reqs)
+            sampled, lps = self._sample_rows(sel, reqs)
             for j, req in enumerate(reqs):
-                deltas.append(self._append_token(req, int(sampled[j])))
+                deltas.append(self._append_token(
+                    req, int(sampled[j]),
+                    float(lps[j]) if lps is not None else None))
         return deltas
 
     def _run_decode(self, work: DecodeWork) -> List[TokenDelta]:
@@ -447,14 +457,16 @@ class EngineCore:
             jnp.asarray(seq_lens), jnp.asarray(bts),
             jnp.zeros((bucket,), jnp.int32))
 
-        sampled = self._sample_rows(logits[: len(live)], live)
+        sampled, lps = self._sample_rows(logits[: len(live)], live)
         deltas = []
         for i, req in enumerate(live):
             # Publish blocks sealed by *previous* tokens before appending:
             # if this token finishes the request, its state is dropped and a
             # late publish would re-emit the whole sequence from scratch.
             self._publish_completed_blocks(req)
-            deltas.append(self._append_token(req, int(sampled[i])))
+            deltas.append(self._append_token(
+                req, int(sampled[i]),
+                float(lps[i]) if lps is not None else None))
         return deltas
 
     # -- pipelined decode windows ------------------------------------------
@@ -593,14 +605,26 @@ class EngineCore:
         self._published_blocks.pop(req.request_id, None)
         self.scheduler.preempt(req)
 
-    def _sample_rows(self, logits: jax.Array, reqs: List[Request]) -> np.ndarray:
+    def _sample_rows(self, logits: jax.Array, reqs: List[Request]
+                     ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Returns (tokens[n], logprobs[n] or None) — logprobs computed on
+        device (one extra fetch) only when some request asked."""
         n = logits.shape[0]
         reqs = reqs[:n]
+        want_lp = any(r.sampling.logprobs for r in reqs)
+
+        def fetch(tokens_dev):
+            if not want_lp:
+                return np.asarray(jax.device_get(tokens_dev)), None
+            lp = chosen_logprobs(logits, tokens_dev)
+            toks, lps = jax.device_get((tokens_dev, lp))
+            return np.asarray(toks), np.asarray(lps)
+
         if all(r.sampling.temperature <= 0 for r in reqs):
             # Greedy fast path: no keys, no sort — a plain argmax (the
             # common serving mix; per-row key plumbing here cost dozens of
             # device round-trips per step in r1).
-            return np.asarray(jax.device_get(greedy_sample(logits)))
+            return fetch(greedy_sample(logits))
 
         temp = np.asarray([r.sampling.temperature for r in reqs]
                           + [0.0] * (n - len(reqs)), np.float32)
@@ -622,22 +646,25 @@ class EngineCore:
                     r.prior_output + len(r.output_tokens)))
         out = sample(logits, jnp.asarray(temp), jnp.asarray(top_k),
                      jnp.asarray(top_p), keys)
-        return np.asarray(jax.device_get(out))
+        return fetch(out)
 
-    def _append_token(self, req: Request, token: int) -> TokenDelta:
+    def _append_token(self, req: Request, token: int,
+                      logprob: Optional[float] = None) -> TokenDelta:
         if req.first_token_ts is None:
             req.first_token_ts = time.monotonic()
         req.output_tokens.append(token)
+        lp = ([logprob] if (logprob is not None and req.sampling.logprobs)
+              else None)
         stop = token in req.sampling.stop_token_ids
         length = (req.prior_output + len(req.output_tokens)
                   >= req.sampling.max_tokens)
         if stop or length:
             self._finish(req, FinishReason.STOP if stop else FinishReason.LENGTH)
             delta = TokenDelta(req.request_id, [token], finished=True,
-                               finish_reason=req.finish_reason)
+                               finish_reason=req.finish_reason, logprobs=lp)
             self._drop(req)
             return delta
-        return TokenDelta(req.request_id, [token])
+        return TokenDelta(req.request_id, [token], logprobs=lp)
 
     def _finish(self, req: Request, reason: FinishReason) -> None:
         # With the managed source, sealed blocks stay resident (inactive,
@@ -650,6 +677,57 @@ class EngineCore:
         self._requests.pop(req.request_id, None)
         self._hash_seqs.pop(req.request_id, None)
         self._published_blocks.pop(req.request_id, None)
+
+    # -- embeddings --------------------------------------------------------
+
+    def embed_tokens(self, token_lists: List[List[int]]) -> np.ndarray:
+        """Last-token hidden-state embeddings for each prompt: [n, H] f32.
+
+        Runs one prefill per prompt (padded to the prefill bucket) with
+        temporarily-allocated pages that are released afterward — the
+        /v1/embeddings surface (reference `http/service/openai.rs:315`).
+        Must run on the engine thread (InferenceEngine wraps it)."""
+        if self.mesh is not None:
+            raise NotImplementedError("embeddings on the sharded engine "
+                                      "path are not wired yet")
+        if self._embed_step is None:
+            from dynamo_tpu.models.llama import make_forward_step as mfs
+
+            self._embed_step = jax.jit(
+                mfs(self.config.model, self.block_size,
+                    use_pallas_decode=False, return_hidden=True),
+                donate_argnums=(1,))
+        sched = self.scheduler.config
+        out = np.zeros((len(token_lists), self.config.model.hidden_size),
+                       np.float32)
+        for i, toks in enumerate(token_lists):
+            L = len(toks)
+            if L == 0:
+                raise ValueError("empty embedding input")
+            if L > sched.max_prefill_chunk:
+                raise ValueError(
+                    f"embedding input of {L} tokens exceeds the prefill "
+                    f"chunk ceiling {sched.max_prefill_chunk}")
+            T = sched.bucket_for_prefill(L)
+            pages_needed = (L + self.block_size - 1) // self.block_size
+            pages = self.allocator.allocate(pages_needed)
+            try:
+                tokens = np.zeros((1, T), np.int32)
+                tokens[0, :L] = toks
+                positions = np.full((1, T), self._pad_position, np.int32)
+                positions[0, :L] = np.arange(L)
+                width = sched.bucket_for_pages(pages_needed)
+                bt = np.zeros((1, width), np.int32)
+                bt[0, :pages_needed] = pages
+                hidden, self.cache = self._embed_step(
+                    self.params, self.cache,
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray([L], np.int32), jnp.asarray(bt),
+                    jnp.asarray([L - 1], np.int32))
+                out[i] = np.asarray(jax.device_get(hidden[0]))
+            finally:
+                self.allocator.release(pages)
+        return out
 
     # -- cross-worker KV transfer ------------------------------------------
 
@@ -869,6 +947,17 @@ class InferenceEngine:
     async def export_blocks(self, hashes) -> Dict[int, np.ndarray]:
         return await self.run_in_engine(
             lambda: self.core.export_blocks(hashes))
+
+    async def embed(self, token_lists) -> np.ndarray:
+        # One engine-thread slot PER INPUT, not one for the whole batch:
+        # decode steps for in-flight generations interleave between
+        # items, so a large embeddings request can't head-of-line block
+        # token streaming.
+        rows = []
+        for toks in token_lists:
+            rows.append(await self.run_in_engine(
+                lambda t=toks: self.core.embed_tokens([t])))
+        return np.concatenate(rows, axis=0) if rows else np.zeros((0, 0))
 
     async def import_blocks(self, blocks) -> int:
         return await self.run_in_engine(

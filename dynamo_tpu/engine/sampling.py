@@ -31,9 +31,21 @@ class SamplingParams:
     max_tokens: int = 16
     stop_token_ids: tuple = ()
     seed: Optional[int] = None
+    # Return the log-probability of each sampled token (reference
+    # perf/logprobs surface; OpenAI `logprobs`).  Requests with this set
+    # take the single-step decode path (the fused window doesn't thread
+    # the logprob aux).
+    logprobs: bool = False
     # Migration support (reference migration.rs:148-163): tokens already
     # generated before a retry are appended to the prompt and max_tokens is
     # decremented by the caller.
+
+
+def chosen_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """log p(token) under softmax(logits): [B, V], [B] → [B] float32."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, tokens[:, None], axis=1)[:, 0]
+    return picked - logz
 
 
 def sample(

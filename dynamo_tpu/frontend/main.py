@@ -53,6 +53,15 @@ def parse_args(argv=None):
     p.add_argument("--max-tokens-default", type=int, default=512)
     p.add_argument("--speedup-ratio", type=float, default=10.0,
                    help="mocker simulated-time compression")
+    from dynamo_tpu.runtime.config import (
+        apply_to_parser_defaults, load_layered_config)
+
+    apply_to_parser_defaults(p, load_layered_config(
+        {"http_host": "127.0.0.1", "http_port": 8080,
+         "control_plane": None, "router_mode": "round_robin",
+         "migration_limit": 3, "model_name": "dynamo-tpu",
+         "num_blocks": 512, "block_size": 64},
+        section="frontend"))
     return p.parse_args(argv)
 
 
@@ -86,7 +95,8 @@ async def build_model_handle(args) -> tuple:
     await engine.start()
     handle = ModelHandle(name=args.model_name, tokenizer=tokenizer,
                          preprocessor=pre,
-                         client=LocalEngineClient(engine))
+                         client=LocalEngineClient(engine),
+                         max_context=cfg.max_context)
     return handle, engine.stop
 
 

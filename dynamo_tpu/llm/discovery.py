@@ -47,6 +47,7 @@ def request_to_wire(req: PreprocessedRequest) -> dict:
             "temperature": s.temperature, "top_k": s.top_k, "top_p": s.top_p,
             "max_tokens": s.max_tokens,
             "stop_token_ids": list(s.stop_token_ids), "seed": s.seed,
+            "logprobs": s.logprobs,
         },
         "stop_sequences": list(req.stop_sequences),
         "annotations": dict(req.annotations),
@@ -63,26 +64,35 @@ def request_from_wire(d: dict) -> PreprocessedRequest:
             top_k=s.get("top_k", 0), top_p=s.get("top_p", 1.0),
             max_tokens=s.get("max_tokens", 16),
             stop_token_ids=tuple(s.get("stop_token_ids", ())),
-            seed=s.get("seed")),
+            seed=s.get("seed"),
+            logprobs=bool(s.get("logprobs", False))),
         stop_sequences=list(d.get("stop_sequences", [])),
         annotations=dict(d.get("annotations", {})),
     )
 
 
 def delta_to_wire(delta: TokenDelta) -> dict:
-    return {
+    d = {
         "token_ids": list(delta.token_ids),
         "finished": delta.finished,
         "finish_reason": delta.finish_reason.value if delta.finish_reason else None,
     }
+    if delta.logprobs is not None:
+        d["logprobs"] = list(delta.logprobs)
+    return d
 
 
 def delta_from_wire(d: dict) -> TokenDelta:
     fr = d.get("finish_reason")
+    lp = d.get("logprobs")
     return TokenDelta(
         request_id="", token_ids=list(d.get("token_ids", [])),
         finished=bool(d.get("finished")),
-        finish_reason=FinishReason(fr) if fr else None)
+        finish_reason=FinishReason(fr) if fr else None,
+        logprobs=list(lp) if lp is not None else None)
+
+
+EMBED_ENDPOINT = "embed"
 
 
 def engine_wire_handler(engine_client) -> Callable:
@@ -90,8 +100,28 @@ def engine_wire_handler(engine_client) -> Callable:
 
     async def handler(payload: dict) -> AsyncIterator[dict]:
         req = request_from_wire(payload)
+        # Trace context: the frontend's request id arrives in the RPC
+        # frame; logging it here gives one grep-able id across frontend
+        # and worker logs (reference `logging.rs:73-79`).
+        logger.info("request %s: %d prompt tokens, max_tokens=%d",
+                    req.request_id, len(req.token_ids),
+                    req.sampling.max_tokens)
+        n_out = 0
         async for delta in engine_client.generate(req):
+            n_out += len(delta.token_ids)
             yield delta_to_wire(delta)
+        logger.info("request %s: finished, %d tokens", req.request_id, n_out)
+
+    return handler
+
+
+def embed_wire_handler(engine_client) -> Callable:
+    """Worker-side `embed` RPC endpoint: one delta per input row."""
+
+    async def handler(payload: dict) -> AsyncIterator[dict]:
+        vecs = await engine_client.embed(payload["token_lists"])
+        for i, vec in enumerate(vecs):
+            yield {"index": i, "embedding": [float(x) for x in vec]}
 
     return handler
 
@@ -109,6 +139,31 @@ class RemoteEngineClient:
             delta = delta_from_wire(d)
             delta.request_id = request.request_id
             yield delta
+
+    async def embed(self, token_lists):
+        """Forward to a worker's `embed` RPC endpoint (round-robin over
+        live instances)."""
+        import numpy as np
+
+        inst = self.client._pick()
+        rpc = self.client.endpoint.runtime.client_for(inst.address)
+        rows = {}
+        try:
+            async for d in rpc.call(
+                    EMBED_ENDPOINT,
+                    {"token_lists": [list(t) for t in token_lists]}):
+                rows[d["index"]] = d["embedding"]
+        except ConnectionError:
+            # Mirror Client.generate's fault handling: evict the cached
+            # client so the next attempt reconnects/re-picks.
+            await self.client.endpoint.runtime.evict_client(inst.address)
+            raise
+        if len(rows) != len(token_lists):
+            raise ConnectionError(
+                f"embed stream ended early: {len(rows)}/{len(token_lists)} "
+                "rows (worker died mid-request?)")
+        return np.asarray([rows[i] for i in range(len(token_lists))],
+                          dtype=np.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -229,7 +284,8 @@ class ModelWatcher:
             preprocessor=OpenAIPreprocessor(
                 tokenizer, chat_template=card.chat_template,
                 default_max_tokens=card.default_max_tokens),
-            client=engine_client))
+            client=engine_client,
+            max_context=card.max_context))
         logger.info("model %r registered (instance %d)", name,
                     entry["instance_id"])
 

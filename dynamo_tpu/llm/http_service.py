@@ -12,8 +12,10 @@ cancels the engine request), request metrics incl. TTFT/ITL histograms
 from __future__ import annotations
 
 import asyncio
+import base64
 import logging
 import time
+import uuid
 from typing import Optional
 
 from aiohttp import web
@@ -39,6 +41,7 @@ class HttpService:
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
+        self.app.router.add_post("/v1/embeddings", self.embeddings)
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/metrics", self.prometheus)
         self.app.router.add_get("/health", self.health)
@@ -74,6 +77,41 @@ class HttpService:
     def _lookup(self, model: str) -> Optional[ModelHandle]:
         return self.models.get(model)
 
+    @staticmethod
+    def _request_id(request: web.Request, prefix: str) -> str:
+        """Trace context: honor a caller-provided X-Request-Id so one id
+        is grep-able across frontend and worker logs (reference
+        distributed trace ctx over transport headers, logging.rs:73-79).
+        A unique suffix is ALWAYS appended — the raw header value is not
+        unique (proxy retries, concurrent duplicates) and the engine keys
+        request state by this id."""
+        header = request.headers.get("x-request-id")
+        if header:
+            return f"{header[:120]}-{uuid.uuid4().hex[:8]}"
+        return oai.request_id(prefix)
+
+    def _validate_context(self, handle: ModelHandle, pre):
+        """Boundary validation (reference `protocols/openai/validate.rs`):
+        a prompt that cannot fit the model context is a client error the
+        HTTP layer must surface as a 400 — r2 silently finished such
+        requests as zero-token LENGTH stops.  A prompt that fits but whose
+        max_tokens would overflow gets max_tokens clamped."""
+        ctx = handle.max_context
+        n = len(pre.token_ids)
+        if n >= ctx:
+            return self._error(
+                400,
+                f"prompt has {n} tokens which exceeds the model's maximum "
+                f"context length of {ctx} tokens",
+                "invalid_request_error")
+        budget = ctx - n
+        if pre.sampling.max_tokens > budget:
+            import dataclasses
+
+            pre.sampling = dataclasses.replace(pre.sampling,
+                                               max_tokens=budget)
+        return None
+
     # -- routes -----------------------------------------------------------
 
     async def health(self, _req: web.Request) -> web.Response:
@@ -104,11 +142,16 @@ class HttpService:
         if handle is None:
             return self._error(404, f"model {body.model!r} not found",
                                "model_not_found")
-        rid = oai.request_id("chatcmpl")
+        rid = self._request_id(request, "chatcmpl")
         try:
             pre = handle.preprocessor.preprocess_chat(body, rid)
         except ValueError as e:
             return self._error(400, str(e))
+        err = self._validate_context(handle, pre)
+        if err is not None:
+            return err
+        logger.info("request %s: chat model=%s prompt_tokens=%d stream=%s",
+                    rid, body.model, len(pre.token_ids), body.stream)
         if body.stream:
             return await self._stream_chat(request, handle, body, pre, rid)
         return await self._unary_chat(handle, body, pre, rid)
@@ -122,11 +165,17 @@ class HttpService:
         if handle is None:
             return self._error(404, f"model {body.model!r} not found",
                                "model_not_found")
-        rid = oai.request_id("cmpl")
+        rid = self._request_id(request, "cmpl")
         try:
             pre = handle.preprocessor.preprocess_completion(body, rid)
         except ValueError as e:
             return self._error(400, str(e))
+        err = self._validate_context(handle, pre)
+        if err is not None:
+            return err
+        logger.info("request %s: completion model=%s prompt_tokens=%d "
+                    "stream=%s", rid, body.model, len(pre.token_ids),
+                    body.stream)
         if body.stream:
             return await self._stream_completion(request, handle, body, pre,
                                                  rid)
@@ -137,9 +186,10 @@ class HttpService:
         det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
         text_parts = []
         reason = None
+        lp_sink = [] if pre.sampling.logprobs else None
         try:
             async for out in self._token_stream(handle, pre, det, body.model,
-                                                start):
+                                                start, lp_sink=lp_sink):
                 text_parts.append(out.text)
                 if out.finished:
                     reason = out.finish_reason
@@ -147,25 +197,96 @@ class HttpService:
             self.metrics.requests_in_flight.add(-1, labels={"model": body.model})
         self._observe_done(body.model, start, len(pre.token_ids),
                            det.completion_tokens)
+        logprobs = None
+        if lp_sink:
+            logprobs = {
+                "tokens": [handle.tokenizer.decode([t]) for t, _ in lp_sink],
+                "token_logprobs": [lp for _, lp in lp_sink],
+            }
         resp = oai.CompletionResponse(
             id=rid, model=body.model,
             choices=[oai.CompletionChoice(
-                text="".join(text_parts), finish_reason=reason)],
+                text="".join(text_parts), finish_reason=reason,
+                logprobs=logprobs)],
             usage=oai.Usage(
                 prompt_tokens=len(pre.token_ids),
                 completion_tokens=det.completion_tokens,
                 total_tokens=len(pre.token_ids) + det.completion_tokens))
         return web.json_response(resp.model_dump(exclude_none=True))
 
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """/v1/embeddings: last-token hidden-state embeddings (reference
+        route `http/service/openai.rs:315`)."""
+        try:
+            body = oai.EmbeddingRequest.model_validate(await request.json())
+        except Exception as e:
+            return self._error(400, f"invalid request: {e}")
+        handle = self._lookup(body.model)
+        if handle is None:
+            return self._error(404, f"model {body.model!r} not found",
+                               "model_not_found")
+        embed = getattr(handle.client, "embed", None)
+        if embed is None:
+            return self._error(501, "this model's engine does not serve "
+                                    "embeddings", "not_implemented")
+        inputs = body.inputs()
+        if not inputs:
+            return self._error(400, "input must be non-empty")
+        if len(inputs) > 128:
+            # Embeddings run one prefill per input on the engine; an
+            # unbounded batch would starve token streaming for seconds.
+            return self._error(400, f"too many inputs ({len(inputs)} > "
+                                    "128 per request)")
+        token_lists = []
+        for item in inputs:
+            toks = (handle.tokenizer.encode(item)
+                    if isinstance(item, str) else list(item))
+            if len(toks) >= handle.max_context:
+                return self._error(
+                    400, f"input of {len(toks)} tokens exceeds the model's "
+                         f"maximum context length of {handle.max_context}")
+            token_lists.append(toks)
+        try:
+            vecs = await embed(token_lists)
+        except (ValueError, NotImplementedError) as e:
+            return self._error(400, str(e))
+        except (ConnectionError, OSError) as e:
+            return self._error(503, f"embedding worker unavailable: {e}",
+                               "service_unavailable")
+
+        def encode_vec(vec):
+            if body.encoding_format == "base64":
+                import numpy as np
+
+                return base64.b64encode(
+                    np.asarray(vec, np.float32).tobytes()).decode("ascii")
+            return [float(x) for x in vec]
+
+        n_in = sum(len(t) for t in token_lists)
+        resp = oai.EmbeddingResponse(
+            model=body.model,
+            data=[oai.EmbeddingData(index=i, embedding=encode_vec(vec))
+                  for i, vec in enumerate(vecs)],
+            usage=oai.Usage(prompt_tokens=n_in, total_tokens=n_in))
+        return web.json_response(resp.model_dump(exclude_none=True))
+
     async def _stream_completion(self, request, handle, body, pre, rid):
         """SSE stream of `text_completion` chunks (ADVICE r1: the unary-only
         handler broke OpenAI streaming clients)."""
 
-        def make_chunk(out):
+        def make_chunk(out, lps):
+            logprobs = None
+            if lps:
+                logprobs = {
+                    "tokens": [handle.tokenizer.decode([t])
+                               for t, _ in lps],
+                    "token_logprobs": [lp for _, lp in lps],
+                }
             return oai.CompletionResponse(
                 id=rid, model=body.model,
                 choices=[oai.CompletionChoice(
-                    text=out.text or "", finish_reason=out.finish_reason)])
+                    text=out.text or "", finish_reason=out.finish_reason,
+                    logprobs=logprobs)])
 
         def make_usage_chunk(usage):
             return oai.CompletionResponse(
@@ -176,12 +297,18 @@ class HttpService:
 
     # -- chat serving internals -------------------------------------------
 
-    async def _token_stream(self, handle, pre, det, model, start_ts):
-        """Engine deltas → TextDeltas, with TTFT/ITL observation."""
+    async def _token_stream(self, handle, pre, det, model, start_ts,
+                            lp_sink=None):
+        """Engine deltas → TextDeltas, with TTFT/ITL observation.
+        `lp_sink`: list collecting (token_id, logprob) pairs when the
+        request asked for logprobs."""
         first = True
         last_t = None
         async for delta in handle.client.generate(pre):
             now = time.monotonic()
+            if (lp_sink is not None and delta.logprobs
+                    and len(delta.logprobs) == len(delta.token_ids)):
+                lp_sink.extend(zip(delta.token_ids, delta.logprobs))
             if delta.token_ids:
                 if first:
                     self.metrics.ttft.observe(now - start_ts,
@@ -209,9 +336,11 @@ class HttpService:
         self.metrics.requests_in_flight.add(1, labels={"model": body.model})
         det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
         parts, reason = [], None
+        lp_sink = [] if pre.sampling.logprobs else None
         try:
             async for out in self._token_stream(handle, pre, det,
-                                                body.model, start):
+                                                body.model, start,
+                                                lp_sink=lp_sink):
                 parts.append(out.text)
                 if out.finished:
                     reason = out.finish_reason
@@ -219,12 +348,32 @@ class HttpService:
             self.metrics.requests_in_flight.add(-1, labels={"model": body.model})
         self._observe_done(body.model, start, len(pre.token_ids),
                            det.completion_tokens)
+        text = "".join(parts)
+        tool_calls = None
+        if body.tools:
+            # Tool-call extraction (reference postprocessor/tool_calling):
+            # only attempted when the client declared tools; parse failure
+            # leaves the message as plain content.
+            from dynamo_tpu.llm.postprocessor import parse_tool_calls
+
+            text, calls = parse_tool_calls(text, body.tool_call_parser)
+            if calls:
+                tool_calls = calls
+                reason = "tool_calls"
+        logprobs = None
+        if lp_sink:
+            logprobs = oai.ChatLogprobs(content=[
+                oai.ChatLogprobEntry(token=handle.tokenizer.decode([t]),
+                                     logprob=lp)
+                for t, lp in lp_sink])
         resp = oai.ChatCompletionResponse(
             id=rid, model=body.model,
             choices=[oai.ChatChoice(
                 message=oai.ChatMessage(role="assistant",
-                                        content="".join(parts)),
-                finish_reason=reason)],
+                                        content=text or None,
+                                        tool_calls=tool_calls),
+                finish_reason=reason,
+                logprobs=logprobs)],
             usage=oai.Usage(
                 prompt_tokens=len(pre.token_ids),
                 completion_tokens=det.completion_tokens,
@@ -232,12 +381,19 @@ class HttpService:
         return web.json_response(resp.model_dump(exclude_none=True))
 
     async def _stream_chat(self, request, handle, body, pre, rid):
-        def make_chunk(out):
+        def make_chunk(out, lps):
+            logprobs = None
+            if lps:
+                logprobs = oai.ChatLogprobs(content=[
+                    oai.ChatLogprobEntry(
+                        token=handle.tokenizer.decode([t]), logprob=lp)
+                    for t, lp in lps])
             return oai.ChatCompletionChunk(
                 id=rid, model=body.model,
                 choices=[oai.ChatStreamChoice(
                     delta=oai.ChatChoiceDelta(content=out.text or None),
-                    finish_reason=out.finish_reason)])
+                    finish_reason=out.finish_reason,
+                    logprobs=logprobs)])
 
         def make_usage_chunk(usage):
             return oai.ChatCompletionChunk(
@@ -266,12 +422,20 @@ class HttpService:
         await response.prepare(request)
 
         det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
+        lp_sink = [] if pre.sampling.logprobs else None
+        lp_sent = 0
         try:
             if head_chunk is not None:
                 await response.write(oai.sse_encode(head_chunk).encode())
             async for out in self._token_stream(handle, pre, det,
-                                                body.model, start):
-                await response.write(oai.sse_encode(make_chunk(out)).encode())
+                                                body.model, start,
+                                                lp_sink=lp_sink):
+                lps = []
+                if lp_sink is not None:
+                    lps = lp_sink[lp_sent:]
+                    lp_sent = len(lp_sink)
+                await response.write(
+                    oai.sse_encode(make_chunk(out, lps)).encode())
                 if out.finished:
                     break
             if (body.stream_options or {}).get("include_usage"):

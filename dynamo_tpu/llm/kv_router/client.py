@@ -123,6 +123,11 @@ class KvRoutedEngineClient:
         healthy = [w for w in live if w not in self._penalty]
         return healthy or live  # all penalised → try anyway
 
+    async def embed(self, token_lists):
+        from dynamo_tpu.llm.discovery import RemoteEngineClient
+
+        return await RemoteEngineClient(self.client).embed(token_lists)
+
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:

@@ -35,6 +35,9 @@ class MigrationClient:
         self.migration_limit = migration_limit
         self.retry_delay = retry_delay
 
+    async def embed(self, token_lists):
+        return await self.inner.embed(token_lists)
+
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:

@@ -1,0 +1,122 @@
+"""Tool-call postprocessor: parse function calls out of generated text.
+
+Role of the reference's `lib/llm/src/postprocessor/tool_calling/
+{parsers,json_parser}.rs`: model families emit tool calls in different
+wire formats; the parser normalises them into OpenAI `tool_calls`
+entries.  Formats covered (the reference's parser matrix):
+
+- hermes:  <tool_call>{"name": ..., "arguments": {...}}</tool_call>
+- mistral: [TOOL_CALLS][{"name": ..., "arguments": {...}}, ...]
+- llama3_json / plain JSON: the whole completion is one call object or a
+  list of them ({"name": ..., "arguments"|"parameters": {...}})
+- "auto" tries each in that order.
+
+Unparseable text returns (text, []) — the completion stays a normal
+assistant message, never an error (parser failures must not break
+serving; reference behaviour)."""
+
+from __future__ import annotations
+
+import json
+import re
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+HERMES_RE = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.DOTALL)
+MISTRAL_TAG = "[TOOL_CALLS]"
+
+
+def _call_entry(name: str, arguments: Any) -> Dict[str, Any]:
+    if not isinstance(arguments, str):
+        arguments = json.dumps(arguments)
+    return {
+        "id": f"call_{uuid.uuid4().hex[:24]}",
+        "type": "function",
+        "function": {"name": name, "arguments": arguments},
+    }
+
+
+def _from_obj(obj: Any) -> Optional[Dict[str, Any]]:
+    if not isinstance(obj, dict) or "name" not in obj:
+        return None
+    args = obj.get("arguments", obj.get("parameters", {}))
+    return _call_entry(obj["name"], args)
+
+
+def _parse_hermes(text: str):
+    calls = []
+    for m in HERMES_RE.finditer(text):
+        try:
+            entry = _from_obj(json.loads(m.group(1)))
+        except json.JSONDecodeError:
+            continue
+        if entry:
+            calls.append(entry)
+    if not calls:
+        return text, []
+    content = HERMES_RE.sub("", text).strip()
+    return content, calls
+
+
+def _parse_mistral(text: str):
+    idx = text.find(MISTRAL_TAG)
+    if idx < 0:
+        return text, []
+    payload = text[idx + len(MISTRAL_TAG):].strip()
+    try:
+        data = json.loads(payload)
+    except json.JSONDecodeError:
+        return text, []
+    if isinstance(data, dict):
+        data = [data]
+    calls = [e for e in (_from_obj(o) for o in data) if e]
+    if not calls:
+        return text, []  # keep the full text: nothing valid was extracted
+    return text[:idx].strip(), calls
+
+
+def _parse_json(text: str):
+    stripped = text.strip()
+    # Fenced model output (```json ... ```) is common; unwrap it.
+    if stripped.startswith("```"):
+        stripped = re.sub(r"^```(?:json)?\s*|\s*```$", "", stripped,
+                          flags=re.DOTALL).strip()
+    if not (stripped.startswith("{") or stripped.startswith("[")):
+        return text, []
+    try:
+        data = json.loads(stripped)
+    except json.JSONDecodeError:
+        return text, []
+    if isinstance(data, dict):
+        data = [data]
+    if not isinstance(data, list):
+        return text, []
+    calls = [e for e in (_from_obj(o) for o in data) if e]
+    if calls and len(calls) == len(data):
+        return "", calls
+    return text, []
+
+
+PARSERS = {
+    "hermes": _parse_hermes,
+    "mistral": _parse_mistral,
+    "json": _parse_json,
+    "llama3_json": _parse_json,
+}
+
+
+def parse_tool_calls(text: str, fmt: str = "auto"
+                     ) -> Tuple[str, List[Dict[str, Any]]]:
+    """Returns (remaining_content, tool_calls).  tool_calls empty when
+    nothing parses — the text passes through untouched."""
+    if fmt != "auto":
+        parser = PARSERS.get(fmt)
+        if parser is None:
+            raise ValueError(f"unknown tool-call format {fmt!r}; "
+                             f"have {sorted(PARSERS)} or 'auto'")
+        return parser(text)
+    for parser in (_parse_hermes, _parse_mistral, _parse_json):
+        content, calls = parser(text)
+        if calls:
+            return content, calls
+    return text, []

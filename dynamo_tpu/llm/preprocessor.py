@@ -108,6 +108,7 @@ class OpenAIPreprocessor:
             max_tokens=request.effective_max_tokens(self.default_max_tokens),
             stop_token_ids=tuple(self.tokenizer.eos_token_ids),
             seed=request.seed,
+            logprobs=bool(request.logprobs),
         )
         return PreprocessedRequest(
             request_id=request_id,

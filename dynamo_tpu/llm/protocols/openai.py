@@ -107,6 +107,25 @@ class SamplingFields(BaseModel):
 class ChatCompletionRequest(SamplingFields):
     model: str
     messages: List[ChatMessage]
+    # Tool calling (reference postprocessor/tool_calling): declared tools
+    # flow into the chat template; responses are parsed for call syntax.
+    tools: Optional[List[Dict[str, Any]]] = None
+    tool_choice: Optional[Union[str, Dict[str, Any]]] = None
+    # Parser family for tool-call extraction ("auto" tries them all).
+    tool_call_parser: str = "auto"
+
+    @field_validator("tool_call_parser")
+    @classmethod
+    def _known_parser(cls, v):
+        # Validate BEFORE generation runs — an unknown parser failing
+        # after the tokens were produced would waste the whole request.
+        from dynamo_tpu.llm.postprocessor import PARSERS
+
+        if v != "auto" and v not in PARSERS:
+            raise ValueError(
+                f"unknown tool_call_parser {v!r}; have "
+                f"{sorted(PARSERS)} or 'auto'")
+        return v
 
     @field_validator("messages")
     @classmethod
@@ -125,6 +144,7 @@ class ChatStreamChoice(BaseModel):
     index: int = 0
     delta: ChatChoiceDelta
     finish_reason: Optional[str] = None
+    logprobs: Optional["ChatLogprobs"] = None
 
 
 class ChatCompletionChunk(BaseModel):
@@ -136,10 +156,20 @@ class ChatCompletionChunk(BaseModel):
     usage: Optional[Usage] = None
 
 
+class ChatLogprobEntry(BaseModel):
+    token: str
+    logprob: float
+
+
+class ChatLogprobs(BaseModel):
+    content: List[ChatLogprobEntry] = Field(default_factory=list)
+
+
 class ChatChoice(BaseModel):
     index: int = 0
     message: ChatMessage
     finish_reason: Optional[str] = None
+    logprobs: Optional[ChatLogprobs] = None
 
 
 class ChatCompletionResponse(BaseModel):
@@ -179,6 +209,42 @@ class CompletionResponse(BaseModel):
     model: str
     choices: List[CompletionChoice]
     usage: Optional[Usage] = None
+
+
+# ---------------------------------------------------------------------------
+# Embeddings
+
+
+class EmbeddingRequest(BaseModel):
+    model: str
+    input: Union[str, List[str], List[int], List[List[int]]]
+    encoding_format: Literal["float", "base64"] = "float"
+    user: Optional[str] = None
+
+    def inputs(self) -> List[Union[str, List[int]]]:
+        """Normalise to a list of prompts (strings or token lists)."""
+        if isinstance(self.input, str):
+            return [self.input]
+        if not self.input:
+            return []
+        if isinstance(self.input[0], int):
+            return [list(self.input)]
+        return list(self.input)
+
+
+class EmbeddingData(BaseModel):
+    object: Literal["embedding"] = "embedding"
+    index: int
+    # float list, or a base64 string of packed float32 when the request
+    # asked for encoding_format="base64" (OpenAI SDK default).
+    embedding: Union[List[float], str]
+
+
+class EmbeddingResponse(BaseModel):
+    object: Literal["list"] = "list"
+    data: List[EmbeddingData] = Field(default_factory=list)
+    model: str
+    usage: Usage = Field(default_factory=Usage)
 
 
 # ---------------------------------------------------------------------------

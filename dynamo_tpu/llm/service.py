@@ -43,6 +43,11 @@ class LocalEngineClient:
                 request.request_id, request.token_ids, request.sampling):
             yield delta
 
+    async def embed(self, token_lists):
+        """Last-token hidden-state embeddings: [n, hidden] (the
+        /v1/embeddings engine surface)."""
+        return await self._engine.embed(token_lists)
+
 
 @dataclass
 class ModelHandle:
@@ -52,6 +57,10 @@ class ModelHandle:
     tokenizer: Tokenizer
     preprocessor: OpenAIPreprocessor
     client: EngineClient
+    # Context ceiling for boundary validation (reference validate.rs);
+    # requests whose prompt alone exceeds it get a 400, and max_tokens is
+    # clamped to fit.
+    max_context: int = 8192
 
 
 class ModelManager:

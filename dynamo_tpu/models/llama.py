@@ -138,6 +138,7 @@ def _attention_block(
     block_size: int,
     k_cache: jax.Array,      # [S, Hkv, D] this layer's cache buffer
     v_cache: jax.Array,
+    sp_mesh=None,            # mesh → ring attention over its sp axis
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (attn_out, k_cache', v_cache').  The layer cache buffers are
     standalone arrays (not slices of a stacked cache) so the scatter in
@@ -158,7 +159,30 @@ def _attention_block(
         v.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
     )
 
-    if ctx_slots is None:
+    if sp_mesh is not None:
+        # Sequence-parallel full-prompt prefill: the chunk IS the whole
+        # sequence, sharded over sp — ring attention visits every K/V
+        # block over the ICI ring (ops/ring_attention.py); no cached
+        # context is read (chunked continuation stays on the paths
+        # below).  Cache writes above remain GSPMD-managed.
+        from jax.sharding import PartitionSpec as P
+
+        from dynamo_tpu.ops.ring_attention import ring_causal_attention
+
+        # Heads stay tp-sharded inside the ring (attention is
+        # head-independent): without "tp" in the specs GSPMD would
+        # all-gather the column-parallel q/k/v projections and every tp
+        # shard would redo all heads' attention.
+        spec4 = P("dp", "sp", "tp", None)
+        out = jax.shard_map(
+            lambda qs, ks, vs, ps: ring_causal_attention(
+                qs, ks, vs, ps, axis_name="sp"),
+            mesh=sp_mesh,
+            in_specs=(spec4, spec4, spec4, P("dp", "sp")),
+            out_specs=spec4,
+            check_vma=False,
+        )(q, k, v, positions)
+    elif ctx_slots is None:
         # Decode hot path: stream pages via the Pallas kernel — no
         # materialised context gather (ops/pallas/paged_attention.py).
         from dynamo_tpu.ops.pallas import paged_decode_attention
@@ -277,7 +301,9 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                       use_pallas_decode: bool = False,
                       moe_mode: str = "dense",
                       mesh=None,
-                      with_expert_load: bool = False):
+                      with_expert_load: bool = False,
+                      sp_ring: bool = False,
+                      return_hidden: bool = False):
     """Build the jitted unified step for a given cache geometry.
 
     Separate factory (rather than passing block_size as a traced value)
@@ -292,6 +318,12 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
     step return (logits, cache, expert_load[E]) — the telemetry the
     reference exposes per worker (`base_handlers.py:40-62`); the default
     2-tuple return keeps every non-MoE call site unchanged.
+
+    `sp_ring`: sequence-parallel FULL-PROMPT prefill — the T axis shards
+    over the mesh's sp axis and attention runs on the ICI ring
+    (ops/ring_attention.py).  The chunk must be the whole sequence (no
+    prior cached context is read); build via
+    parallel.sharding.make_sp_prefill_step.
     """
     cfg.validate()
 
@@ -311,8 +343,8 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
         write_slots = kvc.slots_for_positions(block_tables, positions, block_size)
         write_slots = write_slots.reshape(B * T)
 
-        if use_pallas_decode and T == 1:
-            ctx_positions = ctx_slots = None  # kernel streams pages itself
+        if (use_pallas_decode and T == 1) or (sp_ring and T > 1):
+            ctx_positions = ctx_slots = None  # no materialised ctx gather
         else:
             ctx_positions = jnp.broadcast_to(
                 jnp.arange(C, dtype=jnp.int32), (B, C)
@@ -331,6 +363,7 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                 positions, seq_lens, write_slots, ctx_slots, ctx_positions,
                 block_tables, block_size,
                 k_layers[i], v_layers[i],
+                sp_mesh=mesh if (sp_ring and T > 1) else None,
             )
             x = x + attn_out
             h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
@@ -351,11 +384,16 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
             x = jnp.take_along_axis(
                 x, sample_positions[:, None, None].astype(jnp.int32), axis=1
             )[:, 0]
+        new_cache = {"k": k_layers, "v": v_layers}
+        if return_hidden:
+            # Embeddings path: the last-token final-norm hidden state IS
+            # the embedding (causal-LM convention, e5-mistral-style); the
+            # LM head is skipped entirely.
+            return x.astype(jnp.float32), new_cache
         head = params.get("lm_head")
         if head is None:
             head = params["embed"].T
         logits = (x @ head).astype(jnp.float32)
-        new_cache = {"k": k_layers, "v": v_layers}
         if with_expert_load:
             return logits, new_cache, expert_load
         return logits, new_cache

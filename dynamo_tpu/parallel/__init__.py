@@ -19,6 +19,7 @@ from dynamo_tpu.parallel.sharding import (
     cache_pspecs,
     data_pspecs,
     make_sharded_step,
+    make_sp_prefill_step,
     param_pspecs,
     shard_pytree,
 )
@@ -31,4 +32,5 @@ __all__ = [
     "data_pspecs",
     "shard_pytree",
     "make_sharded_step",
+    "make_sp_prefill_step",
 ]

@@ -125,6 +125,48 @@ def shard_pytree(tree, pspecs, mesh: Mesh):
     )
 
 
+def make_sp_prefill_step(cfg: ModelConfig, block_size: int, mesh: Mesh):
+    """Jit the SEQUENCE-PARALLEL full-prompt prefill step: the token axis
+    shards over the mesh's sp axis and attention runs on the ICI ring
+    (ops/ring_attention.py) — the long-context prefill path SURVEY §2.5
+    demands.  Contract: the chunk is the WHOLE prompt (positions 0..T-1;
+    no prior cached context is read); T must divide by sp.
+
+    Returns `step(params, cache, tokens, positions, seq_lens,
+    block_tables, sample_positions)` → (logits, cache), same signature as
+    the regular step but with tokens/positions sharded P(dp, sp).
+    """
+    from dynamo_tpu.models.llama import make_forward_step
+
+    validate(cfg, mesh)
+    # MoE under sp: dense compute (the dispatch shard_map shards tokens
+    # over dp×ep, which conflicts with the sp sharding of a prefill chunk).
+    step = make_forward_step(cfg, block_size, moe_mode="dense", mesh=mesh,
+                             sp_ring=True)
+    seq = NamedSharding(mesh, P("dp", "sp"))
+    in_shardings = (
+        jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg)),
+        jax.tree.map(lambda s: NamedSharding(mesh, s),
+                     cache_pspecs(cfg.num_layers)),
+        seq,                                       # tokens [B, T]
+        seq,                                       # positions [B, T]
+        NamedSharding(mesh, P("dp")),              # seq_lens [B]
+        NamedSharding(mesh, P("dp", None)),        # block_tables [B, P]
+        NamedSharding(mesh, P("dp")),              # sample_positions [B]
+    )
+    out_shardings = (
+        NamedSharding(mesh, P("dp", None)),        # logits [B, V]
+        jax.tree.map(lambda s: NamedSharding(mesh, s),
+                     cache_pspecs(cfg.num_layers)),
+    )
+    return jax.jit(
+        step,
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+        donate_argnums=(1,),
+    )
+
+
 def resolve_moe_mode(cfg: ModelConfig, mesh: Mesh,
                      moe_mode: str = "auto") -> str:
     """'auto' → all-to-all dispatch when an ep axis exists and tp == 1

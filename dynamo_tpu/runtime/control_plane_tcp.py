@@ -3,8 +3,9 @@
 `ControlPlaneServer` hosts a ControlPlaneState over asyncio TCP with
 newline-delimited JSON frames; `ControlPlaneClient` implements the same
 interface as InProcessControlPlane, so DistributedRuntime doesn't care
-which it got.  (Native C++ broker: see csrc/ — this Python server defines
-the wire protocol the C++ implementation speaks too.)
+which it got.  The wire protocol is deliberately transport-simple
+(line-delimited JSON) so alternative broker implementations can speak it
+without sharing code.
 
 Wire protocol (one JSON object per line):
   request:  {"op": <name>, "id": N, ...args}

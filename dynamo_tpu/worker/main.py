@@ -28,8 +28,15 @@ METRICS_SUBJECT = "load_metrics"       # reference stats endpoint name
 
 
 def parse_args(argv=None):
-    p = argparse.ArgumentParser("dynamo_tpu.worker")
-    p.add_argument("--control-plane", required=True,
+    from dynamo_tpu.runtime.config import (
+        apply_to_parser_defaults, load_layered_config)
+
+    p = argparse.ArgumentParser(
+        "dynamo_tpu.worker",
+        description="Layered config: defaults < dynamo.toml [worker] "
+                    "section < DYN_* env < these flags "
+                    "(runtime/config.py).")
+    p.add_argument("--control-plane", default=None,
                    help="control plane HOST:PORT")
     p.add_argument("--namespace", default="dynamo")
     p.add_argument("--component", default="backend")
@@ -54,7 +61,17 @@ def parse_args(argv=None):
     p.add_argument("--block-size", type=int, default=64)
     p.add_argument("--speedup-ratio", type=float, default=10.0)
     p.add_argument("--metrics-interval", type=float, default=1.0)
-    return p.parse_args(argv)
+    apply_to_parser_defaults(p, load_layered_config(
+        {"control_plane": None, "namespace": "dynamo",
+         "component": "backend", "endpoint": "generate",
+         "model_name": "dynamo-tpu", "num_blocks": 512, "block_size": 64,
+         "metrics_interval": 1.0},
+        section="worker"))
+    args = p.parse_args(argv)
+    if not args.control_plane:
+        p.error("--control-plane is required (flag, DYN_CONTROL_PLANE, "
+                "or dynamo.toml)")
+    return args
 
 
 async def build_engine(args, kv_event_sink):
@@ -121,9 +138,11 @@ async def run(args) -> None:
     if transfer_engine is not None:
         from dynamo_tpu.llm.block_manager.transfer import (
             KV_BLOCKS_ENDPOINT, make_kv_blocks_handler)
+        from dynamo_tpu.llm.discovery import EMBED_ENDPOINT, embed_wire_handler
 
         runtime.rpc.register(KV_BLOCKS_ENDPOINT,
                              make_kv_blocks_handler(transfer_engine))
+        runtime.rpc.register(EMBED_ENDPOINT, embed_wire_handler(engine))
 
     disagg_client = None
     prefill_task = None

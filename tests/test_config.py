@@ -1,0 +1,136 @@
+"""Layered config (defaults < TOML < env < flags) + trace context."""
+
+import argparse
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dynamo_tpu.runtime.config import (
+    apply_to_parser_defaults,
+    load_layered_config,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_layers_precedence(tmp_path, monkeypatch):
+    toml = tmp_path / "dynamo.toml"
+    toml.write_text("""
+block_size = 16
+namespace = "from-toml"
+
+[worker]
+num_blocks = 1024
+""")
+    monkeypatch.setenv("DYN_CONFIG", str(toml))
+    monkeypatch.setenv("DYN_NAMESPACE", '"from-env"')
+    cfg = load_layered_config(
+        {"block_size": 64, "namespace": "dynamo", "num_blocks": 512,
+         "metrics_interval": 1.0},
+        section="worker")
+    assert cfg["block_size"] == 16          # toml top-level beats default
+    assert cfg["num_blocks"] == 1024        # toml [worker] section
+    assert cfg["namespace"] == "from-env"   # env beats toml
+    assert cfg["metrics_interval"] == 1.0   # default survives
+
+
+def test_env_value_parsing(monkeypatch):
+    monkeypatch.setenv("DYN_HTTP_PORT", "9090")
+    monkeypatch.setenv("DYN_MOCKER", "true")
+    monkeypatch.setenv("DYN_MODEL_NAME", "plain-string")
+    cfg = load_layered_config(
+        {"http_port": 8080, "mocker": False, "model_name": "x"})
+    assert cfg["http_port"] == 9090 and cfg["http_port"] != "9090"
+    assert cfg["mocker"] is True
+    assert cfg["model_name"] == "plain-string"
+
+
+def test_flags_stay_top_layer(monkeypatch):
+    monkeypatch.setenv("DYN_BLOCK_SIZE", "32")
+    p = argparse.ArgumentParser()
+    p.add_argument("--block-size", type=int, default=64)
+    apply_to_parser_defaults(p, load_layered_config({"block_size": 64}))
+    assert p.parse_args([]).block_size == 32          # env layer
+    assert p.parse_args(["--block-size", "8"]).block_size == 8  # flag wins
+
+
+def test_bad_toml_is_loud(tmp_path, monkeypatch):
+    bad = tmp_path / "bad.toml"
+    bad.write_text("not valid [toml")
+    monkeypatch.setenv("DYN_CONFIG", str(bad))
+    with pytest.raises(ValueError, match="bad config file"):
+        load_layered_config({"x": 1})
+
+
+@pytest.mark.e2e
+def test_trace_id_spans_frontend_and_worker_logs():
+    """One X-Request-Id must be grep-able in BOTH process logs (reference
+    distributed trace context, logging.rs:73-79)."""
+    from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneServer
+
+    async def main():
+        srv = ControlPlaneServer()
+        port = await srv.start()
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   PYTHONUNBUFFERED="1")
+        env.pop("DYN_CONFIG", None)
+        logs = {}
+        procs = {}
+        for name, argv in (
+                ("worker", ["-m", "dynamo_tpu.worker",
+                            "--control-plane", f"127.0.0.1:{port}",
+                            "--mocker", "--model-name", "m",
+                            "--block-size", "8"]),
+                ("frontend", ["-m", "dynamo_tpu.frontend",
+                              "--control-plane", f"127.0.0.1:{port}",
+                              "--http-port", "18432"])):
+            logs[name] = open(f"/tmp/trace_test_{name}_{os.getpid()}.log",
+                              "w+")
+            procs[name] = subprocess.Popen(
+                [sys.executable, *argv], env=env, cwd=REPO,
+                stdout=logs[name], stderr=subprocess.STDOUT)
+        try:
+            import aiohttp
+
+            trace_id = "trace-e2e-12345"
+            deadline = time.monotonic() + 40
+            status = None
+            while time.monotonic() < deadline:
+                await asyncio.sleep(1.0)
+                try:
+                    async with aiohttp.ClientSession() as s:
+                        async with s.post(
+                                "http://127.0.0.1:18432/v1/completions",
+                                json={"model": "m", "prompt": "hello",
+                                      "max_tokens": 4},
+                                headers={"X-Request-Id": trace_id}) as r:
+                            status = r.status
+                            await r.read()
+                    if status == 200:
+                        break
+                except aiohttp.ClientError:
+                    continue
+            assert status == 200
+            await asyncio.sleep(0.5)
+            for name in ("frontend", "worker"):
+                logs[name].flush()
+                logs[name].seek(0)
+                content = logs[name].read()
+                assert trace_id in content, f"{name} log lacks trace id"
+        finally:
+            for pr in procs.values():
+                pr.terminate()
+            for pr in procs.values():
+                try:
+                    pr.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
+            for f in logs.values():
+                f.close()
+            await srv.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))

@@ -234,3 +234,220 @@ def test_completions_streaming():
             await engine.stop()
 
     _run(main())
+
+
+def test_over_context_prompt_rejected_400():
+    """Boundary validation (VERDICT r2 weak #7): a prompt the model can't
+    fit returns a 400 error shape, not a silent zero-token LENGTH stop."""
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        handle = svc.models.get("tiny")
+        handle.max_context = 64
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/completions", json={
+                        "model": "tiny", "prompt": "x" * 100,
+                        "max_tokens": 4}) as r:
+                    assert r.status == 400
+                    err = await r.json()
+                    assert err["error"]["type"] == "invalid_request_error"
+                    assert "maximum context length" in err["error"]["message"]
+                # A prompt that fits but over-asks max_tokens is clamped,
+                # not rejected: the stream finishes at the ceiling.
+                async with s.post(f"{base}/v1/completions", json={
+                        "model": "tiny", "prompt": "x" * 32,
+                        "temperature": 0.0,
+                        "max_tokens": 10_000}) as r:
+                    assert r.status == 200
+                    data = await r.json()
+                    assert data["usage"]["completion_tokens"] <= 32
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_completions_logprobs():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/completions", json={
+                        "model": "tiny", "prompt": "hello",
+                        "temperature": 0.0, "max_tokens": 4,
+                        "logprobs": 1}) as r:
+                    assert r.status == 200
+                    data = await r.json()
+            lp = data["choices"][0]["logprobs"]
+            assert len(lp["token_logprobs"]) == 4
+            assert len(lp["tokens"]) == 4
+            assert all(x <= 0.0 for x in lp["token_logprobs"])
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_chat_logprobs():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/chat/completions", json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "temperature": 0.0, "max_tokens": 3,
+                        "logprobs": True}) as r:
+                    assert r.status == 200
+                    data = await r.json()
+            entries = data["choices"][0]["logprobs"]["content"]
+            assert len(entries) == 3
+            assert all(e["logprob"] <= 0.0 for e in entries)
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_embeddings_route():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/embeddings", json={
+                        "model": "tiny",
+                        "input": ["hello world", "goodbye"]}) as r:
+                    assert r.status == 200
+                    data = await r.json()
+            assert len(data["data"]) == 2
+            dim = len(data["data"][0]["embedding"])
+            assert dim == mcfg.get_config("tiny-test").hidden_size
+            assert data["data"][1]["index"] == 1
+            # Same input → same embedding (deterministic forward).
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/embeddings", json={
+                        "model": "tiny", "input": "hello world"}) as r:
+                    again = await r.json()
+            assert again["data"][0]["embedding"] == \
+                data["data"][0]["embedding"]
+            assert data["usage"]["prompt_tokens"] > 0
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_streaming_logprobs_and_duplicate_trace_ids():
+    """Stream chunks carry logprobs; two concurrent requests sharing an
+    X-Request-Id header must both succeed (unique engine ids)."""
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/completions", json={
+                        "model": "tiny", "prompt": "hey",
+                        "temperature": 0.0, "max_tokens": 3,
+                        "logprobs": 1, "stream": True}) as r:
+                    assert r.status == 200
+                    body = (await r.read()).decode()
+            lps = []
+            for line in body.splitlines():
+                if line.startswith("data:") and "[DONE]" not in line:
+                    d = json.loads(line[5:])
+                    for c in d.get("choices", []):
+                        lp = c.get("logprobs")
+                        if lp:
+                            lps.extend(lp["token_logprobs"])
+            assert len(lps) == 3 and all(x <= 0.0 for x in lps)
+
+            async def one():
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(f"{base}/v1/completions", json={
+                            "model": "tiny", "prompt": "abc",
+                            "max_tokens": 4},
+                            headers={"X-Request-Id": "dup-id"}) as r:
+                        return r.status, await r.json()
+            (s1, d1), (s2, d2) = await asyncio.gather(one(), one())
+            assert s1 == 200 and s2 == 200
+            assert d1["usage"]["completion_tokens"] == 4
+            assert d2["usage"]["completion_tokens"] == 4
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_embeddings_base64_and_caps():
+    import aiohttp
+    import base64 as b64
+    import numpy as np
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/embeddings", json={
+                        "model": "tiny", "input": "hi",
+                        "encoding_format": "base64"}) as r:
+                    assert r.status == 200
+                    data = await r.json()
+                emb = data["data"][0]["embedding"]
+                assert isinstance(emb, str)
+                vec = np.frombuffer(b64.b64decode(emb), np.float32)
+                assert vec.shape[0] == \
+                    mcfg.get_config("tiny-test").hidden_size
+                async with s.post(f"{base}/v1/embeddings", json={
+                        "model": "tiny",
+                        "input": ["x"] * 200}) as r:
+                    assert r.status == 400
+                    assert "too many" in (await r.json())["error"]["message"]
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_unknown_tool_parser_rejected_before_generation():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/chat/completions", json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "tools": [{"type": "function",
+                                   "function": {"name": "f"}}],
+                        "tool_call_parser": "bogus"}) as r:
+                    assert r.status == 400
+                    assert "tool_call_parser" in \
+                        (await r.json())["error"]["message"]
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())

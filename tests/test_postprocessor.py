@@ -1,0 +1,57 @@
+"""Tool-call parser conformance (reference postprocessor/tool_calling)."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.llm.postprocessor import parse_tool_calls
+
+
+def _one(calls):
+    assert len(calls) == 1
+    c = calls[0]
+    assert c["type"] == "function" and c["id"].startswith("call_")
+    return c["function"]["name"], json.loads(c["function"]["arguments"])
+
+
+def test_hermes_format():
+    text = ('thinking...\n<tool_call>\n{"name": "get_weather", '
+            '"arguments": {"city": "Oslo"}}\n</tool_call>')
+    content, calls = parse_tool_calls(text)
+    assert _one(calls) == ("get_weather", {"city": "Oslo"})
+    assert content == "thinking..."
+
+
+def test_mistral_format():
+    text = ('[TOOL_CALLS][{"name": "add", "arguments": {"a": 1, "b": 2}},'
+            ' {"name": "sub", "arguments": {"a": 3, "b": 1}}]')
+    content, calls = parse_tool_calls(text)
+    assert len(calls) == 2
+    assert calls[0]["function"]["name"] == "add"
+    assert content == ""
+
+
+def test_plain_json_and_fenced():
+    content, calls = parse_tool_calls(
+        '{"name": "f", "arguments": {"x": 1}}')
+    assert _one(calls) == ("f", {"x": 1})
+    content, calls = parse_tool_calls(
+        '```json\n{"name": "g", "parameters": {"y": 2}}\n```')
+    assert _one(calls) == ("g", {"y": 2})
+
+
+def test_non_tool_text_passes_through():
+    for text in ("plain prose answer", '{"not_a_call": 1}', "{broken json",
+                 "[1, 2, 3]"):
+        content, calls = parse_tool_calls(text)
+        assert calls == []
+        assert content == text
+
+
+def test_explicit_format_and_unknown():
+    _, calls = parse_tool_calls(
+        '<tool_call>{"name": "h", "arguments": {}}</tool_call>',
+        fmt="hermes")
+    assert len(calls) == 1
+    with pytest.raises(ValueError, match="unknown tool-call format"):
+        parse_tool_calls("x", fmt="nope")
