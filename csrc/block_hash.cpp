@@ -1,0 +1,69 @@
+// Native chained block hashing — the router/prefix-cache hot path.
+//
+// Role of the reference's Rust `lib/tokens` + `kv_router/indexer.rs:123`
+// (compute_block_hash_for_seq): every routed request chains xxh3_64 over
+// its prompt blocks, and on long prompts the per-block Python loop in
+// dynamo_tpu/tokens.py dominates.  This translation unit does the whole
+// chain in one call.  The byte layout MUST match tokens.py hash_block:
+// xxh3_64( parent_hash as little-endian u64 || tokens as little-endian
+// u32[] ) — tokens.py's Python implementation stays as the fallback and
+// the parity oracle (tests/test_native.py).
+//
+// Built by dynamo_tpu/native.py on first use:
+//   g++ -O3 -shared -fPIC -o libblockhash.so block_hash.cpp
+//
+// vendor/xxhash.h is Yann Collet's BSD-2-Clause single-header xxHash.
+
+#define XXH_INLINE_ALL
+#include "vendor/xxhash.h"
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Chained sequence hashes over full blocks.
+//   tokens:     n little-endian u32 token ids
+//   block_size: tokens per block (> 0)
+//   parent:     chain seed (ROOT_PARENT_HASH or a prior block's hash)
+//   out:        n / block_size slots, filled with the chained hashes
+// Returns the number of full blocks hashed.
+int64_t chained_block_hashes(const uint32_t* tokens, int64_t n,
+                             int64_t block_size, uint64_t parent,
+                             uint64_t* out) {
+    if (block_size <= 0 || n < 0) return -1;
+    const int64_t n_full = n / block_size;
+    // Hash input buffer: parent (8 bytes) then the block's tokens.
+    // Little-endian hosts (x86/TPU VMs) can hash the token memory as-is
+    // after the seed prefix; a scratch buffer keeps it contiguous.
+    const size_t block_bytes = 8 + static_cast<size_t>(block_size) * 4;
+    uint8_t stack_buf[8 + 4 * 1024];
+    uint8_t* buf = block_bytes <= sizeof(stack_buf)
+                       ? stack_buf
+                       : new uint8_t[block_bytes];
+    uint64_t h = parent;
+    for (int64_t i = 0; i < n_full; ++i) {
+        std::memcpy(buf, &h, 8);
+        std::memcpy(buf + 8, tokens + i * block_size,
+                    static_cast<size_t>(block_size) * 4);
+        h = XXH3_64bits(buf, block_bytes);
+        out[i] = h;
+    }
+    if (buf != stack_buf) delete[] buf;
+    return n_full;
+}
+
+// Single-block hash (SaltedBlockHasher and incremental seal paths).
+uint64_t hash_one_block(const uint32_t* tokens, int64_t n, uint64_t parent) {
+    const size_t nbytes = 8 + static_cast<size_t>(n) * 4;
+    uint8_t stack_buf[8 + 4 * 1024];
+    uint8_t* buf =
+        nbytes <= sizeof(stack_buf) ? stack_buf : new uint8_t[nbytes];
+    std::memcpy(buf, &parent, 8);
+    std::memcpy(buf + 8, tokens, static_cast<size_t>(n) * 4);
+    uint64_t h = XXH3_64bits(buf, nbytes);
+    if (buf != stack_buf) delete[] buf;
+    return h;
+}
+
+}  // extern "C"

@@ -53,6 +53,15 @@ def parse_args(argv=None):
     p.add_argument("--max-tokens-default", type=int, default=512)
     p.add_argument("--speedup-ratio", type=float, default=10.0,
                    help="mocker simulated-time compression")
+    p.add_argument("--input", default="http",
+                   choices=["http", "text", "batch"],
+                   help="ingress mode (reference dynamo-run in=http|text|"
+                        "batch): http server, interactive REPL, or "
+                        "offline JSONL batch")
+    p.add_argument("--batch-file", default=None,
+                   help="batch mode: JSONL input ({\"prompt\": ...})")
+    p.add_argument("--batch-output", default=None,
+                   help="batch mode: JSONL output (default: input + .out)")
     from dynamo_tpu.runtime.config import (
         apply_to_parser_defaults, load_layered_config)
 
@@ -100,7 +109,135 @@ async def build_model_handle(args) -> tuple:
     return handle, engine.stop
 
 
+async def _wait_for_model(models: ModelManager, timeout: float = 30.0):
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        names = models.names()
+        if names:
+            return models.get(names[0])
+        await asyncio.sleep(0.1)
+    raise TimeoutError("no model became available")
+
+
+async def run_text_repl(models: ModelManager) -> None:
+    """Interactive chat REPL on stdin/stdout (reference `dynamo-run
+    in=text`, `entrypoint/input/text.rs`).  One exchange per line; Ctrl-D
+    or /quit exits; /clear resets the conversation."""
+    from dynamo_tpu.llm.backend import StreamDetokenizer
+    from dynamo_tpu.llm.protocols.openai import (
+        ChatCompletionRequest, ChatMessage, request_id)
+
+    handle = await _wait_for_model(models)
+    print(f"chat with {handle.name!r} — /quit exits, /clear resets",
+          flush=True)
+    history = []
+    loop = asyncio.get_running_loop()
+    while True:
+        line = await loop.run_in_executor(None, _read_prompt)
+        if line is None or line.strip() == "/quit":
+            return
+        if line.strip() == "/clear":
+            history = []
+            print("(history cleared)", flush=True)
+            continue
+        if not line.strip():
+            continue
+        history.append(ChatMessage(role="user", content=line))
+        body = ChatCompletionRequest(model=handle.name, messages=history)
+        pre = handle.preprocessor.preprocess_chat(body, request_id("repl"))
+        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
+        parts = []
+        async for delta in handle.client.generate(pre):
+            if delta.token_ids:
+                out = det.push_tokens(delta.token_ids)
+                if out.text:
+                    parts.append(out.text)
+                    print(out.text, end="", flush=True)
+                if out.finished:
+                    break
+            if delta.finished:
+                break
+        print(flush=True)
+        history.append(ChatMessage(role="assistant",
+                                   content="".join(parts)))
+
+
+def _read_prompt():
+    try:
+        return input("> ")
+    except EOFError:
+        return None
+
+
+async def run_batch(models: ModelManager, batch_file: str,
+                    batch_output: str, concurrency: int = 32) -> dict:
+    """Offline batch inference (reference `dynamo-run in=batch`,
+    `entrypoint/input/batch.rs`): JSONL in ({"prompt", "max_tokens"?}),
+    JSONL out (adds "completion", token counts), throughput summary."""
+    import json
+    import time as _time
+
+    from dynamo_tpu.llm.backend import StreamDetokenizer
+    from dynamo_tpu.llm.protocols.openai import CompletionRequest, request_id
+
+    handle = await _wait_for_model(models)
+    with open(batch_file) as f:
+        jobs = [json.loads(line) for line in f if line.strip()]
+    sem = asyncio.Semaphore(concurrency)
+    results = [None] * len(jobs)
+    t0 = _time.monotonic()
+
+    async def one(i, job):
+        async with sem:
+            # One bad job (missing field, over-context prompt, worker
+            # error) must not abort the other N-1: record the error in
+            # its row and keep going — offline batches are restartable
+            # only if the output file exists.
+            try:
+                body = CompletionRequest(
+                    model=handle.name, prompt=job["prompt"],
+                    max_tokens=job.get("max_tokens", 128),
+                    temperature=job.get("temperature", 0.0))
+                pre = handle.preprocessor.preprocess_completion(
+                    body, request_id(f"batch{i}"))
+                det = StreamDetokenizer(handle.tokenizer,
+                                        pre.stop_sequences)
+                parts = []
+                async for delta in handle.client.generate(pre):
+                    if delta.token_ids:
+                        out = det.push_tokens(delta.token_ids)
+                        if out.text:
+                            parts.append(out.text)
+                        if out.finished:
+                            break
+                    if delta.finished:
+                        break
+                results[i] = {**job, "completion": "".join(parts),
+                              "prompt_tokens": len(pre.token_ids),
+                              "completion_tokens": det.completion_tokens}
+            except Exception as e:
+                results[i] = {**job, "error": f"{type(e).__name__}: {e}",
+                              "completion_tokens": 0}
+
+    await asyncio.gather(*(one(i, j) for i, j in enumerate(jobs)))
+    elapsed = _time.monotonic() - t0
+    out_tokens = sum(r["completion_tokens"] for r in results)
+    with open(batch_output, "w") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")
+    summary = {"requests": len(jobs), "output_tokens": out_tokens,
+               "elapsed_s": round(elapsed, 3),
+               "tok_s": round(out_tokens / elapsed, 2) if elapsed else 0.0}
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
 async def run(args) -> None:
+    from dynamo_tpu import native
+
+    await native.warmup()  # build the C++ hasher off the event loop
     models = ModelManager()
     shutdowns = []
 
@@ -134,21 +271,49 @@ async def run(args) -> None:
         shutdowns.append(shutdown)
         banner = f"serving {handle.name!r}"
 
-    svc = HttpService(models)
-    port = await svc.start(args.http_host, args.http_port)
-    print(f"dynamo_tpu frontend {banner} "
-          f"on http://{args.http_host}:{port}", flush=True)
-
+    svc = None
+    # Signal handling covers every ingress mode: SIGTERM mid-batch or
+    # mid-REPL must still run the shutdown path (engine drain, control
+    # plane close) rather than die in the default handler.
     stop_ev = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop_ev.set)
-    await stop_ev.wait()
-    await svc.stop()
-    for fn in shutdowns:
-        await fn()
-    if cp_server:
-        await cp_server.stop()
+    try:
+        if args.input == "text":
+            repl = asyncio.create_task(run_text_repl(models))
+            stop_wait = asyncio.create_task(stop_ev.wait())
+            await asyncio.wait({repl, stop_wait},
+                               return_when=asyncio.FIRST_COMPLETED)
+            repl.cancel()
+            stop_wait.cancel()
+        elif args.input == "batch":
+            if not args.batch_file:
+                raise SystemExit("--input batch requires --batch-file")
+            batch = asyncio.create_task(run_batch(
+                models, args.batch_file,
+                args.batch_output or args.batch_file + ".out"))
+            stop_wait = asyncio.create_task(stop_ev.wait())
+            await asyncio.wait({batch, stop_wait},
+                               return_when=asyncio.FIRST_COMPLETED)
+            stop_wait.cancel()
+            if batch.done():
+                batch.result()  # surface batch errors
+            else:
+                batch.cancel()
+        else:
+            svc = HttpService(models)
+            port = await svc.start(args.http_host, args.http_port)
+            print(f"dynamo_tpu frontend {banner} "
+                  f"on http://{args.http_host}:{port}", flush=True)
+            await stop_ev.wait()
+    finally:
+        if svc:
+            await svc.stop()
+        for fn in shutdowns:
+            await fn()
+        if cp_server:
+            await cp_server.stop()
 
 
 def main(argv=None) -> None:

@@ -32,6 +32,7 @@ logger = logging.getLogger(__name__)
 
 KV_EVENTS_SUBJECT = "kv_events"
 HIT_RATE_SUBJECT = "kv_hit_rate"
+ACTIVE_SEQS_SUBJECT = "active_seqs"  # reference kv_router.rs:63
 
 
 class KvRoutedEngineClient:
@@ -54,6 +55,14 @@ class KvRoutedEngineClient:
         # Worker-published ForwardPassMetrics, merged into selection cost
         # (r2 published these every second and routed on none of it).
         self._metrics = LoadMetricsWatcher(runtime.cp, name="kv-router")
+        # Replica sync: other frontends' routing decisions fold into our
+        # optimistic accounting under a namespaced request key (reference
+        # ACTIVE_SEQUENCES_SUBJECT replica sync, kv_router.rs:62-63).
+        import uuid as _uuid
+
+        self._router_id = _uuid.uuid4().hex[:12]
+        self._seq_sub = None
+        self._seq_task: Optional[asyncio.Task] = None
         # Penalty box: workers that just failed a connection are excluded
         # from routing until their lease expires or the TTL passes —
         # otherwise the highest-overlap (dead) worker would be re-chosen on
@@ -66,17 +75,85 @@ class KvRoutedEngineClient:
         self._sub = await self.runtime.cp.subscribe(KV_EVENTS_SUBJECT)
         self._event_task = asyncio.create_task(self._pump_events())
         await self._metrics.start()
+        if self.router.config.replica_sync:
+            self._seq_sub = await self.runtime.cp.subscribe(
+                ACTIVE_SEQS_SUBJECT)
+            self._seq_task = asyncio.create_task(self._pump_active_seqs())
 
     async def stop(self) -> None:
-        if self._sub:
-            self._sub.cancel()
-        if self._event_task:
-            self._event_task.cancel()
-            try:
-                await self._event_task
-            except asyncio.CancelledError:
-                pass
+        for sub in (self._sub, self._seq_sub):
+            if sub:
+                sub.cancel()
+        for task in (self._event_task, self._seq_task):
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
         await self._metrics.stop()
+
+    # -- replica sync ------------------------------------------------------
+
+    def _publish_seq(self, kind: str, request_id: str, **fields) -> None:
+        if not self.router.config.replica_sync:
+            return
+
+        async def pub():
+            try:
+                await self.runtime.cp.publish(ACTIVE_SEQS_SUBJECT, {
+                    "router": self._router_id, "kind": kind,
+                    "request_id": request_id, **fields})
+            except Exception:
+                pass  # sync is best-effort; local accounting still holds
+
+        try:
+            asyncio.get_running_loop().create_task(pub())
+        except RuntimeError:
+            pass
+
+    async def _pump_active_seqs(self) -> None:
+        import time
+
+        last_sweep = time.monotonic()
+        while True:
+            try:
+                msg = await asyncio.wait_for(self._seq_sub.next(),
+                                             timeout=30.0)
+            except asyncio.TimeoutError:
+                msg = None
+            except ConnectionError:
+                logger.error("active_seqs subscription lost")
+                return
+            # Periodic leak sweep: a remote router SIGKILLed between its
+            # "add" and "free" would otherwise reserve phantom load
+            # forever (ActiveSequences.expire_older_than exists for
+            # exactly this).  The TTL comfortably exceeds any real
+            # stream; local entries also freed by generate()'s finally.
+            now = time.monotonic()
+            if now - last_sweep > 60.0:
+                last_sweep = now
+                dropped = self.router.active.expire_older_than(900.0)
+                if dropped:
+                    logger.warning("expired %d leaked sequence "
+                                   "reservations", dropped)
+            if msg is None:
+                continue
+            if msg.get("router") == self._router_id:
+                continue  # own echo
+            try:
+                key = f"{msg['router']}:{msg['request_id']}"
+                kind = msg["kind"]
+                if kind == "add":
+                    self.router.active.add_request(
+                        key, msg["worker"], msg["isl"], msg["overlap"],
+                        expected_output_tokens=msg.get("expected", 0))
+                elif kind == "prefill":
+                    self.router.active.mark_prefill_complete(key)
+                elif kind == "free":
+                    self.router.active.free(key)
+            except Exception:
+                logger.exception("bad active_seqs payload")
 
     def _queue_hit_rate_event(self, ev) -> None:
         # Sync callback from the selector: publish fire-and-forget — a
@@ -138,6 +215,9 @@ class KvRoutedEngineClient:
             metrics=self._metrics.fresh())
         logger.debug("kv-routed %s → worker %s (overlap %d blocks)",
                      request.request_id, worker_id, overlap)
+        self._publish_seq("add", request.request_id, worker=worker_id,
+                          isl=len(request.token_ids), overlap=overlap,
+                          expected=request.sampling.max_tokens)
         first = True
         try:
             async for d in self.client.direct(self._to_wire(request),
@@ -147,6 +227,7 @@ class KvRoutedEngineClient:
                 if delta.token_ids:
                     if first:
                         self.router.mark_prefill_complete(request.request_id)
+                        self._publish_seq("prefill", request.request_id)
                         first = False
                     self.router.push_token(request.request_id,
                                            len(delta.token_ids))
@@ -158,3 +239,4 @@ class KvRoutedEngineClient:
             raise
         finally:
             self.router.free(request.request_id)
+            self._publish_seq("free", request.request_id)

@@ -215,3 +215,56 @@ class KvIndexer:
                     "dropping malformed router event from worker %s",
                     getattr(ev, "worker_id", repr(ev)),
                 )
+
+
+class KvIndexerSharded:
+    """Worker-sharded indexer (reference `KvIndexerSharded`,
+    `indexer.rs:856`): each worker's residency lives in its own KvIndexer
+    shard keyed by `worker_id % n_shards`, so event application for
+    different workers contends on different locks and a busy worker's
+    event storm can't serialize behind the whole fleet's.
+
+    Same surface as KvIndexer; `find_matches` merges per-shard overlap
+    scores (each worker's score lives wholly in its own shard, so the
+    merge is a plain dict union).
+    """
+
+    def __init__(self, block_size: int = 64, n_shards: int = 4) -> None:
+        if n_shards <= 0:
+            raise ValueError(f"n_shards must be positive, got {n_shards}")
+        self.block_size = block_size
+        self.shards = [KvIndexer(block_size) for _ in range(n_shards)]
+
+    def _shard(self, worker: WorkerId) -> KvIndexer:
+        return self.shards[hash(worker) % len(self.shards)]
+
+    def apply_event(self, ev: RouterEvent) -> None:
+        self._shard(ev.worker_id).apply_event(ev)
+
+    def remove_worker(self, worker: WorkerId) -> None:
+        self._shard(worker).remove_worker(worker)
+
+    def find_matches(self, sequence_hashes: Sequence[int]) -> OverlapScores:
+        merged = OverlapScores()
+        for shard in self.shards:
+            merged.scores.update(shard.find_matches(sequence_hashes).scores)
+        return merged
+
+    @property
+    def stale_events_dropped(self) -> int:
+        return sum(s.stale_events_dropped for s in self.shards)
+
+    @property
+    def tree(self):
+        """Compatibility view for worker enumeration (`workers()`)."""
+        class _Union:
+            def __init__(self, shards):
+                self._shards = shards
+
+            def workers(self):
+                out = []
+                for s in self._shards:
+                    out.extend(s.tree.workers())
+                return out
+
+        return _Union(self.shards)

@@ -44,6 +44,13 @@ class KvRouterConfig:
     # Exact indexer (engine emits KV events) vs TTL-based approximation.
     use_kv_events: bool = True
     approx_ttl_secs: float = 120.0
+    # > 1 → worker-sharded indexer (reference KvIndexerSharded,
+    # indexer.rs:856): per-worker event storms stop serializing the fleet.
+    indexer_shards: int = 1
+    # Publish/consume routing decisions across router replicas (reference
+    # ACTIVE_SEQUENCES_SUBJECT, kv_router.rs:62-63) — needed once more
+    # than one frontend routes the same workers.
+    replica_sync: bool = True
 
 
 class KvRouter:
@@ -53,9 +60,15 @@ class KvRouter:
         on_hit_rate_event: Optional[Callable[[KVHitRateEvent], None]] = None,
     ) -> None:
         self.config = config or KvRouterConfig()
-        self.indexer: Optional[KvIndexer] = (
-            KvIndexer(self.config.block_size) if self.config.use_kv_events else None
-        )
+        if not self.config.use_kv_events:
+            self.indexer = None
+        elif self.config.indexer_shards > 1:
+            from dynamo_tpu.llm.kv_router.indexer import KvIndexerSharded
+
+            self.indexer = KvIndexerSharded(
+                self.config.block_size, self.config.indexer_shards)
+        else:
+            self.indexer = KvIndexer(self.config.block_size)
         self.approx: Optional[ApproxKvIndexer] = (
             None
             if self.config.use_kv_events

@@ -1,0 +1,56 @@
+"""CLI entry: `python -m dynamo_tpu.router_service`.
+
+    python -m dynamo_tpu.router_service --control-plane HOST:PORT \
+        --model-name my-model
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from dynamo_tpu.router_service import RouterService
+from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneClient
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("dynamo_tpu.router_service")
+    p.add_argument("--control-plane", required=True, help="HOST:PORT")
+    p.add_argument("--model-name", required=True)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="router")
+    p.add_argument("--serve-as", default=None,
+                   help="public name of the routed model "
+                        "(default: <model-name>-routed)")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        host, port = args.control_plane.rsplit(":", 1)
+        cp = ControlPlaneClient(host, int(port))
+        await cp.start()
+        runtime = DistributedRuntime(cp)
+        svc = RouterService(runtime, args.model_name,
+                            namespace=args.namespace,
+                            component=args.component,
+                            serve_as=args.serve_as)
+        await svc.start()
+        print(f"router service for {args.model_name!r} at "
+              f"{svc.instance.address}", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await svc.stop()
+        await runtime.shutdown()
+        await cp.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

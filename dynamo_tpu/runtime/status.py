@@ -1,0 +1,65 @@
+"""Per-process system status server: /health, /live, /metrics.
+
+Role of the reference's `system_status_server.rs` (axum; routes at
+:155-176): every long-running process — worker, frontend, aggregator —
+exposes liveness, readiness, and Prometheus text on its own port.  The
+frontend embeds these in its OpenAI server; this module is the
+standalone variant for processes without an HTTP ingress (workers).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Awaitable, Callable, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+
+class StatusServer:
+    def __init__(self,
+                 registry: Optional[MetricsRegistry] = None,
+                 ready_fn: Optional[Callable[[], bool]] = None,
+                 extra_text_fn: Optional[Callable[[], str]] = None) -> None:
+        """`ready_fn`: readiness probe (default: always ready once
+        serving).  `extra_text_fn`: extra Prometheus text appended to the
+        registry exposition (e.g. the worker's ForwardPassMetrics)."""
+        self.registry = registry or MetricsRegistry()
+        self.ready_fn = ready_fn or (lambda: True)
+        self.extra_text_fn = extra_text_fn
+        self._runner: Optional[web.AppRunner] = None
+        self.port: Optional[int] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/live", self._live)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        logger.info("status server on %s:%d", host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _health(self, _req: web.Request) -> web.Response:
+        ok = bool(self.ready_fn())
+        return web.json_response({"status": "ready" if ok else "starting"},
+                                 status=200 if ok else 503)
+
+    async def _live(self, _req: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, _req: web.Request) -> web.Response:
+        text = self.registry.expose()
+        if self.extra_text_fn:
+            text += self.extra_text_fn()
+        return web.Response(text=text, content_type="text/plain")

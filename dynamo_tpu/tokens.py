@@ -73,10 +73,21 @@ def compute_block_hashes(
     Analog of the reference's `compute_block_hash_for_seq`
     (`lib/llm/src/kv_router/indexer.rs:123`).  The trailing partial block (if
     any) is not hashed — only full blocks are eligible for reuse/routing.
+
+    The chain runs in the native C++ module when available (csrc/
+    block_hash.cpp — byte-identical layout; tests/test_native.py holds
+    the parity) and falls back to the per-block Python loop here.
     """
     if block_size <= 0:
         raise ValueError(f"block_size must be positive, got {block_size}")
     arr = _as_u32(tokens)
+
+    from dynamo_tpu import native
+
+    fast = native.chained_block_hashes(arr, block_size, parent_hash)
+    if fast is not None:
+        return [int(h) for h in fast]
+
     n_full = len(arr) // block_size
     hashes: List[int] = []
     h = parent_hash

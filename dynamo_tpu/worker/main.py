@@ -61,6 +61,9 @@ def parse_args(argv=None):
     p.add_argument("--block-size", type=int, default=64)
     p.add_argument("--speedup-ratio", type=float, default=10.0)
     p.add_argument("--metrics-interval", type=float, default=1.0)
+    p.add_argument("--health-port", type=int, default=0,
+                   help="per-worker status server port (0 = ephemeral; "
+                        "-1 disables; reference system_status_server.rs)")
     apply_to_parser_defaults(p, load_layered_config(
         {"control_plane": None, "namespace": "dynamo",
          "component": "backend", "endpoint": "generate",
@@ -114,6 +117,9 @@ async def build_engine(args, kv_event_sink):
 
 
 async def run(args) -> None:
+    from dynamo_tpu import native
+
+    await native.warmup()  # build the C++ hasher off the event loop
     cp = ControlPlaneClient(*_split(args.control_plane))
     await cp.start()
     runtime = DistributedRuntime(cp)
@@ -181,6 +187,28 @@ async def run(args) -> None:
                                    kv_block_size=args.block_size,
                                    **card_fields)
         await register_llm(endpoint, instance, card)
+    status = None
+    if args.health_port >= 0:
+        from dynamo_tpu.runtime.status import StatusServer
+
+        def worker_metrics_text() -> str:
+            m = metrics_fn()
+            ws, ks = m.worker_stats, m.kv_stats
+            lines = [
+                f"dynamo_worker_request_active_slots {ws.request_active_slots}",
+                f"dynamo_worker_requests_waiting {ws.num_requests_waiting}",
+                f"dynamo_worker_kv_active_blocks {ks.kv_active_blocks}",
+                f"dynamo_worker_kv_usage {ks.gpu_cache_usage_perc}",
+            ]
+            if m.expert_load:
+                for e, n in enumerate(m.expert_load):
+                    lines.append(
+                        f'dynamo_worker_expert_load{{expert="{e}"}} {n}')
+            return "\n".join(lines) + "\n"
+
+        status = StatusServer(extra_text_fn=worker_metrics_text)
+        hport = await status.start(port=args.health_port)
+        print(f"worker status server on :{hport}", flush=True)
     print(f"worker instance {instance.instance_id} role={args.role} "
           f"serving {args.model_name!r} at {instance.address}", flush=True)
 
@@ -218,6 +246,8 @@ async def run(args) -> None:
         prefill_task.cancel()
     if disagg_client is not None:
         await disagg_client.stop()
+    if status is not None:
+        await status.stop()
     await shutdown()
     await runtime.shutdown()
     await cp.close()

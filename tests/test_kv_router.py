@@ -281,3 +281,70 @@ def test_router_threads_metrics_to_selector():
                              update_states=False,
                              metrics={"w_busy": saturated})
     assert w == "w_idle"
+
+
+def test_sharded_indexer_matches_flat():
+    from dynamo_tpu.llm.kv_router.indexer import KvIndexer, KvIndexerSharded
+    from dynamo_tpu.llm.kv_router.protocols import RouterEvent
+
+    def stored(worker, eid, hashes, parent=None):
+        return RouterEvent(worker_id=worker, event=KvCacheEvent(
+            event_id=eid,
+            data=KvCacheEventData.stored(hashes, parent_hash=parent)))
+
+    flat, sharded = KvIndexer(8), KvIndexerSharded(8, n_shards=4)
+    events = [stored(w, i + 1, [100 * w + i, 100 * w + i + 1])
+              for w in range(1, 6) for i in range(3)]
+    for ev in events:
+        flat.apply_event(ev)
+        sharded.apply_event(ev)
+    for q in ([101], [100, 101], [301, 302], [999]):
+        assert sharded.find_matches(q).scores == flat.find_matches(q).scores
+    assert sorted(sharded.tree.workers()) == sorted(flat.tree.workers())
+    sharded.remove_worker(3)
+    flat.remove_worker(3)
+    assert sharded.find_matches([301, 302]).scores == \
+        flat.find_matches([301, 302]).scores
+
+
+def test_router_replica_sync_applies_remote_decisions():
+    """A second frontend's published decision raises this router's view of
+    that worker's load (reference ACTIVE_SEQUENCES_SUBJECT sync)."""
+    import asyncio
+
+    from dynamo_tpu.llm.kv_router.client import (
+        ACTIVE_SEQS_SUBJECT, KvRoutedEngineClient)
+    from dynamo_tpu.runtime.control_plane import InProcessControlPlane
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        runtime = DistributedRuntime(cp)
+        client = KvRoutedEngineClient(None, runtime, block_size=8)
+        await client.start()
+        try:
+            # Remote replica routes a big request to worker 1.
+            await cp.publish(ACTIVE_SEQS_SUBJECT, {
+                "router": "other", "kind": "add", "request_id": "r9",
+                "worker": 1, "isl": 64, "overlap": 0, "expected": 64})
+            await asyncio.sleep(0.05)
+            w, _ = client.router.find_best_match(
+                "mine", list(range(16)), [1, 2], update_states=False)
+            assert w == 2  # worker 1 is loaded by the REMOTE decision
+            # Remote free restores balance.
+            await cp.publish(ACTIVE_SEQS_SUBJECT, {
+                "router": "other", "kind": "free", "request_id": "r9"})
+            await asyncio.sleep(0.05)
+            assert client.router.active.decode_blocks().get(1, 0) == 0
+            # Own echoes are ignored (no double counting).
+            await cp.publish(ACTIVE_SEQS_SUBJECT, {
+                "router": client._router_id, "kind": "add",
+                "request_id": "x", "worker": 2, "isl": 64, "overlap": 0})
+            await asyncio.sleep(0.05)
+            assert client.router.active.prefill_tokens().get(2, 0) == 0
+        finally:
+            await client.stop()
+            await cp.close()
+
+    asyncio.run(main())
