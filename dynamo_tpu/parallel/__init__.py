@@ -15,6 +15,7 @@ matmuls, all-to-all for experts, ppermute rings for sequence shards).
 """
 
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.parallel.pipeline import make_pp_step
 from dynamo_tpu.parallel.sharding import (
     cache_pspecs,
     data_pspecs,
@@ -33,4 +34,5 @@ __all__ = [
     "shard_pytree",
     "make_sharded_step",
     "make_sp_prefill_step",
+    "make_pp_step",
 ]

@@ -1,9 +1,10 @@
 """Device mesh construction.
 
-Axis order is (dp, sp, ep, tp) with tp innermost: on real slices JAX device
-order makes the innermost axis span physically-adjacent chips, so the
-highest-traffic collectives (tensor-parallel psum every layer) ride the
-shortest ICI hops, while dp (lowest traffic) spans the slice/DCN dimension.
+Axis order is (dp, pp, sp, ep, tp) with tp innermost: on real slices JAX
+device order makes the innermost axis span physically-adjacent chips, so
+the highest-traffic collectives (tensor-parallel psum every layer) ride
+the shortest ICI hops; pp's point-to-point activation hops and dp (lowest
+traffic) span the slice/DCN dimension.
 """
 
 from __future__ import annotations
@@ -15,7 +16,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "ep", "tp")
+AXES = ("dp", "pp", "sp", "ep", "tp")
 
 
 @dataclass(frozen=True)
@@ -23,17 +24,18 @@ class MeshConfig:
     """Degrees per axis; product must equal the device count in use."""
 
     dp: int = 1
+    pp: int = 1
     sp: int = 1
     ep: int = 1
     tp: int = 1
 
     @property
     def shape(self) -> tuple:
-        return (self.dp, self.sp, self.ep, self.tp)
+        return (self.dp, self.pp, self.sp, self.ep, self.tp)
 
     @property
     def size(self) -> int:
-        return self.dp * self.sp * self.ep * self.tp
+        return self.dp * self.pp * self.sp * self.ep * self.tp
 
     def describe(self) -> str:
         return "x".join(f"{a}{n}" for a, n in zip(AXES, self.shape) if n > 1) or "single"

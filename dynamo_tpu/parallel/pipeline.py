@@ -1,0 +1,229 @@
+"""Pipeline parallelism: stage-partitioned forward with microbatch rotation.
+
+SURVEY §2.5 row "PP" (the reference configures PP in its delegated
+engines for multinode runs, `trtllm/multinode/multinode-examples.md`;
+here the engine is ours).  TPU-idiomatic design — a GPipe-style schedule
+expressed entirely inside one `shard_map` over the `pp` mesh axis:
+
+- layer stacks shard over pp: stage s owns layers [s·L/S, (s+1)·L/S) as
+  STACKED arrays, applied with `lax.scan` (one compiled layer body per
+  stage, not L/S unrolled copies);
+- the KV cache for the pp path is the stacked [L, slots, Hkv, D] layout
+  sharded over pp on the layer axis — each stage holds exactly its
+  layers' cache;
+- activations + per-microbatch metadata rotate stage→stage+1 via
+  `lax.ppermute` each tick; stage 0 injects fresh microbatch embeddings,
+  the last stage runs the LM head and banks logits.  S + M − 1 ticks
+  drain M microbatches through S stages; every stage executes identical
+  code every tick (junk lanes masked at the end) so the schedule is
+  branch-free and XLA-friendly.
+
+v1 restrictions (validated): dense models (no MoE), pp exclusive of
+tp/sp in this step (dp rides outside via engine replicas).  The unified
+step contract matches `make_forward_step`, so tests compare logits AND
+cache against the single-device oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.engine import kv_cache as kvc
+from dynamo_tpu.models.config import ModelConfig
+
+
+def stack_layer_params(params: Dict) -> Dict:
+    """Convert the per-layer list-of-dicts into stacked arrays [L, ...]
+    (scan-ready; the pp in_spec shards axis 0)."""
+    layers = params["layers"]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    out = dict(params)
+    out["layers"] = stacked
+    return out
+
+
+def init_pp_cache(cfg: kvc.KvCacheConfig) -> Dict:
+    """Stacked cache for the pp step: {'k': [L, slots, Hkv, D], 'v': ...}."""
+    shape = (cfg.num_layers, cfg.num_slots, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def pp_param_pspecs(cfg: ModelConfig) -> Dict:
+    """Stacked-params pspecs: layer leaves shard axis 0 over pp; embed /
+    norms / head replicated."""
+    layer_leaf = P("pp")
+    layers = {
+        "attn": {"wq": layer_leaf, "wk": layer_leaf, "wv": layer_leaf,
+                 "wo": layer_leaf},
+        "attn_norm": layer_leaf,
+        "mlp_norm": layer_leaf,
+        "mlp": {"w_gate": layer_leaf, "w_up": layer_leaf,
+                "w_down": layer_leaf},
+    }
+    specs = {"embed": P(None, None), "final_norm": P(None),
+             "layers": layers}
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, None)
+    return specs
+
+
+def pp_cache_pspecs() -> Dict:
+    spec = P("pp", None, None, None)
+    return {"k": spec, "v": spec}
+
+
+def make_pp_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
+                 n_microbatches: int):
+    """Jit the pipeline-parallel unified step.
+
+    Returns `step(params_stacked, cache, tokens, positions, seq_lens,
+    block_tables, sample_positions) -> (logits, cache)` — the regular
+    step contract; tokens [B, T] with B divisible by n_microbatches.
+    Build inputs with `stack_layer_params` / `init_pp_cache`.
+    """
+    from dynamo_tpu.models.llama import _attention_block, _dense_mlp, rms_norm
+
+    cfg.validate()
+    if cfg.is_moe:
+        raise ValueError("pp v1 supports dense models only")
+    S = mesh.shape["pp"]
+    if cfg.num_layers % S != 0:
+        raise ValueError(f"pp={S} must divide num_layers={cfg.num_layers}")
+    for axis in ("dp", "sp", "ep", "tp"):
+        if mesh.shape[axis] != 1:
+            # The shard_map specs mention only pp: any other populated
+            # axis would silently replicate the whole stage compute —
+            # wasted chips, which make_mesh treats as a provisioning bug.
+            raise ValueError(
+                f"pp v1 composes with no other axis in-mesh (got "
+                f"{axis}={mesh.shape[axis]}); run dp via engine replicas")
+    M = n_microbatches
+
+    def body(params, cache, tokens, positions, seq_lens, block_tables,
+             sample_positions):
+        B, T = tokens.shape
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mb = B // M
+        Pw = block_tables.shape[1]
+        C = Pw * block_size
+        stage = jax.lax.axis_index("pp")
+        last_stage = S - 1
+        layers = params["layers"]  # stacked, local shard [L/S, ...]
+        k_cache, v_cache = cache["k"], cache["v"]  # [L/S, slots, H, D]
+
+        def stage_compute(x, meta, k_cache, v_cache, valid):
+            """Run this stage's layers on one microbatch activation.
+            `valid` (traced bool): whether this (stage, tick) holds a real
+            microbatch — bubble ticks compute uniformly but their cache
+            writes are redirected to the null block (slot 0), because the
+            rotated-in metadata can point at REAL pages of a previous
+            microbatch (the M=2 drain tick corrupted mb1's cache before
+            this mask existed)."""
+            positions_mb, seq_lens_mb, bt_mb = meta
+            write_slots = kvc.slots_for_positions(
+                bt_mb, positions_mb, block_size).reshape(mb * T)
+            write_slots = jnp.where(valid, write_slots, 0)
+            ctx_positions = jnp.broadcast_to(
+                jnp.arange(C, dtype=jnp.int32), (mb, C))
+            ctx_slots = kvc.slots_for_positions(bt_mb, ctx_positions,
+                                                block_size)
+
+            def layer_fn(x, scanned):
+                layer, k_l, v_l = scanned
+                attn_out, k_l, v_l = _attention_block(
+                    cfg, layer["attn"],
+                    rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps),
+                    positions_mb, seq_lens_mb, write_slots, ctx_slots,
+                    ctx_positions, bt_mb, block_size, k_l, v_l)
+                x = x + attn_out
+                h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)
+                x = x + _dense_mlp(layer["mlp"], h)
+                return x, (k_l, v_l)
+
+            x, (k_new, v_new) = jax.lax.scan(
+                layer_fn, x, (layers, k_cache, v_cache))
+            return x, k_new, v_new
+
+        def microbatch(i, arr):
+            return jax.lax.dynamic_slice_in_dim(arr, i * mb, mb, axis=0)
+
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        H = cfg.hidden_size
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T
+
+        # One compiled tick body inside fori_loop — the schedule's length
+        # (S + M − 1 ticks) must not scale program size/compile time.
+        # All per-tick variation (inject? bank?) is traced masking.
+        def tick(t, carry):
+            x, meta, sample_mb, out, k_cache, v_cache = carry
+
+            # Stage 0 swaps in microbatch t's fresh embedding while any
+            # remain; every stage computes the candidate uniformly and
+            # `where`-selects — branch-free across stages and ticks.
+            t_inj = jnp.minimum(t, M - 1)
+            fresh_x = jnp.take(params["embed"], microbatch(t_inj, tokens),
+                               axis=0)
+            fresh_meta = (microbatch(t_inj, positions),
+                          microbatch(t_inj, seq_lens),
+                          microbatch(t_inj, block_tables))
+            fresh_sample = microbatch(t_inj, sample_positions)
+            inject = jnp.logical_and(stage == 0, t < M)
+            x = jnp.where(inject, fresh_x, x)
+            meta = tuple(jnp.where(inject, f, m)
+                         for f, m in zip(fresh_meta, meta))
+            sample_mb = jnp.where(inject, fresh_sample, sample_mb)
+
+            valid = jnp.logical_and(t - stage >= 0, t - stage < M)
+            x, k_cache, v_cache = stage_compute(x, meta, k_cache, v_cache,
+                                                valid)
+
+            # Last stage banks its finished microbatch's logits.
+            idx = t - (S - 1)
+            bank = jnp.logical_and(stage == last_stage, idx >= 0)
+            idx_c = jnp.clip(idx, 0, M - 1)
+            hfin = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+            hsel = jnp.take_along_axis(
+                hfin, sample_mb[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            logits_mb = (hsel @ head).astype(jnp.float32)
+            out = out.at[idx_c].set(
+                jnp.where(bank, logits_mb, out[idx_c]))
+
+            x = jax.lax.ppermute(x, "pp", perm)
+            meta = tuple(jax.lax.ppermute(m, "pp", perm) for m in meta)
+            sample_mb = jax.lax.ppermute(sample_mb, "pp", perm)
+            return x, meta, sample_mb, out, k_cache, v_cache
+
+        carry = (
+            jnp.zeros((mb, T, H), params["embed"].dtype),
+            (jnp.zeros((mb, T), jnp.int32), jnp.zeros((mb,), jnp.int32),
+             jnp.zeros((mb, Pw), jnp.int32)),
+            jnp.zeros((mb,), jnp.int32),
+            jnp.zeros((M, mb, cfg.vocab_size), jnp.float32),
+            k_cache, v_cache,
+        )
+        _, _, _, out, k_cache, v_cache = jax.lax.fori_loop(
+            0, S + M - 1, tick, carry)
+
+        # Only the last stage wrote non-zero logits: psum replicates them.
+        logits = jax.lax.psum(out, "pp").reshape(M * mb, cfg.vocab_size)
+        return logits, {"k": k_cache, "v": v_cache}
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pp_param_pspecs(cfg), pp_cache_pspecs(),
+                  P(None, None), P(None, None), P(None), P(None, None),
+                  P(None)),
+        out_specs=(P(None, None), pp_cache_pspecs()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(1,))

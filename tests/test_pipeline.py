@@ -1,0 +1,116 @@
+"""Pipeline parallelism vs the single-device oracle (SURVEY §2.5 "PP")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import kv_cache as kvc
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.models.llama import init_params, make_forward_step
+from dynamo_tpu.parallel import MeshConfig, make_mesh
+from dynamo_tpu.parallel.pipeline import (
+    init_pp_cache,
+    make_pp_step,
+    pp_cache_pspecs,
+    pp_param_pspecs,
+    stack_layer_params,
+)
+from dynamo_tpu.parallel.sharding import shard_pytree
+
+CFG = mcfg.get_config("tiny-test")
+BLOCK = 8
+
+
+def _inputs(batch, T, key=5):
+    tokens = jax.random.randint(jax.random.key(key), (batch, T), 0,
+                                CFG.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (batch, T))
+    bt = np.zeros((batch, 8), np.int32)
+    for i in range(batch):
+        bt[i, :4] = np.arange(1 + 4 * i, 5 + 4 * i)
+    return (tokens, positions, jnp.full((batch,), T, jnp.int32),
+            jnp.asarray(bt), jnp.full((batch,), T - 1, jnp.int32))
+
+
+def _pp_setup(mesh, params):
+    stacked = shard_pytree(stack_layer_params(params),
+                           pp_param_pspecs(CFG), mesh)
+    cache = shard_pytree(
+        init_pp_cache(kvc.KvCacheConfig.for_model(
+            CFG, num_blocks=64, block_size=BLOCK, dtype=jnp.float32)),
+        pp_cache_pspecs(), mesh)
+    return stacked, cache
+
+
+@pytest.mark.parametrize("n_mb", [1, 2, 4])
+def test_pp_step_matches_unsharded(n_mb):
+    params = init_params(CFG, jax.random.key(0))
+    batch, T = 4, 16
+    inputs = _inputs(batch, T)
+
+    ref_step = make_forward_step(CFG, BLOCK)
+    ref_cache = kvc.init_cache(kvc.KvCacheConfig.for_model(
+        CFG, num_blocks=64, block_size=BLOCK, dtype=jnp.float32))
+    want, want_cache = ref_step(params, ref_cache, *inputs)
+
+    mesh = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    stacked, cache = _pp_setup(mesh, params)
+    step = make_pp_step(CFG, BLOCK, mesh, n_microbatches=n_mb)
+    got, got_cache = step(stacked, cache, *inputs)
+
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=5e-5, atol=5e-5)
+    # Stage-owned KV must equal the oracle's per-layer cache.  Block 0
+    # (the null block, slots [0, BLOCK)) is excluded: both paths dump
+    # masked/padding writes there and its contents are junk BY DESIGN
+    # (kv_cache.py docstring) — only real pages carry semantics.
+    for li in range(CFG.num_layers):
+        for side in ("k", "v"):
+            np.testing.assert_allclose(
+                np.asarray(want_cache[side][li])[BLOCK:],
+                np.asarray(got_cache[side][li])[BLOCK:],
+                rtol=5e-5, atol=5e-5)
+
+
+def test_pp_prefill_then_decode():
+    """Prefill through the pipeline, then decode one token through it —
+    matching a full unsharded run (cache handoff across calls)."""
+    params = init_params(CFG, jax.random.key(0))
+    batch, T = 2, 12
+    tokens, positions, seq_lens, bt, sample = _inputs(batch, T, key=7)
+
+    ref_step = make_forward_step(CFG, BLOCK)
+    ref_cache = kvc.init_cache(kvc.KvCacheConfig.for_model(
+        CFG, num_blocks=64, block_size=BLOCK, dtype=jnp.float32))
+    logits, ref_cache = ref_step(params, ref_cache, tokens, positions,
+                                 seq_lens, bt, sample)
+    nxt = jnp.argmax(logits, -1).astype(jnp.int32)[:, None]
+    want, _ = ref_step(params, ref_cache, nxt,
+                       jnp.full((batch, 1), T, jnp.int32),
+                       jnp.full((batch,), T + 1, jnp.int32), bt,
+                       jnp.zeros((batch,), jnp.int32))
+
+    mesh = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    stacked, cache = _pp_setup(mesh, params)
+    step = make_pp_step(CFG, BLOCK, mesh, n_microbatches=2)
+    logits2, cache = step(stacked, cache, tokens, positions, seq_lens, bt,
+                          sample)
+    nxt2 = jnp.argmax(logits2, -1).astype(jnp.int32)[:, None]
+    np.testing.assert_array_equal(np.asarray(nxt), np.asarray(nxt2))
+    got, _ = step(stacked, cache, nxt2,
+                  jnp.full((batch, 1), T, jnp.int32),
+                  jnp.full((batch,), T + 1, jnp.int32), bt,
+                  jnp.zeros((batch,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=5e-5, atol=5e-5)
+
+
+def test_pp_validations():
+    mesh = make_mesh(MeshConfig(pp=8), jax.devices())
+    with pytest.raises(ValueError, match="divide num_layers"):
+        make_pp_step(CFG, BLOCK, mesh, 2)  # 8 stages > 2 layers
+    moe = mcfg.get_config("tiny-moe")
+    mesh2 = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    with pytest.raises(ValueError, match="dense models"):
+        make_pp_step(moe, BLOCK, mesh2, 2)
