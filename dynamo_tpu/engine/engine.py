@@ -92,6 +92,9 @@ class EngineConfig:
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     cache_dtype: Optional[jnp.dtype] = None
     mesh: Optional[object] = None          # jax.sharding.Mesh for tp/ep
+    # Batch-sharded attention with slot-sharded KV (tp beyond the kv-head
+    # count; reference sglang --enable-dp-attention).
+    dp_attention: bool = False
     seed: int = 0
     enable_kv_events: bool = True
     # Prefix cache / tiered KVBM (G1 device always; G2 host / G3 disk when
@@ -141,14 +144,20 @@ class EngineCore:
             from dynamo_tpu.parallel.sharding import resolve_moe_mode
 
             moe_mode = resolve_moe_mode(cfg, self.mesh)
-            params = shard_pytree(params, param_pspecs(cfg, moe_mode),
-                                  self.mesh)
+            params = shard_pytree(
+                params,
+                param_pspecs(cfg, moe_mode,
+                             dp_attention=config.dp_attention),
+                self.mesh)
             self._step = make_sharded_step(
                 cfg, self.block_size, self.mesh, moe_mode,
-                with_expert_load=self._moe)
+                with_expert_load=self._moe,
+                dp_attention=config.dp_attention)
             cache = shard_pytree(
                 kvc.init_cache(self.cache_cfg),
-                cache_pspecs(cfg.num_layers), self.mesh)
+                cache_pspecs(cfg.num_layers,
+                             dp_attention=config.dp_attention),
+                self.mesh)
         else:
             pallas = config.use_pallas_decode
             if pallas is None:

@@ -26,9 +26,19 @@ from dynamo_tpu.llm.protocols.openai import (
 from dynamo_tpu.llm.tokenizer import Tokenizer
 
 DEFAULT_CHAT_TEMPLATE = (
+    "{% if tools %}"
+    "<|start_header_id|>system<|end_header_id|>\n\n"
+    "You may call these tools; respond with a JSON object "
+    '{"name": ..., "arguments": ...} to invoke one:\n'
+    "{{ tools | tojson }}<|eot_id|>"
+    "{% endif %}"
     "{% for message in messages %}"
     "<|start_header_id|>{{ message.role }}<|end_header_id|>\n\n"
-    "{{ message.content }}<|eot_id|>"
+    "{{ message.content }}"
+    "{% if message.tool_calls %}"
+    "{{ message.tool_calls | tojson }}"
+    "{% endif %}"
+    "<|eot_id|>"
     "{% endfor %}"
     "{% if add_generation_prompt %}"
     "<|start_header_id|>assistant<|end_header_id|>\n\n"
@@ -66,11 +76,30 @@ class OpenAIPreprocessor:
     # -- chat -------------------------------------------------------------
 
     def render_chat(self, request: ChatCompletionRequest) -> str:
-        messages = [
-            {"role": m.role, "content": m.text()} for m in request.messages
-        ]
+        messages = []
+        for m in request.messages:
+            msg = {"role": m.role, "content": m.text()}
+            if m.tool_calls:
+                msg["tool_calls"] = m.tool_calls
+            messages.append(msg)
+        # Declared tools flow into the template context (the `tools`
+        # variable HF chat templates consume) — without this the model
+        # never sees the tool schemas and can't emit calls.
+        # tool_choice (OpenAI semantics): "none" hides the schemas for
+        # this turn; {"type":"function","function":{"name": N}} narrows
+        # them to the forced tool.
+        tools = request.tools or None
+        choice = request.tool_choice
+        if choice == "none":
+            tools = None
+        elif isinstance(choice, dict) and tools:
+            forced = choice.get("function", {}).get("name")
+            if forced:
+                tools = [t for t in tools
+                         if t.get("function", {}).get("name") == forced] \
+                    or tools
         return self._template.render(
-            messages=messages, add_generation_prompt=True)
+            messages=messages, add_generation_prompt=True, tools=tools)
 
     def preprocess_chat(
         self, request: ChatCompletionRequest, request_id: str

@@ -30,19 +30,34 @@ from dynamo_tpu.models.config import ModelConfig
 Params = Dict
 
 
-def param_pspecs(cfg: ModelConfig, moe_mode: str = "dense") -> Params:
+def param_pspecs(cfg: ModelConfig, moe_mode: str = "dense",
+                 dp_attention: bool = False) -> Params:
     """PartitionSpec pytree matching `llama.init_params` structure.
 
     MoE weights: dense mode shards each expert's MLP over tp too (the
     dense einsums partition fine under GSPMD); dispatch mode keeps expert
     shards tp-unsharded (the shard_map body owns them whole) and
-    replicates the router (every shard routes its own tokens)."""
-    attn = {
-        "wq": P(None, "tp"),
-        "wk": P(None, "tp"),
-        "wv": P(None, "tp"),
-        "wo": P("tp", None),
-    }
+    replicates the router (every shard routes its own tokens).
+
+    `dp_attention` (reference: sglang --enable-dp-attention,
+    `disagg_dp_attn.sh:33-37`): attention runs data-parallel over the
+    batch with REPLICATED attention weights while MLPs stay
+    tensor-parallel — the mode for models whose kv-head count is below
+    the tp degree (head-sharded KV would cap tp or duplicate KV)."""
+    if dp_attention:
+        attn = {
+            "wq": P(None, None),
+            "wk": P(None, None),
+            "wv": P(None, None),
+            "wo": P(None, None),
+        }
+    else:
+        attn = {
+            "wq": P(None, "tp"),
+            "wk": P(None, "tp"),
+            "wv": P(None, "tp"),
+            "wo": P("tp", None),
+        }
     layer = {
         "attn": attn,
         "attn_norm": P(None),
@@ -79,14 +94,19 @@ def param_pspecs(cfg: ModelConfig, moe_mode: str = "dense") -> Params:
     return specs
 
 
-def cache_pspecs(num_layers: int) -> Dict:
+def cache_pspecs(num_layers: int, dp_attention: bool = False) -> Dict:
     """KV cache: per-layer [slots, kv_heads, head_dim] buffers, heads over tp.
 
     The slot axis is deliberately *not* dp-sharded: each dp replica runs its
     own engine process with its own cache (serving-style DP, reference
     PushRouter replicas), so within one process the cache only shards over
-    tp."""
-    spec = P(None, "tp", None)
+    tp.
+
+    `dp_attention`: the SLOT axis shards over tp instead of heads — total
+    KV memory still splits tp-ways, but head count no longer caps tp.
+    (Page→device locality is GSPMD's to resolve; a locality-aware
+    allocator is the planned refinement.)"""
+    spec = P("tp", None, None) if dp_attention else P(None, "tp", None)
     return {"k": [spec] * num_layers, "v": [spec] * num_layers}
 
 
@@ -100,13 +120,15 @@ def data_pspecs() -> Dict:
     }
 
 
-def validate(cfg: ModelConfig, mesh: Mesh) -> None:
+def validate(cfg: ModelConfig, mesh: Mesh,
+             dp_attention: bool = False) -> None:
     tp = mesh.shape["tp"]
     ep = mesh.shape["ep"]
-    if cfg.num_kv_heads % tp:
+    if not dp_attention and cfg.num_kv_heads % tp:
         raise ValueError(
             f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
-            "(head-sharded KV cache; replication not supported)"
+            "(head-sharded KV cache; use dp_attention for tp beyond the "
+            "kv-head count)"
         )
     if cfg.intermediate_size % tp:
         raise ValueError(f"tp={tp} must divide intermediate={cfg.intermediate_size}")
@@ -185,36 +207,55 @@ def resolve_moe_mode(cfg: ModelConfig, mesh: Mesh,
 
 def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
                       moe_mode: str = "auto",
-                      with_expert_load: bool = False):
+                      with_expert_load: bool = False,
+                      dp_attention: bool = False):
     """Jit the unified engine step with explicit in/out shardings.
 
     Returns `step(params, cache, tokens, positions, seq_lens, block_tables)`
     → (logits, cache[, expert_load]).  Cache is donated (in-place paged-
     cache update); logits come back replicated so the sampler/host sees
     full vocab.
+
+    `dp_attention`: batch shards over (dp, tp) and the KV cache's slot
+    axis over tp — see param_pspecs/cache_pspecs.  Batch must divide
+    dp×tp.
     """
     from dynamo_tpu.models.llama import make_forward_step
 
-    validate(cfg, mesh)
+    validate(cfg, mesh, dp_attention)
     moe_mode = resolve_moe_mode(cfg, mesh, moe_mode)
-    step = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
-                             with_expert_load=with_expert_load)
+    inner = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
+                              with_expert_load=with_expert_load)
+    if dp_attention:
+        div = mesh.shape["dp"] * mesh.shape["tp"]
+
+        def step(params, cache, tokens, *rest):
+            # Shape check at trace time (batch is static under jit):
+            # surfaces a clear error instead of opaque GSPMD padding.
+            if tokens.shape[0] % div:
+                raise ValueError(
+                    f"dp_attention: batch {tokens.shape[0]} must divide "
+                    f"dp*tp = {div}")
+            return inner(params, cache, tokens, *rest)
+    else:
+        step = inner
     d = data_pspecs()
+    batch_axes = ("dp", "tp") if dp_attention else "dp"
     in_shardings = (
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     param_pspecs(cfg, moe_mode)),
+                     param_pspecs(cfg, moe_mode, dp_attention)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers)),
-        NamedSharding(mesh, d["tokens"]),
-        NamedSharding(mesh, d["positions"]),
-        NamedSharding(mesh, d["seq_lens"]),
-        NamedSharding(mesh, d["block_tables"]),
-        NamedSharding(mesh, P("dp")),              # sample_positions [B]
+                     cache_pspecs(cfg.num_layers, dp_attention)),
+        NamedSharding(mesh, P(batch_axes, None)),  # tokens
+        NamedSharding(mesh, P(batch_axes, None)),  # positions
+        NamedSharding(mesh, P(batch_axes)),        # seq_lens
+        NamedSharding(mesh, P(batch_axes, None)),  # block_tables
+        NamedSharding(mesh, P(batch_axes)),        # sample_positions [B]
     )
     out_shardings = [
-        NamedSharding(mesh, P("dp", None)),        # logits [B, V]
+        NamedSharding(mesh, P(batch_axes, None)),  # logits [B, V]
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers)),
+                     cache_pspecs(cfg.num_layers, dp_attention)),
     ]
     if with_expert_load:
         out_shardings.append(NamedSharding(mesh, P(None)))

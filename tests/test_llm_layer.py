@@ -174,3 +174,62 @@ def test_generate_rejected_request_terminates():
     assert deltas[-1].finished
     assert deltas[-1].finish_reason == FinishReason.LENGTH
     assert deltas[-1].token_ids == []
+
+
+def test_chat_template_receives_tools():
+    """Declared tools must reach the rendered prompt — a model that never
+    sees the schemas can't call them."""
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import (
+        ChatCompletionRequest, ChatMessage)
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    pre = OpenAIPreprocessor(ByteTokenizer())
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[ChatMessage(role="user", content="weather in Oslo?")],
+        tools=[{"type": "function",
+                "function": {"name": "get_weather",
+                             "parameters": {"type": "object"}}}])
+    text = pre.render_chat(req)
+    assert "get_weather" in text
+    # Without tools the system block is absent.
+    req2 = ChatCompletionRequest(
+        model="m", messages=[ChatMessage(role="user", content="hi")])
+    assert "call these tools" not in pre.render_chat(req2)
+
+
+def test_tool_choice_and_history_rendering():
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import (
+        ChatCompletionRequest, ChatMessage)
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+
+    pre = OpenAIPreprocessor(ByteTokenizer())
+    tools = [{"type": "function", "function": {"name": "get_weather"}},
+             {"type": "function", "function": {"name": "get_time"}}]
+    # tool_choice="none" hides the schemas for this turn.
+    req = ChatCompletionRequest(
+        model="m", messages=[ChatMessage(role="user", content="hi")],
+        tools=tools, tool_choice="none")
+    assert "get_weather" not in pre.render_chat(req)
+    # Forcing one tool narrows the schema list.
+    req = ChatCompletionRequest(
+        model="m", messages=[ChatMessage(role="user", content="hi")],
+        tools=tools,
+        tool_choice={"type": "function", "function": {"name": "get_time"}})
+    text = pre.render_chat(req)
+    assert "get_time" in text and "get_weather" not in text
+    # Assistant tool-call turns render their calls (multi-turn history).
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[
+            ChatMessage(role="user", content="weather?"),
+            ChatMessage(role="assistant", content=None, tool_calls=[
+                {"id": "call_1", "type": "function",
+                 "function": {"name": "get_weather",
+                              "arguments": "{\"city\": \"Oslo\"}"}}]),
+            ChatMessage(role="tool", content="12C"),
+        ], tools=tools)
+    text = pre.render_chat(req)
+    assert "call_1" in text and "12C" in text

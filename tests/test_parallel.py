@@ -116,3 +116,34 @@ def test_decode_after_sharded_prefill():
                   jnp.zeros((batch,), jnp.int32))
     np.testing.assert_allclose(want, np.asarray(got),
                                rtol=5e-4, atol=5e-4)
+
+
+def test_dp_attention_allows_tp_beyond_kv_heads():
+    """DP-attention (reference sglang --enable-dp-attention): tp=8 on a
+    4-kv-head model — impossible head-sharded — matches the unsharded
+    oracle with batch-sharded attention and slot-sharded KV."""
+    cfg = mcfg.get_config("tiny-test")  # kv_heads=4
+    params = init_params(cfg, jax.random.key(0))
+    batch, T = 8, 16  # batch divisible by dp*tp = 8
+    inputs = _inputs(cfg, batch, T, key=9)
+    sample_pos = jnp.full((batch,), T - 1, jnp.int32)
+    want = _reference_logits(cfg, params, inputs, sample_pos)
+
+    mesh = make_mesh(MeshConfig(tp=8), jax.devices())
+    from dynamo_tpu.parallel.sharding import param_pspecs as pps
+
+    sharded = shard_pytree(params, pps(cfg, dp_attention=True), mesh)
+    cache = shard_pytree(
+        kvc.init_cache(kvc.KvCacheConfig.for_model(
+            cfg, num_blocks=64, block_size=BLOCK, dtype=jnp.float32)),
+        cache_pspecs(cfg.num_layers, dp_attention=True), mesh)
+    step = make_sharded_step(cfg, BLOCK, mesh, dp_attention=True)
+    got, cache2 = step(sharded, cache, *inputs, sample_pos)
+
+    np.testing.assert_allclose(want, np.asarray(got), rtol=5e-4, atol=5e-4)
+    # KV memory splits over tp on the SLOT axis.
+    assert (cache2["k"][0].sharding.spec
+            == cache_pspecs(cfg.num_layers, dp_attention=True)["k"][0])
+    # Plain mode still refuses tp > kv_heads.
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        make_sharded_step(cfg, BLOCK, mesh)
