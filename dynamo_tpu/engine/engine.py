@@ -102,6 +102,10 @@ class EngineConfig:
     enable_prefix_cache: bool = True
     host_blocks: int = 0
     disk_blocks: int = 0
+    # G4 remote tier: `remote_fetch_fn(block_hash) -> Optional[ndarray]`,
+    # consulted on local-tier misses during admission matching.  Must be
+    # synchronous and bounded (runs on the engine thread).
+    remote_fetch_fn: Optional[Callable] = None
     # Pallas paged-decode kernel; None = auto (TPU backend, unsharded —
     # the sharded step keeps the GSPMD-partitionable gather path).
     use_pallas_decode: Optional[bool] = None
@@ -210,6 +214,7 @@ class EngineCore:
                 extract_fn=self._extract_block,
                 inject_fn=self._inject_block,
                 on_removed=self._on_block_evicted,
+                remote_fetch_fn=config.remote_fetch_fn,
             )
         else:
             self.allocator = BlockAllocator(config.num_blocks)

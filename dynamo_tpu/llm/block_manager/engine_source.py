@@ -32,12 +32,15 @@ class ManagedBlockSource:
         extract_fn=None,
         inject_fn=None,
         on_removed: Optional[Callable[[int], None]] = None,
+        remote_fetch_fn=None,
     ) -> None:
         """`on_removed(block_hash)` fires when a block leaves the device
-        tier (the engine turns it into a REMOVED KV event)."""
+        tier (the engine turns it into a REMOVED KV event).
+        `remote_fetch_fn` is the G4 remote tier (manager.py)."""
         self._on_removed = on_removed
         self.manager = KvBlockManager(config, extract_fn=extract_fn,
-                                      inject_fn=inject_fn)
+                                      inject_fn=inject_fn,
+                                      remote_fetch_fn=remote_fetch_fn)
         # Chain the eviction hooks: offload first (manager's), then event.
         inner_evict = self.manager.device.on_evict
 

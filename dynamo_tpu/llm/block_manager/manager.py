@@ -58,10 +58,18 @@ class KvBlockManager:
         block_nbytes: int = 0,
         extract_fn=None,
         inject_fn=None,
+        remote_fetch_fn=None,
     ) -> None:
+        """`remote_fetch_fn(block_hash) -> Optional[np.ndarray]`: the G4
+        tier (reference cache level G4 "remote",
+        `block_manager.rs:68-82`) — consulted when a prefix block misses
+        every local tier.  Must be synchronous and bounded (the caller is
+        the engine thread); the disagg decode path wires this to a
+        peer-worker kv_blocks pull."""
         self.config = config
         self.extract_fn = extract_fn
         self.inject_fn = inject_fn
+        self.remote_fetch_fn = remote_fetch_fn
 
         self.device = BlockPool(config.device_blocks, name="G1-device",
                                 on_evict=self._on_device_evict,
@@ -79,6 +87,7 @@ class KvBlockManager:
             self.disk = BlockPool(config.disk_blocks, name="G3-disk")
         self.offloaded_blocks = 0
         self.onboarded_blocks = 0
+        self.remote_fetched_blocks = 0
 
     # -- lazy tier storage (shape known at first offload) ------------------
 
@@ -140,21 +149,29 @@ class KvBlockManager:
         g1 = self.device.match_sequence_hashes(hashes)
         ids = self.device.acquire_matched(g1)
         n = len(ids)
-        # 2) extend from lower tiers
+        # 2) extend from lower tiers (G2 host → G3 disk → G4 remote).
+        # Capacity/inject guards come FIRST: tiers below G2 materialize
+        # data (disk read, remote network pull) and a block fetched with
+        # nowhere to put it would be wasted work re-paid on every retry.
         while n < len(hashes):
+            if self.inject_fn is None or not self.device.can_allocate(1):
+                break
             h = hashes[n]
-            src = None
-            if self.host and self.host.registry.lookup(h) is not None:
-                src = ("host", self.host.registry.lookup(h))
-            elif self.disk and self.disk.registry.lookup(h) is not None:
-                src = ("disk", self.disk.registry.lookup(h))
-            if src is None or self.inject_fn is None:
+            data = None
+            if self.host is not None:
+                hslot = self.host.registry.lookup(h)
+                if hslot is not None:
+                    data = self._host_data[hslot.index]
+            if data is None and self.disk is not None:
+                dslot = self.disk.registry.lookup(h)
+                if dslot is not None:
+                    data = np.array(self._disk_data[dslot.index])
+            if data is None and self.remote_fetch_fn is not None:
+                data = self.remote_fetch_fn(h)
+                if data is not None:
+                    self.remote_fetched_blocks += 1
+            if data is None:
                 break
-            if not self.device.can_allocate(1):
-                break
-            tier, slot = src
-            data = (self._host_data[slot.index] if tier == "host"
-                    else np.array(self._disk_data[slot.index]))
             [gslot] = self.device.allocate(1)
             self.inject_fn(gslot, data)
             self.device.register(gslot, h)
@@ -219,6 +236,7 @@ class KvBlockManager:
             "g1_misses": self.device.misses,
             "offloaded": self.offloaded_blocks,
             "onboarded": self.onboarded_blocks,
+            "remote_fetched": self.remote_fetched_blocks,
         }
         if self.host:
             s["g2_resident"] = len(self.host.registry.by_hash)

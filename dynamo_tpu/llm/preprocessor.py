@@ -96,8 +96,12 @@ class OpenAIPreprocessor:
             forced = choice.get("function", {}).get("name")
             if forced:
                 tools = [t for t in tools
-                         if t.get("function", {}).get("name") == forced] \
-                    or tools
+                         if t.get("function", {}).get("name") == forced]
+                if not tools:
+                    # OpenAI semantics: forcing an undeclared tool is a
+                    # client error, not a silent fall-back to all tools.
+                    raise ValueError(
+                        f"tool_choice forces unknown tool {forced!r}")
         return self._template.render(
             messages=messages, add_generation_prompt=True, tools=tools)
 

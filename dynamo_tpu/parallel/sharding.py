@@ -217,8 +217,8 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
     full vocab.
 
     `dp_attention`: batch shards over (dp, tp) and the KV cache's slot
-    axis over tp — see param_pspecs/cache_pspecs.  Batch must divide
-    dp×tp.
+    axis over tp — see param_pspecs/cache_pspecs.  Batch must be a
+    multiple of dp×tp.
     """
     from dynamo_tpu.models.llama import make_forward_step
 
@@ -234,12 +234,11 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
             # surfaces a clear error instead of opaque GSPMD padding.
             if tokens.shape[0] % div:
                 raise ValueError(
-                    f"dp_attention: batch {tokens.shape[0]} must divide "
-                    f"dp*tp = {div}")
+                    f"dp_attention: batch {tokens.shape[0]} must be a "
+                    f"multiple of dp*tp = {div}")
             return inner(params, cache, tokens, *rest)
     else:
         step = inner
-    d = data_pspecs()
     batch_axes = ("dp", "tp") if dp_attention else "dp"
     in_shardings = (
         jax.tree.map(lambda s: NamedSharding(mesh, s),

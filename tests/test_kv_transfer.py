@@ -128,3 +128,40 @@ def test_transfer_over_rpc_plane():
         return True
 
     assert asyncio.run(asyncio.wait_for(main(), timeout=120))
+
+
+def test_g4_remote_tier_onboards_peer_blocks():
+    """G4 (remote) tier: a local-tier miss during admission matching
+    consults the remote fetch hook and onboards the peer's blocks —
+    the decode engine skips prefill for the fetched prefix."""
+    prompt = list(range(1, 25))  # 3 sealed blocks
+
+    a = _core()
+    out_a = _run(a, "a", prompt)
+
+    fetches = []
+
+    def remote_fetch(block_hash):
+        fetches.append(block_hash)
+        got = a.export_blocks([block_hash])
+        return got.get(block_hash)
+
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+
+    b = EngineCore(EngineConfig(
+        model=TINY, num_blocks=64,
+        remote_fetch_fn=remote_fetch,
+        scheduler=SchedulerConfig(
+            max_seqs=4, block_size=BS, max_pages_per_seq=8,
+            max_prefill_chunk=16,
+            decode_buckets=(1, 2, 4), prefill_buckets=(8, 16))))
+    out_b = _run(b, "b", prompt)
+    assert out_b == out_a
+    assert len(fetches) == 3
+    assert b.allocator.manager.remote_fetched_blocks == 3
+    # The fetched prefix is registered locally: a second request hits G1,
+    # no further remote fetches.
+    out_b2 = _run(b, "b2", prompt)
+    assert out_b2 == out_a
+    assert len(fetches) == 3
