@@ -772,9 +772,13 @@ class EngineCore:
 
     # -- block registration + KV events ------------------------------------
 
-    def _extract_block(self, page: int) -> np.ndarray:
-        """Device block → host array [2, L, bs, Hkv, D] (offload/transfer)."""
-        return np.asarray(self._extract_jit(self.cache, jnp.int32(page)))
+    def _extract_block(self, page: int):
+        """Device block [2, L, bs, Hkv, D] as a DEVICE array: the jit
+        dispatch is async and the result is an independent staging buffer,
+        so the block manager's offload path can defer the host transfer
+        off-thread (np.asarray on the handle syncs when bytes are
+        needed)."""
+        return self._extract_jit(self.cache, jnp.int32(page))
 
     def _inject_block(self, page: int, data: np.ndarray) -> None:
         """Host array → device block (onboard/transfer-in)."""

@@ -11,10 +11,17 @@ engine's paged jax array), G2 (pinned host DRAM — one numpy array), G3
   G1 slots before prefill, converting disk/DRAM residency into skipped
   prefill FLOPs.
 
-Device↔host copies are slot-indexed gathers/scatters through donated jit
-functions (in-place HBM updates, no cache reallocation); host↔disk are
-numpy slice copies.  All transfers are synchronous-per-engine-step in this
-round (the async double-buffered offload queue is a planned refinement).
+Device↔host copies are slot-indexed gathers/scatters through jit
+functions; host↔disk are numpy slice copies.
+
+Offload is ASYNC (r2 shipped it synchronous — every G1 eviction blocked
+the engine thread on a device→host round trip, which costs ~170 ms on a
+tunneled TPU): `_on_device_evict` runs only the device-side extract (an
+async dispatch producing an independent staging array — device execution
+order guarantees it reads the cache before the engine's next step), and
+the host copy resolves on a background thread.  G2 readers
+(onboard/export/spill-to-disk) consult the pending map and wait for the
+specific block's future only when they actually need its bytes.
 """
 
 from __future__ import annotations
@@ -88,6 +95,13 @@ class KvBlockManager:
         self.offloaded_blocks = 0
         self.onboarded_blocks = 0
         self.remote_fetched_blocks = 0
+        # Async offload: hash → Future resolving when the block's bytes
+        # have landed in _host_data.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._offload_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kv-offload")
+        self._pending_host: Dict[int, object] = {}
 
     # -- lazy tier storage (shape known at first offload) ------------------
 
@@ -108,24 +122,62 @@ class KvBlockManager:
     # -- offload path (down-tier) ------------------------------------------
 
     def _on_device_evict(self, block_hash: int, slot: int) -> None:
-        """G1 eviction → stash the block in G2 (if enabled)."""
+        """G1 eviction → stash the block in G2 (if enabled).
+
+        Synchronous part: ONLY the device-side extract dispatch (the
+        extract must be enqueued before the evicted slot's next write;
+        in-order device execution then guarantees it reads the old
+        bytes).  The device→host transfer resolves off-thread."""
         if self.host is None or self.extract_fn is None:
             return
         if self.host.registry.lookup(block_hash) is not None:
             return  # already resident down-tier
-        data = np.asarray(self.extract_fn(slot))
-        self._ensure_storage(data)
+        staged = self.extract_fn(slot)   # device array (async dispatch)
+        if self._block_shape is None:
+            # First offload: the storage allocation needs the concrete
+            # shape — pay the one-time sync.
+            staged = np.asarray(staged)
+            self._ensure_storage(staged)
         if not self.host.can_allocate(1):
             return  # G2 fully pinned (shouldn't happen: G2 blocks unpin fast)
         [hslot] = self.host.allocate(1)
-        self._host_data[hslot] = data
         self.host.register(hslot, block_hash)
         self.host.release([hslot])       # → inactive: resident, evictable
+
+        def land(staged=staged, hslot=hslot):
+            self._host_data[hslot] = np.asarray(staged)
+
+        self._pending_host[block_hash] = self._offload_pool.submit(land)
         self.offloaded_blocks += 1
 
+    def _settle_host(self, block_hash: int) -> bool:
+        """Settle an in-flight offload for `block_hash` (if any) before
+        reading its G2 bytes.  Returns False — and DISCARDS the G2
+        registration — when the deferred device→host copy failed: the
+        slot would otherwise serve uninitialized bytes as valid KV, and
+        the captured exception would detonate inside whichever unrelated
+        engine operation touched the hash next."""
+        fut = self._pending_host.pop(block_hash, None)
+        if fut is None:
+            return True
+        try:
+            fut.result()
+            return True
+        except Exception:
+            logger.exception("async offload of block %x failed; dropping "
+                             "its G2 entry", block_hash)
+            if self.host is not None:
+                self.host.discard(block_hash)
+            return False
+
     def _on_host_evict(self, block_hash: int, slot: int) -> None:
-        """G2 eviction → spill to G3 (if enabled)."""
-        if self.disk is None or self._host_data is None:
+        """G2 eviction → spill to G3 (if enabled).
+
+        The pending-offload entry is settled FIRST, on every path: an
+        early return that left it behind would leak one Future per
+        evicted hash forever."""
+        ok = self._settle_host(block_hash)
+        if self.disk is None or self._host_data is None or not ok:
             return
         if self.disk.registry.lookup(block_hash) is not None:
             return
@@ -160,7 +212,7 @@ class KvBlockManager:
             data = None
             if self.host is not None:
                 hslot = self.host.registry.lookup(h)
-                if hslot is not None:
+                if hslot is not None and self._settle_host(h):
                     data = self._host_data[hslot.index]
             if data is None and self.disk is not None:
                 dslot = self.disk.registry.lookup(h)
@@ -191,7 +243,8 @@ class KvBlockManager:
             return np.asarray(self.extract_fn(slot.index))
         if self.host is not None:
             hslot = self.host.registry.lookup(block_hash)
-            if hslot is not None and self._host_data is not None:
+            if (hslot is not None and self._host_data is not None
+                    and self._settle_host(block_hash)):
                 return np.array(self._host_data[hslot.index])
         if self.disk is not None:
             dslot = self.disk.registry.lookup(block_hash)

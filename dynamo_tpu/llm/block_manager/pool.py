@@ -164,6 +164,20 @@ class BlockPool:
         self.registry.by_hash[block_hash] = slot
         return True
 
+    def discard(self, block_hash: int) -> bool:
+        """Drop a registered block entirely (failed fill / poisoned
+        bytes): the registration disappears and an unpinned slot returns
+        to the free list.  Pinned slots just lose their registration."""
+        slot = self.registry.by_hash.pop(block_hash, None)
+        if slot is None:
+            return False
+        self.registry.inactive.pop(block_hash, None)
+        slot.block_hash = None
+        if slot.ref_count == 0:
+            self._slots.pop(slot.index, None)
+            self._free.append(slot.index)
+        return True
+
     # -- release ----------------------------------------------------------
 
     def release(self, slot_indices: Sequence[int]) -> None:

@@ -165,3 +165,59 @@ def test_g4_remote_tier_onboards_peer_blocks():
     out_b2 = _run(b, "b2", prompt)
     assert out_b2 == out_a
     assert len(fetches) == 3
+
+
+def test_async_offload_waits_for_inflight_bytes():
+    """Eviction dispatches the extract and returns; a G2 reader arriving
+    before the host copy lands must wait for THAT block's future (the
+    async-offload ordering contract)."""
+    import threading
+    import time as _time
+
+    from dynamo_tpu.llm.block_manager.manager import (
+        KvBlockManager, TieredConfig)
+
+    store = {1: None}
+    release_gate = threading.Event()
+
+    class SlowStaged:
+        """Device-array stand-in whose host transfer blocks on a gate."""
+
+        def __init__(self, value):
+            self.value = value
+
+        def __array__(self, dtype=None, copy=None):
+            release_gate.wait(5)
+            return np.full((2, 2), self.value, np.float32)
+
+    injected = {}
+    mgr = KvBlockManager(
+        TieredConfig(device_blocks=4, host_blocks=4, block_size=8),
+        extract_fn=lambda slot: SlowStaged(slot),
+        inject_fn=lambda slot, data: injected.__setitem__(slot, np.array(data)))
+    # Prime storage shape with a fast first offload.
+    release_gate.set()
+    [s0] = mgr.allocate(1)
+    mgr.register(s0, 100)
+    mgr.release([s0])
+    mgr.allocate(3)  # evicts hash 100 → offload (fast path, shape known)
+    assert mgr.offloaded_blocks == 1
+    release_gate.clear()
+
+    # Simulate an in-flight (not yet landed) host copy for hash 100 and
+    # verify a G2 reader blocks on exactly that future.
+    fut_done = []
+
+    def land_slow():
+        release_gate.wait(5)
+        fut_done.append(True)
+
+    mgr._pending_host[100] = mgr._offload_pool.submit(land_slow)
+    t = threading.Thread(
+        target=lambda: fut_done.append(mgr.export_block(100) is not None))
+    t.start()
+    _time.sleep(0.1)
+    assert not fut_done  # reader is blocked on the pending offload
+    release_gate.set()
+    t.join(5)
+    assert fut_done and fut_done[-1] is True  # waited, then read real bytes
