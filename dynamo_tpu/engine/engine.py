@@ -868,6 +868,12 @@ class InferenceEngine:
         self._wake.set()
         if self._thread:
             await asyncio.to_thread(self._thread.join, 10.0)
+        # Tear down the managed block source's offload worker (thread
+        # leak per discarded engine otherwise).
+        close = getattr(getattr(self.core.allocator, "manager", None),
+                        "close", None)
+        if close is not None:
+            await asyncio.to_thread(close)
 
     def _run_loop(self) -> None:
         while not self._stop.is_set():

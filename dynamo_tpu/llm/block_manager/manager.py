@@ -147,6 +147,11 @@ class KvBlockManager:
         def land(staged=staged, hslot=hslot):
             self._host_data[hslot] = np.asarray(staged)
 
+        # Backpressure: each pending land pins a device staging buffer in
+        # HBM; cap the backlog so an eviction burst can't OOM the device
+        # (settling the oldest waits for exactly one transfer).
+        if len(self._pending_host) >= 16:
+            self._settle_host(next(iter(self._pending_host)))
         self._pending_host[block_hash] = self._offload_pool.submit(land)
         self.offloaded_blocks += 1
 
@@ -268,6 +273,13 @@ class KvBlockManager:
         self.device.release([slot])  # -> inactive: resident, matchable
         self.onboarded_blocks += 1
         return True
+
+    def close(self) -> None:
+        """Settle outstanding offloads and stop the worker thread (a
+        manager per discarded engine would otherwise leak its thread)."""
+        for h in list(self._pending_host):
+            self._settle_host(h)
+        self._offload_pool.shutdown(wait=True)
 
     # -- passthrough G1 ops ------------------------------------------------
 

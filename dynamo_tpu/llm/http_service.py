@@ -42,6 +42,7 @@ class HttpService:
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
         self.app.router.add_post("/v1/embeddings", self.embeddings)
+        self.app.router.add_post("/v1/responses", self.responses)
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/metrics", self.prometheus)
         self.app.router.add_get("/health", self.health)
@@ -211,6 +212,64 @@ class HttpService:
             usage=oai.Usage(
                 prompt_tokens=len(pre.token_ids),
                 completion_tokens=det.completion_tokens,
+                total_tokens=len(pre.token_ids) + det.completion_tokens))
+        return web.json_response(resp.model_dump(exclude_none=True))
+
+    async def responses(self, request: web.Request) -> web.Response:
+        """/v1/responses (reference `protocols/openai/responses.rs`):
+        normalised onto the chat pipeline; unary only in this round."""
+        try:
+            body = oai.ResponsesRequest.model_validate(await request.json())
+        except Exception as e:
+            return self._error(400, f"invalid request: {e}")
+        if body.stream:
+            return self._error(400, "streaming /v1/responses is not "
+                                    "supported yet; use stream=false")
+        handle = self._lookup(body.model)
+        if handle is None:
+            return self._error(404, f"model {body.model!r} not found",
+                               "model_not_found")
+        rid = self._request_id(request, "resp")
+        try:
+            chat = body.as_chat()
+            pre = handle.preprocessor.preprocess_chat(chat, rid)
+        except Exception as e:
+            # as_chat's ChatMessage validation failures are client input
+            # errors too (e.g. an unsupported role) — 400, not 500.
+            return self._error(400, str(e))
+        err = self._validate_context(handle, pre)
+        if err is not None:
+            return err
+        logger.info("request %s: responses model=%s prompt_tokens=%d",
+                    rid, body.model, len(pre.token_ids))
+        start = time.monotonic()
+        self.metrics.requests_total.inc(labels={"model": body.model})
+        self.metrics.requests_in_flight.add(1, labels={"model": body.model})
+        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
+        parts, reason = [], None
+        try:
+            async for out in self._token_stream(handle, pre, det,
+                                                body.model, start):
+                parts.append(out.text)
+                if out.finished:
+                    reason = out.finish_reason
+        finally:
+            self.metrics.requests_in_flight.add(-1,
+                                                labels={"model": body.model})
+        self._observe_done(body.model, start, len(pre.token_ids),
+                           det.completion_tokens)
+        # Responses-API status semantics: stop → completed; truncation
+        # (length ceiling) → incomplete; engine error → failed.
+        status = {"stop": "completed", "length": "incomplete",
+                  "error": "failed"}.get(str(reason or "stop"), "completed")
+        resp = oai.ResponsesResponse(
+            id=rid, model=body.model, status=status,
+            output=[oai.ResponseOutputMessage(
+                status=status,
+                content=[oai.ResponseOutputText(text="".join(parts))])],
+            usage=oai.ResponsesUsage(
+                input_tokens=len(pre.token_ids),
+                output_tokens=det.completion_tokens,
                 total_tokens=len(pre.token_ids) + det.completion_tokens))
         return web.json_response(resp.model_dump(exclude_none=True))
 

@@ -62,10 +62,12 @@ class ChatMessage(BaseModel):
             return self.content
         if self.content is None:
             return ""
-        # Multi-part content: concatenate text parts (image parts are the
-        # multimodal pipeline's job).
+        # Multi-part content: concatenate textual parts — chat uses
+        # "text", the Responses API uses "input_text"/"output_text"
+        # (image parts are the multimodal pipeline's job).
         return "".join(
-            p.get("text", "") for p in self.content if p.get("type") == "text")
+            p.get("text", "") for p in self.content
+            if p.get("type") in ("text", "input_text", "output_text"))
 
 
 class SamplingFields(BaseModel):
@@ -209,6 +211,73 @@ class CompletionResponse(BaseModel):
     model: str
     choices: List[CompletionChoice]
     usage: Optional[Usage] = None
+
+
+# ---------------------------------------------------------------------------
+# Responses API (the newer OpenAI surface; reference protocols/openai/
+# responses.rs)
+
+
+class ResponsesRequest(BaseModel):
+    model: str
+    input: Union[str, List[Dict[str, Any]]]
+    instructions: Optional[str] = None
+    max_output_tokens: Optional[int] = Field(default=None, ge=1)
+    temperature: Optional[float] = Field(default=None, ge=0.0, le=2.0)
+    top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
+    stream: bool = False
+
+    def as_chat(self) -> "ChatCompletionRequest":
+        """Normalise to the internal chat form (one preprocessor path)."""
+        messages: List[ChatMessage] = []
+        if self.instructions:
+            messages.append(ChatMessage(role="system",
+                                        content=self.instructions))
+        if isinstance(self.input, str):
+            messages.append(ChatMessage(role="user", content=self.input))
+        else:
+            for item in self.input:
+                role = item.get("role", "user")
+                if role == "developer":  # Responses-API alias for system
+                    role = "system"
+                messages.append(ChatMessage(
+                    role=role, content=item.get("content")))
+        return ChatCompletionRequest(
+            model=self.model, messages=messages,
+            max_tokens=self.max_output_tokens,
+            temperature=self.temperature, top_p=self.top_p)
+
+
+class ResponseOutputText(BaseModel):
+    type: Literal["output_text"] = "output_text"
+    text: str
+
+
+class ResponseOutputMessage(BaseModel):
+    type: Literal["message"] = "message"
+    role: Literal["assistant"] = "assistant"
+    status: str = "completed"
+    content: List[ResponseOutputText] = Field(default_factory=list)
+
+
+class ResponsesUsage(BaseModel):
+    input_tokens: int = 0
+    output_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ResponsesResponse(BaseModel):
+    id: str
+    object: Literal["response"] = "response"
+    created_at: int = Field(default_factory=now_ts)
+    model: str
+    status: str = "completed"
+    output: List[ResponseOutputMessage] = Field(default_factory=list)
+    usage: ResponsesUsage = Field(default_factory=ResponsesUsage)
+
+    @property
+    def output_text(self) -> str:
+        return "".join(t.text for m in self.output for t in m.content)
 
 
 # ---------------------------------------------------------------------------

@@ -451,3 +451,73 @@ def test_unknown_tool_parser_rejected_before_generation():
             await engine.stop()
 
     _run(main())
+
+
+def test_responses_route():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/responses", json={
+                        "model": "tiny", "input": "say hi",
+                        "instructions": "be brief",
+                        "max_output_tokens": 4}) as r:
+                    assert r.status == 200
+                    data = await r.json()
+            assert data["object"] == "response"
+            # max_output_tokens truncation reports incomplete (Responses
+            # API status semantics); a natural stop would be completed.
+            assert data["status"] in ("completed", "incomplete")
+            msg = data["output"][0]
+            assert msg["type"] == "message" and msg["role"] == "assistant"
+            assert isinstance(msg["content"][0]["text"], str)
+            assert data["usage"]["output_tokens"] == 4
+            # Structured message input form.
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/responses", json={
+                        "model": "tiny",
+                        "input": [{"role": "user", "content": "hello"}],
+                        "max_output_tokens": 2}) as r:
+                    assert r.status == 200
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
+def test_responses_structured_parts_and_status():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                # Structured input_text parts + developer role must not be
+                # dropped or 500.
+                async with s.post(f"{base}/v1/responses", json={
+                        "model": "tiny",
+                        "input": [
+                            {"role": "developer", "content": "be brief"},
+                            {"role": "user", "content": [
+                                {"type": "input_text", "text": "hello"}]}],
+                        "max_output_tokens": 4}) as r:
+                    assert r.status == 200
+                    data = await r.json()
+                # Length-truncated generations report incomplete.
+                assert data["status"] == "incomplete"
+                assert data["usage"]["input_tokens"] > 10  # parts rendered
+                # Unknown role is a 400, not a 500.
+                async with s.post(f"{base}/v1/responses", json={
+                        "model": "tiny",
+                        "input": [{"role": "alien", "content": "x"}]}) as r:
+                    assert r.status == 400
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
