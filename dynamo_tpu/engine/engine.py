@@ -692,6 +692,13 @@ class EngineCore:
         self._hash_seqs.pop(req.request_id, None)
         self._published_blocks.pop(req.request_id, None)
 
+    def clear_prefix_cache(self) -> int:
+        """Admin flush of all reusable cached blocks (reference
+        `clear_kv_blocks.rs`); returns the number dropped.  Must run on
+        the engine thread."""
+        clear = getattr(self.allocator, "clear_cache", None)
+        return clear() if clear is not None else 0
+
     # -- embeddings --------------------------------------------------------
 
     def embed_tokens(self, token_lists: List[List[int]]) -> np.ndarray:
@@ -971,6 +978,9 @@ class InferenceEngine:
     async def export_blocks(self, hashes) -> Dict[int, np.ndarray]:
         return await self.run_in_engine(
             lambda: self.core.export_blocks(hashes))
+
+    async def clear_kv_blocks(self) -> int:
+        return await self.run_in_engine(self.core.clear_prefix_cache)
 
     async def embed(self, token_lists) -> np.ndarray:
         # One engine-thread slot PER INPUT, not one for the whole batch:

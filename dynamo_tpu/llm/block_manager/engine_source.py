@@ -100,3 +100,12 @@ class ManagedBlockSource:
     @property
     def stats(self):
         return self.manager.stats
+
+    def clear_cache(self) -> int:
+        """Flush all reusable cached blocks; REMOVED events keep the
+        routers' indexes truthful."""
+        dropped = self.manager.clear_cache()
+        if self._on_removed:
+            for h in dropped:
+                self._on_removed(h)
+        return len(dropped)

@@ -308,3 +308,16 @@ class KvBlockManager:
         if self.disk:
             s["g3_resident"] = len(self.disk.registry.by_hash)
         return s
+
+    def clear_cache(self) -> List[int]:
+        """Admin flush (reference `http/service/clear_kv_blocks.rs`): drop
+        every reusable cached block in every tier.  Returns the G1 hashes
+        dropped (the ones routers index via KV events)."""
+        for h in list(self._pending_host):
+            self._settle_host(h)
+        dropped = self.device.clear_inactive()
+        if self.host is not None:
+            self.host.clear_inactive()
+        if self.disk is not None:
+            self.disk.clear_inactive()
+        return dropped

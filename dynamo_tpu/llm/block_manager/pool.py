@@ -196,3 +196,17 @@ class BlockPool:
             else:
                 del self._slots[idx]
                 self._free.append(idx)
+
+    def clear_inactive(self) -> List[int]:
+        """Drop EVERY inactive registered block (admin cache flush —
+        reference `clear_kv_blocks.rs`): returns the dropped hashes.
+        Pinned (active) blocks are untouched; no eviction hooks fire
+        (flushing must not offload what it is discarding)."""
+        dropped = []
+        while self.registry.inactive:
+            h, slot = self.registry.inactive.popitem(last=False)
+            del self.registry.by_hash[h]
+            del self._slots[slot.index]
+            self._free.append(slot.index)
+            dropped.append(h)
+        return dropped

@@ -93,6 +93,7 @@ def delta_from_wire(d: dict) -> TokenDelta:
 
 
 EMBED_ENDPOINT = "embed"
+CLEAR_KV_ENDPOINT = "clear_kv"
 
 
 def engine_wire_handler(engine_client) -> Callable:
@@ -111,6 +112,16 @@ def engine_wire_handler(engine_client) -> Callable:
             n_out += len(delta.token_ids)
             yield delta_to_wire(delta)
         logger.info("request %s: finished, %d tokens", req.request_id, n_out)
+
+    return handler
+
+
+def clear_kv_wire_handler(engine_client) -> Callable:
+    """Worker-side `clear_kv` admin endpoint."""
+
+    async def handler(payload: dict) -> AsyncIterator[dict]:
+        n = await engine_client.clear_kv_blocks()
+        yield {"cleared": int(n)}
 
     return handler
 
@@ -139,6 +150,35 @@ class RemoteEngineClient:
             delta = delta_from_wire(d)
             delta.request_id = request.request_id
             yield delta
+
+    async def clear_kv_blocks(self) -> int:
+        """Flush every live instance's reusable KV blocks — including the
+        sibling prefill component's workers in a disaggregated deployment
+        (their warm caches would otherwise survive the flush).  Errors
+        are per-instance (a worker without the endpoint doesn't abort the
+        fleet flush)."""
+        from dynamo_tpu.runtime.rpc import RpcError
+
+        runtime = self.client.endpoint.runtime
+        ep = self.client.endpoint
+        addresses = [inst.address for inst in self.client.instances()]
+        prefill_prefix = (f"instances/{ep.namespace}/"
+                          f"{ep.component}-prefill/")
+        for entry in (await runtime.cp.get_prefix(prefill_prefix)).values():
+            addr = entry.get("address")
+            if addr:
+                addresses.append(addr)
+        total = 0
+        for address in addresses:
+            rpc = runtime.client_for(address)
+            try:
+                async for d in rpc.call(CLEAR_KV_ENDPOINT, {}):
+                    total += int(d.get("cleared", 0))
+            except RpcError:
+                continue  # endpoint absent on this worker (e.g. mocker)
+            except ConnectionError:
+                await runtime.evict_client(address)
+        return total
 
     async def embed(self, token_lists):
         """Forward to a worker's `embed` RPC endpoint (round-robin over

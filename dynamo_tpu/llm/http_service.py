@@ -28,6 +28,9 @@ from dynamo_tpu.runtime.metrics import FrontendMetrics, MetricsRegistry
 
 logger = logging.getLogger(__name__)
 
+N_STREAM_UNSUPPORTED = ("n > 1 with stream=true is not supported; request "
+                        "n choices unary or stream one")
+
 
 class HttpService:
     def __init__(
@@ -43,6 +46,7 @@ class HttpService:
         self.app.router.add_post("/v1/completions", self.completions)
         self.app.router.add_post("/v1/embeddings", self.embeddings)
         self.app.router.add_post("/v1/responses", self.responses)
+        self.app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/metrics", self.prometheus)
         self.app.router.add_get("/health", self.health)
@@ -154,6 +158,8 @@ class HttpService:
         logger.info("request %s: chat model=%s prompt_tokens=%d stream=%s",
                     rid, body.model, len(pre.token_ids), body.stream)
         if body.stream:
+            if body.n > 1:
+                return self._error(400, N_STREAM_UNSUPPORTED)
             return await self._stream_chat(request, handle, body, pre, rid)
         return await self._unary_chat(handle, body, pre, rid)
 
@@ -178,42 +184,56 @@ class HttpService:
                     "stream=%s", rid, body.model, len(pre.token_ids),
                     body.stream)
         if body.stream:
+            if body.n > 1:
+                return self._error(400, N_STREAM_UNSUPPORTED)
             return await self._stream_completion(request, handle, body, pre,
                                                  rid)
 
         start = time.monotonic()
         self.metrics.requests_total.inc(labels={"model": body.model})
         self.metrics.requests_in_flight.add(1, labels={"model": body.model})
-        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
-        text_parts = []
-        reason = None
-        lp_sink = [] if pre.sampling.logprobs else None
+        want_lp = bool(pre.sampling.logprobs)
         try:
-            async for out in self._token_stream(handle, pre, det, body.model,
-                                                start, lp_sink=lp_sink):
-                text_parts.append(out.text)
-                if out.finished:
-                    reason = out.finish_reason
+            results, total_out = await self._collect_choices(
+                handle, pre, body.n, body.model, start, want_lp)
         finally:
             self.metrics.requests_in_flight.add(-1, labels={"model": body.model})
-        self._observe_done(body.model, start, len(pre.token_ids),
-                           det.completion_tokens)
-        logprobs = None
-        if lp_sink:
-            logprobs = {
-                "tokens": [handle.tokenizer.decode([t]) for t, _ in lp_sink],
-                "token_logprobs": [lp for _, lp in lp_sink],
-            }
+        self._observe_done(body.model, start, len(pre.token_ids), total_out)
+        choices = []
+        for i, (text, reason, det, lp_sink) in enumerate(results):
+            logprobs = None
+            if lp_sink:
+                logprobs = {
+                    "tokens": [handle.tokenizer.decode([t])
+                               for t, _ in lp_sink],
+                    "token_logprobs": [lp for _, lp in lp_sink],
+                }
+            choices.append(oai.CompletionChoice(
+                index=i, text=text, finish_reason=reason,
+                logprobs=logprobs))
         resp = oai.CompletionResponse(
-            id=rid, model=body.model,
-            choices=[oai.CompletionChoice(
-                text="".join(text_parts), finish_reason=reason,
-                logprobs=logprobs)],
+            id=rid, model=body.model, choices=choices,
             usage=oai.Usage(
                 prompt_tokens=len(pre.token_ids),
-                completion_tokens=det.completion_tokens,
-                total_tokens=len(pre.token_ids) + det.completion_tokens))
+                completion_tokens=total_out,
+                total_tokens=len(pre.token_ids) + total_out))
         return web.json_response(resp.model_dump(exclude_none=True))
+
+    async def clear_kv_blocks(self, _req: web.Request) -> web.Response:
+        """Admin: flush every model's reusable KV blocks (reference
+        `http/service/clear_kv_blocks.rs`)."""
+        out = {}
+        for name in self.models.names():
+            clear = getattr(self.models.get(name).client,
+                            "clear_kv_blocks", None)
+            if clear is None:
+                out[name] = {"status": "unsupported"}
+                continue
+            try:
+                out[name] = {"status": "ok", "cleared": await clear()}
+            except Exception as e:
+                out[name] = {"status": "error", "error": str(e)}
+        return web.json_response(out)
 
     async def responses(self, request: web.Request) -> web.Response:
         """/v1/responses (reference `protocols/openai/responses.rs`):
@@ -356,6 +376,57 @@ class HttpService:
 
     # -- chat serving internals -------------------------------------------
 
+    def _fan_out(self, pre, n: int):
+        """n>1 sampling: clone the preprocessed request per choice with a
+        distinct engine id; a client-pinned seed folds the choice index in
+        (reproducible, but distinct across choices — vLLM convention)."""
+        import copy
+        import dataclasses
+
+        out = []
+        for i in range(n):
+            clone = copy.copy(pre)
+            clone.request_id = f"{pre.request_id}-c{i}" if i else pre.request_id
+            if i and pre.sampling.seed is not None:
+                clone.sampling = dataclasses.replace(
+                    pre.sampling, seed=pre.sampling.seed + i)
+            out.append(clone)
+        return out
+
+    async def _collect_one(self, handle, pre, model, start, want_lp):
+        """Drain one engine stream → (text, finish_reason, det, lp_sink)."""
+        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
+        lp_sink = [] if want_lp else None
+        parts, reason = [], None
+        async for out in self._token_stream(handle, pre, det, model, start,
+                                            lp_sink=lp_sink):
+            parts.append(out.text)
+            if out.finished:
+                reason = out.finish_reason
+        return "".join(parts), reason, det, lp_sink
+
+    async def _collect_choices(self, handle, pre, n, model, start, want_lp):
+        """n-choice unary collection.  Choice 0 runs FIRST so its sealed
+        prompt blocks are registered before choices 1..n-1 start — they
+        prefix-hit instead of paying n× prefill for the same prompt.
+        Sibling failures don't leak running generations: the remainder is
+        gathered with return_exceptions and the first error re-raised
+        only after every stream has settled."""
+        clones = self._fan_out(pre, n)
+        results = [await self._collect_one(handle, clones[0], model, start,
+                                           want_lp)]
+        if n > 1:
+            rest = await asyncio.gather(
+                *(self._collect_one(handle, c, model, start, want_lp)
+                  for c in clones[1:]),
+                return_exceptions=True)
+            for r in rest:
+                if isinstance(r, BaseException):
+                    raise r
+            results.extend(rest)
+        total_out = sum(det.completion_tokens for _, _, det, _ in results)
+        return results, total_out
+
     async def _token_stream(self, handle, pre, det, model, start_ts,
                             lp_sink=None):
         """Engine deltas → TextDeltas, with TTFT/ITL observation.
@@ -393,50 +464,46 @@ class HttpService:
         start = time.monotonic()
         self.metrics.requests_total.inc(labels={"model": body.model})
         self.metrics.requests_in_flight.add(1, labels={"model": body.model})
-        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
-        parts, reason = [], None
-        lp_sink = [] if pre.sampling.logprobs else None
+        want_lp = bool(pre.sampling.logprobs)
         try:
-            async for out in self._token_stream(handle, pre, det,
-                                                body.model, start,
-                                                lp_sink=lp_sink):
-                parts.append(out.text)
-                if out.finished:
-                    reason = out.finish_reason
+            results, total_out = await self._collect_choices(
+                handle, pre, body.n, body.model, start, want_lp)
         finally:
             self.metrics.requests_in_flight.add(-1, labels={"model": body.model})
-        self._observe_done(body.model, start, len(pre.token_ids),
-                           det.completion_tokens)
-        text = "".join(parts)
-        tool_calls = None
-        if body.tools:
-            # Tool-call extraction (reference postprocessor/tool_calling):
-            # only attempted when the client declared tools; parse failure
-            # leaves the message as plain content.
-            from dynamo_tpu.llm.postprocessor import parse_tool_calls
+        self._observe_done(body.model, start, len(pre.token_ids), total_out)
 
-            text, calls = parse_tool_calls(text, body.tool_call_parser)
-            if calls:
-                tool_calls = calls
-                reason = "tool_calls"
-        logprobs = None
-        if lp_sink:
-            logprobs = oai.ChatLogprobs(content=[
-                oai.ChatLogprobEntry(token=handle.tokenizer.decode([t]),
-                                     logprob=lp)
-                for t, lp in lp_sink])
-        resp = oai.ChatCompletionResponse(
-            id=rid, model=body.model,
-            choices=[oai.ChatChoice(
+        choices = []
+        for i, (text, reason, det, lp_sink) in enumerate(results):
+            tool_calls = None
+            if body.tools:
+                # Tool-call extraction (reference postprocessor/
+                # tool_calling): only attempted when the client declared
+                # tools; parse failure leaves plain content.
+                from dynamo_tpu.llm.postprocessor import parse_tool_calls
+
+                text, calls = parse_tool_calls(text, body.tool_call_parser)
+                if calls:
+                    tool_calls = calls
+                    reason = "tool_calls"
+            logprobs = None
+            if lp_sink:
+                logprobs = oai.ChatLogprobs(content=[
+                    oai.ChatLogprobEntry(
+                        token=handle.tokenizer.decode([t]), logprob=lp)
+                    for t, lp in lp_sink])
+            choices.append(oai.ChatChoice(
+                index=i,
                 message=oai.ChatMessage(role="assistant",
                                         content=text or None,
                                         tool_calls=tool_calls),
                 finish_reason=reason,
-                logprobs=logprobs)],
+                logprobs=logprobs))
+        resp = oai.ChatCompletionResponse(
+            id=rid, model=body.model, choices=choices,
             usage=oai.Usage(
                 prompt_tokens=len(pre.token_ids),
-                completion_tokens=det.completion_tokens,
-                total_tokens=len(pre.token_ids) + det.completion_tokens))
+                completion_tokens=total_out,
+                total_tokens=len(pre.token_ids) + total_out))
         return web.json_response(resp.model_dump(exclude_none=True))
 
     async def _stream_chat(self, request, handle, body, pre, rid):

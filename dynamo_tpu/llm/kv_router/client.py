@@ -205,6 +205,11 @@ class KvRoutedEngineClient:
 
         return await RemoteEngineClient(self.client).embed(token_lists)
 
+    async def clear_kv_blocks(self) -> int:
+        from dynamo_tpu.llm.discovery import RemoteEngineClient
+
+        return await RemoteEngineClient(self.client).clear_kv_blocks()
+
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:

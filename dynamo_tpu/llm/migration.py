@@ -38,6 +38,9 @@ class MigrationClient:
     async def embed(self, token_lists):
         return await self.inner.embed(token_lists)
 
+    async def clear_kv_blocks(self) -> int:
+        return await self.inner.clear_kv_blocks()
+
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:

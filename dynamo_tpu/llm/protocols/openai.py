@@ -92,9 +92,9 @@ class SamplingFields(BaseModel):
 
     @field_validator("n")
     @classmethod
-    def _n_is_one(cls, v):
-        if v != 1:
-            raise ValueError("n > 1 is not supported")
+    def _n_sane(cls, v):
+        if v < 1 or v > 8:
+            raise ValueError("n must be in [1, 8]")
         return v
 
     def stop_list(self) -> List[str]:

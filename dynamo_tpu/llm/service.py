@@ -48,6 +48,9 @@ class LocalEngineClient:
         /v1/embeddings engine surface)."""
         return await self._engine.embed(token_lists)
 
+    async def clear_kv_blocks(self) -> int:
+        return await self._engine.clear_kv_blocks()
+
 
 @dataclass
 class ModelHandle:

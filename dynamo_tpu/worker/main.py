@@ -144,11 +144,15 @@ async def run(args) -> None:
     if transfer_engine is not None:
         from dynamo_tpu.llm.block_manager.transfer import (
             KV_BLOCKS_ENDPOINT, make_kv_blocks_handler)
-        from dynamo_tpu.llm.discovery import EMBED_ENDPOINT, embed_wire_handler
+        from dynamo_tpu.llm.discovery import (
+            CLEAR_KV_ENDPOINT, EMBED_ENDPOINT, clear_kv_wire_handler,
+            embed_wire_handler)
 
         runtime.rpc.register(KV_BLOCKS_ENDPOINT,
                              make_kv_blocks_handler(transfer_engine))
         runtime.rpc.register(EMBED_ENDPOINT, embed_wire_handler(engine))
+        runtime.rpc.register(CLEAR_KV_ENDPOINT,
+                             clear_kv_wire_handler(engine))
 
     disagg_client = None
     prefill_task = None

@@ -521,3 +521,46 @@ def test_responses_structured_parts_and_status():
             await engine.stop()
 
     _run(main())
+
+
+def test_n_greater_than_one_and_clear_kv_blocks():
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                # n=3 greedy → three identical choices with indexes 0..2.
+                async with s.post(f"{base}/v1/completions", json={
+                        "model": "tiny", "prompt": "hello",
+                        "temperature": 0.0, "max_tokens": 3, "n": 3}) as r:
+                    assert r.status == 200
+                    data = await r.json()
+                assert [c["index"] for c in data["choices"]] == [0, 1, 2]
+                texts = [c["text"] for c in data["choices"]]
+                assert texts[0] == texts[1] == texts[2]  # greedy
+                assert data["usage"]["completion_tokens"] == 9
+                # n>1 streaming is rejected clearly.
+                async with s.post(f"{base}/v1/completions", json={
+                        "model": "tiny", "prompt": "x", "n": 2,
+                        "stream": True}) as r:
+                    assert r.status == 400
+                # Prime the prefix cache, then flush it via the admin route.
+                async with s.post(f"{base}/v1/completions", json={
+                        "model": "tiny", "prompt": "b" * 40,
+                        "max_tokens": 2}) as r:
+                    assert r.status == 200
+                async with s.post(f"{base}/clear_kv_blocks") as r:
+                    assert r.status == 200
+                    flushed = await r.json()
+                assert flushed["tiny"]["status"] == "ok"
+                assert flushed["tiny"]["cleared"] > 0
+                # Flushing again: nothing left.
+                async with s.post(f"{base}/clear_kv_blocks") as r:
+                    assert (await r.json())["tiny"]["cleared"] == 0
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
