@@ -57,6 +57,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
     KvCacheEvent,
     KvCacheEventData,
     KvStats,
+    SpecDecodeStats,
     WorkerStats,
 )
 from dynamo_tpu.models.config import ModelConfig
@@ -120,6 +121,15 @@ class EngineConfig:
     # in-line and the round-trip swallowed 98% of serving wall-clock.
     decode_window: int = 8
     window_pipeline_depth: int = 8
+    # Speculative decoding via prompt-lookup drafts (PLD / n-gram): when
+    # > 0, greedy decode steps propose `speculative_tokens` continuation
+    # tokens from the sequence's own history and verify them in ONE
+    # device step (reference surface: SpecDecodeStats the delegated
+    # engines publish).  Engages only for all-greedy batches on the
+    # single-chip path; repetitive text (code, extraction, RAG quotes)
+    # accepts multiple tokens per step.
+    speculative_tokens: int = 0
+    speculative_ngram: int = 3
 
 
 class EngineCore:
@@ -235,6 +245,8 @@ class EngineCore:
             worker_stats=WorkerStats(
                 request_total_slots=config.scheduler.max_seqs),
             kv_stats=KvStats(kv_total_blocks=config.num_blocks - 1),
+            spec_decode_stats=(SpecDecodeStats()
+                               if config.speculative_tokens > 0 else None),
         )
 
     # -- request lifecycle ------------------------------------------------
@@ -307,17 +319,133 @@ class EngineCore:
             if plan.prefill:
                 deltas.extend(self._run_prefill_batch(plan.prefill))
             if plan.decode:
-                deltas.extend(self._run_decode(plan.decode))
+                d = (self._run_decode_spec(plan.decode)
+                     if self._spec_eligible(plan) else None)
+                if d is None:
+                    d = self._run_decode(plan.decode)
+                deltas.extend(d)
 
         self._collect_dead(deltas)
         self.step_count += 1
         self._refresh_metrics()
         return deltas
 
+    # -- speculative decoding (prompt-lookup drafts) -----------------------
+
+    @staticmethod
+    def _draft_lookup(hist: List[int], ngram: int, k: int) -> List[int]:
+        """Prompt-lookup draft: find the most recent PRIOR occurrence of
+        the trailing `ngram` and propose the k tokens that followed it.
+        Empty when history is short or the n-gram never repeats."""
+        n = len(hist)
+        if n <= ngram:
+            return []
+        tail = hist[-ngram:]
+        # Scan right-to-left over prior positions (recency wins).
+        for start in range(n - ngram - 1, -1, -1):
+            if hist[start:start + ngram] == tail:
+                cont = hist[start + ngram:start + ngram + k]
+                if cont:
+                    return list(cont)
+        return []
+
+    def _spec_eligible(self, plan) -> bool:
+        # logprobs requests take the plain path: the spec accept loop
+        # doesn't thread per-token logprobs (the API contract must not
+        # change with a server-side perf flag).
+        return (self.config.speculative_tokens > 0
+                and self.mesh is None
+                and plan.decode is not None
+                and plan.prefill is None
+                and not self.scheduler.waiting
+                and all(r.sampling.temperature <= 0
+                        and not r.sampling.logprobs
+                        for r in plan.decode.requests))
+
+    def _run_decode_spec(self, work: DecodeWork) -> Optional[List[TokenDelta]]:
+        """One speculative step: feed [last_token, draft_0..draft_{k-1}]
+        as a T=k+1 chunk, get logits at every position, and greedily
+        accept the longest draft prefix the model agrees with — up to
+        k+1 tokens per device step (the +1 is the model's own token at
+        the first disagreement, which costs nothing extra).
+
+        Rejected positions leave junk KV in their slots; that is safe by
+        the same discipline as window overshoot: a future token at
+        position p REWRITES slot p before anything attends to it, and
+        context gathers mask positions >= seq_len.
+
+        Returns None when capacity can't cover the lookahead (caller
+        falls back to the plain path, which preempts properly)."""
+        K = self.config.speculative_tokens
+        T = K + 1
+        reqs = work.requests
+        bucket = work.bucket
+
+        drafts = []
+        real = []  # rows with an actual lookup hit (stats + fallback)
+        for req in reqs:
+            if not self.scheduler.ensure_capacity(req, req.context_len + T):
+                return None
+            hist = req.prompt_tokens[: req.prefilled] + req.output_tokens
+            d = self._draft_lookup(hist, self.config.speculative_ngram, K)
+            real.append(bool(d))
+            d = (d + [0] * K)[:K]
+            drafts.append(d)
+        if not any(real):
+            # Nothing to verify: the (K+1)-wide step would cost a full
+            # all-positions-logits forward to emit ~1 token per row.
+            return None
+
+        bs = self.block_size
+        width = self.scheduler.config.bucket_for_pages(
+            max((r.context_len + T + bs - 1) // bs for r in reqs))
+        tokens = np.zeros((bucket, T), np.int32)
+        positions = np.full((bucket, T), self._pad_position, np.int32)
+        seq_lens = np.zeros((bucket,), np.int32)
+        bts = np.zeros((bucket, width), np.int32)
+        for i, req in enumerate(reqs):
+            ctx = req.context_len
+            last = (req.output_tokens[-1] if req.output_tokens
+                    else req.prompt_tokens[-1])
+            tokens[i] = [last] + drafts[i]
+            positions[i] = np.arange(ctx - 1, ctx - 1 + T)
+            seq_lens[i] = ctx + K  # every fed token's KV is written
+            n = min(len(req.pages), width)
+            bts[i, :n] = req.pages[:n]
+
+        # sample_positions=None → logits at EVERY chunk position [B,T,V].
+        logits, self.cache = self._run_step(
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(seq_lens), jnp.asarray(bts), None)
+        argmax = np.asarray(jax.device_get(
+            jnp.argmax(logits, axis=-1))).astype(np.int32)  # [bucket, T]
+
+        deltas: List[TokenDelta] = []
+        stats = self.metrics.spec_decode_stats
+        for i, req in enumerate(reqs):
+            accepted = [int(argmax[i, 0])]       # the model's own token
+            for j in range(K):
+                if drafts[i][j] != accepted[-1]:
+                    break  # draft diverged from what the model just chose
+                accepted.append(int(argmax[i, j + 1]))
+            if real[i]:
+                # Padded empty drafts don't skew the acceptance-rate
+                # telemetry consumers use to judge whether PLD pays off.
+                stats.num_drafts += K
+                stats.num_accepted_tokens += len(accepted) - 1
+            for tok in accepted:
+                if req.request_id not in self._requests:
+                    break  # finished mid-burst (stop token / max_tokens)
+                self._publish_completed_blocks(req)
+                deltas.append(self._append_token(req, tok))
+        return deltas
+
     def _window_eligible(self, plan) -> bool:
         # MoE models take the single-step path: the window's fori_loop
         # doesn't thread the expert-load aux (telemetry would go dark).
+        # Speculative decoding (when configured) supersedes windows.
         if not (self.config.decode_window > 1
+                and self.config.speculative_tokens == 0
                 and self.mesh is None
                 and not self._moe
                 and plan.decode is not None

@@ -169,6 +169,7 @@ class RemoteEngineClient:
             if addr:
                 addresses.append(addr)
         total = 0
+        unreachable = []
         for address in addresses:
             rpc = runtime.client_for(address)
             try:
@@ -178,6 +179,14 @@ class RemoteEngineClient:
                 continue  # endpoint absent on this worker (e.g. mocker)
             except ConnectionError:
                 await runtime.evict_client(address)
+                unreachable.append(address)
+        if unreachable:
+            # A partial flush must be loud: the operator flushing before a
+            # benchmark (or after a privacy incident) needs to know which
+            # workers kept their warm caches.
+            raise ConnectionError(
+                f"flushed {total} blocks but {len(unreachable)} instances "
+                f"were unreachable: {', '.join(unreachable)}")
         return total
 
     async def embed(self, token_lists):

@@ -416,8 +416,12 @@ class HttpService:
         results = [await self._collect_one(handle, clones[0], model, start,
                                            want_lp)]
         if n > 1:
+            # Siblings start NOW: measuring their TTFT against the
+            # request's original start would fold choice 0's whole
+            # generation time into the histogram.
+            sib_start = time.monotonic()
             rest = await asyncio.gather(
-                *(self._collect_one(handle, c, model, start, want_lp)
+                *(self._collect_one(handle, c, model, sib_start, want_lp)
                   for c in clones[1:]),
                 return_exceptions=True)
             for r in rest:
