@@ -130,6 +130,14 @@ class EngineConfig:
     # accepts multiple tokens per step.
     speculative_tokens: int = 0
     speculative_ngram: int = 3
+    # Sequence-parallel ring prefill (mesh with sp > 1): full-prompt
+    # prefills of at least this many tokens route through the ICI ring
+    # (ops/ring_attention.py) instead of the chunked gather path — the
+    # long-context serving path (SURVEY §2.5 SP row).
+    sp_prefill_threshold: int = 256
+    # Pipeline parallelism (mesh with pp > 1): GPipe microbatch count for
+    # the stage-rotated step (parallel/pipeline.py).
+    pp_microbatches: int = 2
 
 
 class EngineCore:
@@ -154,7 +162,44 @@ class EngineCore:
         if params is None:
             params = init_params(cfg, jax.random.key(config.seed))
         self._moe = cfg.is_moe
-        if self.mesh is not None:
+        # Auto pallas: on for TPU, except under a dp_attention mesh (its
+        # slot-sharded KV breaks the kernel's global slot indexing — an
+        # EXPLICIT use_pallas_decode=True there is rejected loudly by
+        # make_sharded_step rather than silently downgraded) or when the
+        # per-shard cache feature width can't satisfy Mosaic's DMA tiling
+        # (F % 128, block % 8 — small test models fall back to gather).
+        pallas = config.use_pallas_decode
+        if pallas is None:
+            tp = (self.mesh.shape["tp"] if self.mesh is not None else 1)
+            feat = cfg.num_kv_heads * cfg.head_dim // max(tp, 1)
+            pallas = (jax.default_backend() == "tpu"
+                      and feat % 128 == 0
+                      and self.block_size % 8 == 0
+                      and not (config.dp_attention
+                               and config.mesh is not None))
+        self._use_pallas = pallas
+        self._pp = (self.mesh is not None
+                    and self.mesh.shape.get("pp", 1) > 1)
+        self._sp_step = None
+        self.sp_prefill_count = 0  # served prefills that ran the ring path
+        if self._pp:
+            # Pipeline serving: stage-rotated GPipe step over the pp axis.
+            # v1: stacked cache layout — whole-block extract/inject (and
+            # so the tiered prefix cache) aren't wired for it yet.
+            from dynamo_tpu.parallel.pipeline import (
+                init_pp_cache, make_pp_step, pp_cache_pspecs,
+                pp_param_pspecs, stack_layer_params)
+
+            if config.enable_prefix_cache:
+                logger.warning("pp serving v1 has no tiered prefix cache; "
+                               "running with the plain allocator")
+            params = shard_pytree(stack_layer_params(params),
+                                  pp_param_pspecs(cfg), self.mesh)
+            self._step = make_pp_step(cfg, self.block_size, self.mesh,
+                                      config.pp_microbatches)
+            cache = shard_pytree(init_pp_cache(self.cache_cfg),
+                                 pp_cache_pspecs(), self.mesh)
+        elif self.mesh is not None:
             from dynamo_tpu.parallel.sharding import resolve_moe_mode
 
             moe_mode = resolve_moe_mode(cfg, self.mesh)
@@ -166,22 +211,27 @@ class EngineCore:
             self._step = make_sharded_step(
                 cfg, self.block_size, self.mesh, moe_mode,
                 with_expert_load=self._moe,
-                dp_attention=config.dp_attention)
+                dp_attention=config.dp_attention,
+                use_pallas_decode=pallas)
             cache = shard_pytree(
                 kvc.init_cache(self.cache_cfg),
                 cache_pspecs(cfg.num_layers,
                              dp_attention=config.dp_attention),
                 self.mesh)
+            if (self.mesh.shape.get("sp", 1) > 1 and not cfg.is_moe
+                    and not config.dp_attention):
+                # (dp_attention shards the cache differently than the sp
+                # step's specs — the combination isn't wired.)
+                from dynamo_tpu.parallel.sharding import make_sp_prefill_step
+
+                self._sp_step = make_sp_prefill_step(
+                    cfg, self.block_size, self.mesh)
         else:
-            pallas = config.use_pallas_decode
-            if pallas is None:
-                pallas = jax.default_backend() == "tpu"
             self._step = jax.jit(
                 make_forward_step(cfg, self.block_size,
                                   use_pallas_decode=pallas,
                                   with_expert_load=self._moe),
                 donate_argnums=(1,))
-            self._use_pallas = pallas
             cache = kvc.init_cache(self.cache_cfg)
         # Cumulative per-expert assignment counts (MoE telemetry the
         # worker publishes; reference `base_handlers.py:40-62`).
@@ -190,6 +240,7 @@ class EngineCore:
         self._load_dev = None  # device-side accumulator (lazy sync)
         self._embed_step = None  # lazily compiled (embeddings route)
         self._window_fns: Dict[bool, Callable] = {}
+        self._window_state: Optional[Dict] = None  # device-resident rows
         self._inflight: List = []  # dispatched-unsynced decode windows
         # One thread: fetches are sequential anyway (window N-1 finishes
         # on device before window N), and ordering keeps _sync_one_window
@@ -204,9 +255,10 @@ class EngineCore:
         # it must actually be wired, not just exist); plain free list when
         # prefix caching is off.  The managed source owns residency truth,
         # so REMOVED events come from its eviction hook rather than from
-        # request finish.
-        self._managed_cache = config.enable_prefix_cache
-        if config.enable_prefix_cache:
+        # request finish.  (pp v1: stacked cache has no block extract —
+        # plain allocator, see above.)
+        self._managed_cache = config.enable_prefix_cache and not self._pp
+        if self._managed_cache:
             from dynamo_tpu.llm.block_manager.engine_source import (
                 ManagedBlockSource,
             )
@@ -234,6 +286,16 @@ class EngineCore:
         # runtime table width, so slots_for_positions resolves it to the
         # null block (tables are bucket-sliced — see bucket_for_pages).
         self._pad_position = sched_cfg.max_pages_per_seq * self.block_size
+        # Sharded batch axes demand divisibility: rows pad up to a
+        # multiple of dp (dp*tp under dp_attention, whose batch shards
+        # over both axes; the microbatch count under pp).
+        if self._pp:
+            self._row_mult = config.pp_microbatches
+        elif self.mesh is not None:
+            self._row_mult = self.mesh.shape["dp"] * (
+                self.mesh.shape["tp"] if config.dp_attention else 1)
+        else:
+            self._row_mult = 1
         self._requests: Dict[str, Request] = {}
         self._hash_seqs: Dict[str, TokenBlockSequence] = {}
         self._published_blocks: Dict[str, int] = {}  # req -> #blocks published
@@ -354,7 +416,7 @@ class EngineCore:
         # doesn't thread per-token logprobs (the API contract must not
         # change with a server-side perf flag).
         return (self.config.speculative_tokens > 0
-                and self.mesh is None
+                and not self._pp  # pp step has no all-positions logits
                 and plan.decode is not None
                 and plan.prefill is None
                 and not self.scheduler.waiting
@@ -379,7 +441,7 @@ class EngineCore:
         K = self.config.speculative_tokens
         T = K + 1
         reqs = work.requests
-        bucket = work.bucket
+        bucket = self._pad_rows(work.bucket)
 
         drafts = []
         real = []  # rows with an actual lookup hit (stats + fallback)
@@ -446,8 +508,8 @@ class EngineCore:
         # Speculative decoding (when configured) supersedes windows.
         if not (self.config.decode_window > 1
                 and self.config.speculative_tokens == 0
-                and self.mesh is None
                 and not self._moe
+                and not self._pp  # windows build their own non-pp step
                 and plan.decode is not None
                 and plan.prefill is None
                 and not self.scheduler.waiting):
@@ -492,6 +554,10 @@ class EngineCore:
 
     # -- internals --------------------------------------------------------
 
+    def _pad_rows(self, n: int) -> int:
+        m = self._row_mult
+        return -(-n // m) * m
+
     def _run_step(self, tokens, positions, seq_lens, bts, sample_pos):
         """One device step; accumulates the MoE expert-load aux (when
         present) ON DEVICE — a per-step device_get here would cost a
@@ -517,11 +583,23 @@ class EngineCore:
             self._load_dev = None
         return self.expert_load
 
+    def _sp_eligible(self, batch: PrefillBatch) -> bool:
+        """Ring-SP prefill handles FULL prompts (no prior cached context
+        is read — ops/ring_attention.py); route the batch through the
+        ring when every item is a whole prompt past the threshold."""
+        if self._sp_step is None:
+            return False
+        thr = self.config.sp_prefill_threshold
+        return all(
+            w.start == 0 and w.length == len(w.request.prompt_tokens)
+            and w.length >= thr
+            for w in batch.items)
+
     def _run_prefill_batch(self, batch: PrefillBatch) -> List[TokenDelta]:
         """One device call for ALL scheduled prefill chunks (ragged rows
         padded to the chunk bucket; pad rows/tails write to the null block).
         Completion rows sample their first output token (TTFT)."""
-        R, T, P = batch.rows, batch.chunk, batch.pages
+        R, T, P = self._pad_rows(batch.rows), batch.chunk, batch.pages
         tokens = np.zeros((R, T), np.int32)
         positions = np.full((R, T), self._pad_position, np.int32)
         seq_lens = np.zeros((R,), np.int32)
@@ -539,10 +617,22 @@ class EngineCore:
             n = min(len(req.pages), P)
             bts[i, :n] = req.pages[:n]
 
-        logits, self.cache = self._run_step(
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(seq_lens), jnp.asarray(bts),
-            jnp.asarray(sample_pos))
+        if self._sp_eligible(batch):
+            # Served long-context path: whole-prompt prefill over the ICI
+            # ring, T sharded over sp (VERDICT r3 next-4 — the ring was
+            # test-only before; now EngineCore routes real requests
+            # through it).
+            self.sp_prefill_count += len(batch.items)
+            logits, self.cache = self._sp_step(
+                self.params, self.cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(seq_lens), jnp.asarray(bts),
+                jnp.asarray(sample_pos))
+        else:
+            logits, self.cache = self._run_step(
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(seq_lens), jnp.asarray(bts),
+                jnp.asarray(sample_pos))
 
         deltas: List[TokenDelta] = []
         done_rows: List[int] = []
@@ -565,7 +655,7 @@ class EngineCore:
 
     def _run_decode(self, work: DecodeWork) -> List[TokenDelta]:
         reqs = work.requests
-        bucket = work.bucket
+        bucket = self._pad_rows(work.bucket)
 
         tokens = np.zeros((bucket, 1), np.int32)
         positions = np.full((bucket, 1), self._pad_position, np.int32)
@@ -616,15 +706,25 @@ class EngineCore:
     def _window_fn(self, greedy_only: bool):
         fn = self._window_fns.get(greedy_only)
         if fn is None:
-            from dynamo_tpu.models.llama import make_decode_window
+            if self.mesh is not None:
+                from dynamo_tpu.parallel.sharding import make_sharded_window
 
-            fn = jax.jit(
-                make_decode_window(
-                    self.config.model, self.block_size,
+                fn = make_sharded_window(
+                    self.config.model, self.block_size, self.mesh,
                     self.config.decode_window,
+                    greedy_only=greedy_only,
                     use_pallas_decode=self._use_pallas,
-                    greedy_only=greedy_only),
-                donate_argnums=(1,))
+                    dp_attention=self.config.dp_attention)
+            else:
+                from dynamo_tpu.models.llama import make_decode_window
+
+                fn = jax.jit(
+                    make_decode_window(
+                        self.config.model, self.block_size,
+                        self.config.decode_window,
+                        use_pallas_decode=self._use_pallas,
+                        greedy_only=greedy_only),
+                    donate_argnums=(1,))
             self._window_fns[greedy_only] = fn
         return fn
 
@@ -632,10 +732,17 @@ class EngineCore:
         """Dispatch one fused K-token decode window (no host sync); sync
         and emit the window from pipeline_depth dispatches ago.  Returns
         None if page capacity can't cover the lookahead (caller drains and
-        falls back to the single-step path)."""
+        falls back to the single-step path).
+
+        Steady state is ZERO host→device uploads: the window function
+        returns advanced positions/seq_lens/offsets as device arrays, and
+        the per-row sampling arrays are reuploaded only when the request
+        set (or a row's sampling/pages) changes — on a tunneled chip each
+        small-array upload is a blocking RPC, and r4 measured ~300 ms of
+        pure upload latency per dispatch before this cache existed."""
         K = self.config.decode_window
         reqs = work.requests
-        bucket = work.bucket
+        bucket = self._pad_rows(work.bucket)
         lag = len(self._inflight)  # windows dispatched but unsynced
 
         # Shadow context: host bookkeeping lags the device by lag*K tokens.
@@ -649,6 +756,65 @@ class EngineCore:
         bs = self.block_size
         width = self.scheduler.config.bucket_for_pages(
             max((s + K + bs - 1) // bs for s in shadows))
+        greedy_only = all(r.sampling.temperature <= 0 for r in reqs)
+        sig = (tuple(r.request_id for r in reqs), bucket, width, greedy_only,
+               tuple((r.sampling.temperature, r.sampling.top_k,
+                      r.sampling.top_p, r.sampling.seed) for r in reqs))
+        want_pos = np.asarray([s - 1 for s in shadows], np.int32)
+        st = self._window_state
+        if (st is None or st["sig"] != sig
+                or not np.array_equal(st["pos_host"][: len(reqs)],
+                                      want_pos)):
+            st = self._build_window_state(reqs, bucket, width, shadows,
+                                          lag, K, greedy_only, sig)
+        pages_sig = tuple(len(r.pages) for r in reqs)
+        if st["pages_sig"] != pages_sig:
+            bts = np.zeros((bucket, width), np.int32)
+            for i, req in enumerate(reqs):
+                n = min(len(req.pages), width)
+                bts[i, :n] = req.pages[:n]
+            st["bts"] = jnp.asarray(bts)
+            st["pages_sig"] = pages_sig
+        self._window_state = st
+
+        if lag:
+            last_tokens = self._inflight[-1]["out"][K - 1]  # device, no sync
+        else:
+            toks = np.zeros((bucket,), np.int32)
+            for i, req in enumerate(reqs):
+                toks[i] = (req.output_tokens[-1] if req.output_tokens
+                           else req.prompt_tokens[-1])
+            last_tokens = jnp.asarray(toks)
+
+        (self.cache, out, st["pos"], st["seq"], st["off"]) = \
+            self._window_fn(greedy_only)(
+                self.params, self.cache, last_tokens,
+                st["pos"], st["seq"], st["bts"], st["temp"], st["topk"],
+                st["topp"], st["keys"], st["off"])
+        st["pos_host"][: len(reqs)] += K
+        # Start the device→host copy NOW: copy_to_host_async enqueues the
+        # transfer without stalling the execution stream (a blocking
+        # per-window np.asarray measured ~75-100 ms of injected pipeline
+        # bubble on the tunneled chip), and the fetch thread's np.asarray
+        # then finds the bytes already crossing the wire.
+        try:
+            out.copy_to_host_async()
+        except Exception:
+            pass  # backend without async host copies: fetch still works
+        self._inflight.append({
+            "rids": [r.request_id for r in reqs],
+            "reqs": list(reqs),
+            "out": out,
+            "fetch": self._fetch_pool.submit(np.asarray, out),
+        })
+        if len(self._inflight) > self.config.window_pipeline_depth:
+            return self._sync_one_window()
+        return []
+
+    def _build_window_state(self, reqs, bucket, width, shadows, lag, K,
+                            greedy_only, sig) -> Dict:
+        """Upload the per-row window arrays (one-time per request-set
+        change; the window advances them on device afterwards)."""
         positions0 = np.full((bucket,), self._pad_position, np.int32)
         seq_lens0 = np.zeros((bucket,), np.int32)
         bts = np.zeros((bucket, width), np.int32)
@@ -666,44 +832,33 @@ class EngineCore:
             top_p[i] = req.sampling.top_p
             offsets[i] = (req.prior_output + len(req.output_tokens)
                           + lag * K)
-
-        if lag:
-            last_tokens = self._inflight[-1]["out"][K - 1]  # device, no sync
-        else:
-            toks = np.zeros((bucket,), np.int32)
-            for i, req in enumerate(reqs):
-                toks[i] = (req.output_tokens[-1] if req.output_tokens
-                           else req.prompt_tokens[-1])
-            last_tokens = jnp.asarray(toks)
-
-        greedy_only = all(r.sampling.temperature <= 0 for r in reqs)
         if greedy_only:
             base_keys = jax.random.split(jax.random.key(0), bucket)
         else:
+            # One base key per request-set build; per-token randomness
+            # comes from fold_in(base, offset) with offsets advancing on
+            # device, so seeded streams stay reproducible and unseeded
+            # rows never repeat a key.
             self._rng, sub = jax.random.split(self._rng)
             base_keys = jax.random.split(sub, bucket)
             for i, req in enumerate(reqs):
                 if req.sampling.seed is not None:
                     base_keys = base_keys.at[i].set(
                         jax.random.key(req.sampling.seed))
-
-        self.cache, out = self._window_fn(greedy_only)(
-            self.params, self.cache, last_tokens,
-            jnp.asarray(positions0), jnp.asarray(seq_lens0),
-            jnp.asarray(bts), jnp.asarray(temp), jnp.asarray(top_k),
-            jnp.asarray(top_p), base_keys, jnp.asarray(offsets))
-        self._inflight.append({
-            "rids": [r.request_id for r in reqs],
-            "reqs": list(reqs),
-            "out": out,
-            # Start the device→host copy NOW, off-thread; by the time this
-            # window is synced (pipeline_depth dispatches later) the bytes
-            # have already crossed the wire.
-            "fetch": self._fetch_pool.submit(np.asarray, out),
-        })
-        if len(self._inflight) > self.config.window_pipeline_depth:
-            return self._sync_one_window()
-        return []
+        pos_host = positions0.copy()
+        return {
+            "sig": sig,
+            "pages_sig": tuple(len(r.pages) for r in reqs),
+            "pos_host": pos_host,
+            "pos": jnp.asarray(positions0),
+            "seq": jnp.asarray(seq_lens0),
+            "bts": jnp.asarray(bts),
+            "temp": jnp.asarray(temp),
+            "topk": jnp.asarray(top_k),
+            "topp": jnp.asarray(top_p),
+            "keys": base_keys,
+            "off": jnp.asarray(offsets),
+        }
 
     def _sync_one_window(self) -> List[TokenDelta]:
         entry = self._inflight.pop(0)
@@ -836,46 +991,72 @@ class EngineCore:
         temporarily-allocated pages that are released afterward — the
         /v1/embeddings surface (reference `http/service/openai.rs:315`).
         Must run on the engine thread (InferenceEngine wraps it)."""
-        if self.mesh is not None:
-            raise NotImplementedError("embeddings on the sharded engine "
-                                      "path are not wired yet")
+        if self._pp:
+            raise ValueError("embeddings are not wired for the pp engine "
+                             "(pipeline stages have no return_hidden path)")
         if self._embed_step is None:
-            from dynamo_tpu.models.llama import make_forward_step as mfs
+            if self.mesh is not None:
+                from dynamo_tpu.parallel.sharding import (
+                    make_sharded_embed_step)
 
-            self._embed_step = jax.jit(
-                mfs(self.config.model, self.block_size,
-                    use_pallas_decode=False, return_hidden=True),
-                donate_argnums=(1,))
+                self._embed_step = make_sharded_embed_step(
+                    self.config.model, self.block_size, self.mesh,
+                    dp_attention=self.config.dp_attention)
+            else:
+                from dynamo_tpu.models.llama import make_forward_step as mfs
+
+                self._embed_step = jax.jit(
+                    mfs(self.config.model, self.block_size,
+                        use_pallas_decode=False, return_hidden=True),
+                    donate_argnums=(1,))
         sched = self.scheduler.config
+        for toks in token_lists:
+            if len(toks) == 0:
+                raise ValueError("empty embedding input")
+            if len(toks) > sched.max_prefill_chunk:
+                raise ValueError(
+                    f"embedding input of {len(toks)} tokens exceeds the "
+                    f"prefill chunk ceiling {sched.max_prefill_chunk}")
         out = np.zeros((len(token_lists), self.config.model.hidden_size),
                        np.float32)
-        for i, toks in enumerate(token_lists):
-            L = len(toks)
-            if L == 0:
-                raise ValueError("empty embedding input")
-            if L > sched.max_prefill_chunk:
-                raise ValueError(
-                    f"embedding input of {L} tokens exceeds the prefill "
-                    f"chunk ceiling {sched.max_prefill_chunk}")
-            T = sched.bucket_for_prefill(L)
-            pages_needed = (L + self.block_size - 1) // self.block_size
-            pages = self.allocator.allocate(pages_needed)
+        # Pack up to R prompts per device call — under a sharded mesh the
+        # row count must be a multiple of the batch divisor anyway, so
+        # fill those rows with real prompts instead of zero padding.
+        R = max(self._pad_rows(1), 1)
+        for start in range(0, len(token_lists), R):
+            group = token_lists[start: start + R]
+            T = sched.bucket_for_prefill(max(len(t) for t in group))
+            per_pages = [(len(t) + self.block_size - 1) // self.block_size
+                         for t in group]
+            width = sched.bucket_for_pages(max(per_pages))
+            # Allocate inside the guarded region: a partial-failure midway
+            # through the group must release what was already taken.
+            pages: List[List[int]] = []
             try:
-                tokens = np.zeros((1, T), np.int32)
-                tokens[0, :L] = toks
-                positions = np.full((1, T), self._pad_position, np.int32)
-                positions[0, :L] = np.arange(L)
-                width = sched.bucket_for_pages(pages_needed)
-                bt = np.zeros((1, width), np.int32)
-                bt[0, :pages_needed] = pages
+                for n in per_pages:
+                    pages.append(self.allocator.allocate(n))
+                tokens = np.zeros((R, T), np.int32)
+                positions = np.full((R, T), self._pad_position, np.int32)
+                bt = np.zeros((R, width), np.int32)
+                seq_lens = np.zeros((R,), np.int32)
+                sample = np.zeros((R,), np.int32)
+                for i, toks in enumerate(group):
+                    L = len(toks)
+                    tokens[i, :L] = toks
+                    positions[i, :L] = np.arange(L)
+                    bt[i, : per_pages[i]] = pages[i]
+                    seq_lens[i] = L
+                    sample[i] = L - 1
                 hidden, self.cache = self._embed_step(
                     self.params, self.cache,
                     jnp.asarray(tokens), jnp.asarray(positions),
-                    jnp.asarray([L], np.int32), jnp.asarray(bt),
-                    jnp.asarray([L - 1], np.int32))
-                out[i] = np.asarray(jax.device_get(hidden[0]))
+                    jnp.asarray(seq_lens), jnp.asarray(bt),
+                    jnp.asarray(sample))
+                out[start: start + len(group)] = np.asarray(
+                    jax.device_get(hidden[: len(group)]))
             finally:
-                self.allocator.release(pages)
+                for p in pages:
+                    self.allocator.release(p)
         return out
 
     # -- cross-worker KV transfer ------------------------------------------

@@ -6,17 +6,26 @@ around but does not implement; its block bookkeeping lives in
 page_size, inner_dim, dtype}).  On TPU the cache must be a *static-shape*
 array XLA can reason about, so:
 
-- storage is PER-LAYER arrays `[num_blocks * block_size, num_kv_heads,
-  head_dim]` for K and V — a flat "slot" axis rather than a blocked one,
+- storage is PER-LAYER arrays `[num_blocks * block_size, num_kv_heads *
+  head_dim]` for K and V — a flat "slot" axis by a flat "feature" axis,
   so both the scatter (write new tokens) and gather (read context) are
   single `take`/`scatter` ops with precomputed flat indices.  Layers are
-  separate buffers, NOT one stacked [L, S, H, D] array: each layer's
+  separate buffers, NOT one stacked [L, S, F] array: each layer's
   update is then an independent in-place scatter XLA can alias under
   donation and inside `fori_loop` carries, and the Pallas decode kernel
   reads the layer buffer directly in HBM.  (r2 stacked the layers; every
   layer update sliced + wrote back the whole array and every kernel call
   materialised its layer slice — the decode step ran ~15x over its HBM
   floor.);
+- the feature axis is FLAT (Hkv * head_dim, head-major) rather than a
+  [Hkv, D] pair: with head_dim 64, a 3D [S, 8, 64] buffer tiles as
+  T(8,128) on its two minor dims, and XLA's layout assignment stores it
+  transposed ({0,2,1}) to dodge the 64→128 lane padding — then inserts
+  TWO full-buffer relayout copies per layer per decode step to feed the
+  row-major scatter and the Pallas kernel (r3 measured ~4.3 GB/token of
+  pure relayout traffic, 3/4 of the whole step).  A 2D [S, F=512] buffer
+  has one natural layout; scatter, kernel, and carry all agree, and the
+  relayouts vanish;
 - block 0 is reserved as the *null block*: padded block-table entries point
   at it, and its contents are never read unmasked;
 - sharding: `num_kv_heads` over the `tp` mesh axis (head-sharded cache means
@@ -57,6 +66,11 @@ class KvCacheConfig:
         return self.num_blocks * self.block_size
 
     @property
+    def feature_dim(self) -> int:
+        """Flat per-token K (or V) width: num_kv_heads * head_dim."""
+        return self.num_kv_heads * self.head_dim
+
+    @property
     def bytes_per_block(self) -> int:
         """K+V bytes for one block across all layers (the unit the block
         manager and router count in)."""
@@ -84,10 +98,10 @@ class KvCacheConfig:
 
 
 def init_cache(cfg: KvCacheConfig) -> dict:
-    """Allocate the cache pytree: {'k': [L x [S, H, D]], 'v': [L x [S, H, D]]}
-    — per-layer buffers (see module docstring for why not one stacked
-    array)."""
-    shape = (cfg.num_slots, cfg.num_kv_heads, cfg.head_dim)
+    """Allocate the cache pytree: {'k': [L x [S, F]], 'v': [L x [S, F]]}
+    — per-layer 2D buffers, F = num_kv_heads * head_dim head-major (see
+    module docstring for why flat, and why not one stacked array)."""
+    shape = (cfg.num_slots, cfg.feature_dim)
     return {
         "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
         "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
@@ -118,10 +132,10 @@ def slots_for_positions(
 
 
 def write_kv(
-    cache_layer_k: jax.Array,  # [S, H, D]
+    cache_layer_k: jax.Array,  # [S, F]
     cache_layer_v: jax.Array,
     slots: jax.Array,          # [N] flat slot ids (may repeat NULL for pad)
-    k: jax.Array,              # [N, H, D]
+    k: jax.Array,              # [N, F] flat rows
     v: jax.Array,
 ) -> Tuple[jax.Array, jax.Array]:
     """Scatter new K/V rows into one layer's slot axis.
@@ -129,20 +143,27 @@ def write_kv(
     Padding tokens should carry slot 0 (null block) so their writes land in
     the reserved junk block.  `mode="drop"` guards out-of-range indices.
     """
-    k_new = cache_layer_k.at[slots].set(k.astype(cache_layer_k.dtype), mode="drop")
-    v_new = cache_layer_v.at[slots].set(v.astype(cache_layer_v.dtype), mode="drop")
+    k_new = cache_layer_k.at[slots].set(k.astype(cache_layer_k.dtype),
+                                        mode="drop")
+    v_new = cache_layer_v.at[slots].set(v.astype(cache_layer_v.dtype),
+                                        mode="drop")
     return k_new, v_new
 
 
 def gather_kv(
-    cache_layer_k: jax.Array,  # [S, H, D]
+    cache_layer_k: jax.Array,  # [S, F]
     cache_layer_v: jax.Array,
     slots: jax.Array,          # [B, C] flat slot ids for each context position
+    num_kv_heads: int,
 ) -> Tuple[jax.Array, jax.Array]:
     """Gather per-sequence context K/V: returns [B, C, H, D] pairs."""
+    B, C = slots.shape
+    F = cache_layer_k.shape[-1]
+    D = F // num_kv_heads
     k = jnp.take(cache_layer_k, slots, axis=0, mode="clip")
     v = jnp.take(cache_layer_v, slots, axis=0, mode="clip")
-    return k, v
+    return (k.reshape(B, C, num_kv_heads, D),
+            v.reshape(B, C, num_kv_heads, D))
 
 
 def make_block_ops(block_size: int):
@@ -155,7 +176,7 @@ def make_block_ops(block_size: int):
     compiled program serves every page.
 
     Returns (extract, inject):
-      extract(cache, page) -> [2, L, block_size, Hkv, D] (K stacked on V)
+      extract(cache, page) -> [2, L, block_size, F] (K stacked on V)
       inject(cache, page, data) -> cache' (donated, in-place on device)
     """
 

@@ -136,9 +136,10 @@ def _attention_block(
     kv_positions,            # [B, C], or None
     block_tables: jax.Array, # [B, P]
     block_size: int,
-    k_cache: jax.Array,      # [S, Hkv, D] this layer's cache buffer
+    k_cache: jax.Array,      # [S, F] this layer's cache buffer (flat feat)
     v_cache: jax.Array,
     sp_mesh=None,            # mesh → ring attention over its sp axis
+    pallas_mesh=None,        # mesh → shard_map the decode kernel (dp, tp)
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (attn_out, k_cache', v_cache').  The layer cache buffers are
     standalone arrays (not slices of a stacked cache) so the scatter in
@@ -155,8 +156,8 @@ def _attention_block(
         k_cache,
         v_cache,
         write_slots,
-        k.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
-        v.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
+        k.reshape(B * T, cfg.kv_size),
+        v.reshape(B * T, cfg.kv_size),
     )
 
     if sp_mesh is not None:
@@ -187,13 +188,32 @@ def _attention_block(
         # materialised context gather (ops/pallas/paged_attention.py).
         from dynamo_tpu.ops.pallas import paged_decode_attention
 
-        out = paged_decode_attention(
-            q[:, 0], k_layer, v_layer, block_tables, seq_lens,
-            block_size=block_size,
-            interpret=jax.default_backend() != "tpu",
-        )[:, None]
+        interp = jax.default_backend() != "tpu"
+        if pallas_mesh is not None:
+            # Sharded serving: GSPMD can't partition a custom call, so
+            # the kernel runs under shard_map — heads over tp (each shard
+            # sees its [S, F/tp] cache slice, a self-consistent smaller
+            # GQA geometry), batch over dp.
+            from jax.sharding import PartitionSpec as P
+
+            out = jax.shard_map(
+                lambda qs, ks, vs, bts, sls: paged_decode_attention(
+                    qs, ks, vs, bts, sls, block_size=block_size,
+                    interpret=interp),
+                mesh=pallas_mesh,
+                in_specs=(P("dp", "tp", None), P(None, "tp"), P(None, "tp"),
+                          P("dp", None), P("dp")),
+                out_specs=P("dp", "tp", None),
+                check_vma=False,
+            )(q[:, 0], k_layer, v_layer, block_tables, seq_lens)[:, None]
+        else:
+            out = paged_decode_attention(
+                q[:, 0], k_layer, v_layer, block_tables, seq_lens,
+                block_size=block_size, interpret=interp,
+            )[:, None]
     else:
-        k_ctx, v_ctx = kvc.gather_kv(k_layer, v_layer, ctx_slots)
+        k_ctx, v_ctx = kvc.gather_kv(k_layer, v_layer, ctx_slots,
+                                     cfg.num_kv_heads)
         out = paged_attention(q, k_ctx, v_ctx, positions, kv_positions,
                               seq_lens)
     out = out.reshape(B, T, cfg.q_size) @ p_attn["wo"]
@@ -241,7 +261,8 @@ def _moe_block(cfg: ModelConfig, p: Params, x: jax.Array,
 
 def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
                        use_pallas_decode: bool = False,
-                       greedy_only: bool = False):
+                       greedy_only: bool = False,
+                       mesh=None):
     """K decode steps in ONE device dispatch, tokens fed back on-device.
 
     The per-token host loop costs a host↔device round-trip per step — the
@@ -260,22 +281,35 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
 
     Returns run(params, cache, last_tokens[B], positions0[B], seq_lens0[B],
                 block_tables[B,P], temp[B], top_k[B], top_p[B],
-                base_keys[B], key_offsets[B]) -> (cache, tokens[K, B]).
+                base_keys[B], key_offsets[B])
+        -> (cache, tokens[K, B], positions0+K, seq_lens0+K, key_offsets+K).
+
+    The advanced positions/seq_lens/offsets come back as DEVICE arrays so
+    the engine can feed the next window with zero host→device transfers —
+    on a tunneled chip each small-array upload is a blocking RPC, and r4
+    measured ~300 ms/dispatch of pure upload latency before this existed.
     """
     from dynamo_tpu.engine.sampling import sample
 
-    step = make_forward_step(cfg, block_size, use_pallas_decode)
+    step = make_forward_step(cfg, block_size, use_pallas_decode,
+                             mesh=mesh)
 
     def run(params, cache, last_tokens, positions0, seq_lens0, block_tables,
             temp, top_k, top_p, base_keys, key_offsets):
         B = last_tokens.shape[0]
         zero_pos = jnp.zeros((B,), jnp.int32)
+        # Padding rows (seq_lens0 == 0) must stay dead across device-side
+        # advances: their seq_lens pin at 0 (attention loop skipped, no
+        # unbounded block-table indices) and their positions pin at the
+        # null-resolving pad position.
+        live = seq_lens0 > 0
 
         def body(i, carry):
             cache, toks, out = carry
+            adv = jnp.where(live, i, 0)
             logits, cache = step(
                 params, cache, toks[:, None],
-                (positions0 + i)[:, None], seq_lens0 + i,
+                (positions0 + adv)[:, None], seq_lens0 + adv,
                 block_tables, zero_pos)
             if greedy_only:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -288,7 +322,9 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
         out0 = jnp.zeros((window, B), jnp.int32)
         cache, _, out = jax.lax.fori_loop(
             0, window, body, (cache, last_tokens, out0))
-        return cache, out
+        adv = jnp.where(live, window, 0)
+        return (cache, out, positions0 + adv, seq_lens0 + adv,
+                key_offsets + window)
 
     return run
 
@@ -364,6 +400,8 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                 block_tables, block_size,
                 k_layers[i], v_layers[i],
                 sp_mesh=mesh if (sp_ring and T > 1) else None,
+                pallas_mesh=(mesh if (use_pallas_decode and T == 1
+                                      and mesh is not None) else None),
             )
             x = x + attn_out
             h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps)

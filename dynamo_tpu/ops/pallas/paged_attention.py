@@ -16,18 +16,28 @@ is the TPU-native equivalent of that layer of the stack.
 
 Layout strategy: Mosaic DMA wants 128-aligned trailing dims, and head_dim
 is 64 on small Llamas — so the kernel sees the cache as 2D
-`[S, F = Hkv * head_dim]` (a free reshape of the engine's [S, Hkv, D]
-layout) and GQA head selection is algebraic instead of indexed:
-
-- queries are pre-scattered (in XLA, outside the kernel) into zero-padded
-  rows `qp[B, Hq, F]` where row h occupies only its KV head's column band,
-  so `qp @ k_page.T` contracts to exactly the right per-head scores;
-- `probs @ v_page` produces [Hq, F] whose band h is the right output;
-  the band extraction is again XLA outside the kernel.
+`[S, F = Hkv * head_dim]` (the engine's native storage layout — see
+kv_cache.init_cache) and GQA head selection is algebraic instead of
+indexed: each query row h is masked into its KV head's column band, so
+`qp @ k_tile.T` contracts to exactly the right per-head scores, and the
+band of `probs @ v_tile` is head h's output.  Banding and band-extraction
+happen INSIDE the kernel on VMEM-resident tiles (v3; earlier revisions
+did them in XLA, costing an extra [B, Hq, F] materialisation per layer
+per step).
 
 The padded matmuls do Hkv x the minimal attention FLOPs, but decode
 attention is HBM-bandwidth-bound, and bytes moved is what the kernel
-minimises; the MXU eats the extra zeros for free at these sizes.
+minimises; the MXU eats the extra zeros nearly for free at these sizes.
+
+Perf structure (v3):
+- bf16 x bf16 MXU passes with f32 accumulation (f32 operands cost ~4x
+  the passes for accuracy the f32 accumulator already provides);
+- `pair` pages per tile: one MXU pass over a 128-token tile costs barely
+  more than over a 64-token page (the F-contraction dominates);
+- double-buffered tile DMA pipeline within a sequence, PLUS cross-program
+  prefetch: a sequence's last-tile compute overlaps the first-tile fetch
+  of the NEXT sequence (slot 2), so the 64 grid-program boundaries don't
+  each drain the pipeline.
 """
 
 from __future__ import annotations
@@ -41,89 +51,139 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _decode_kernel(block_size: int, soft_cap: Optional[float],
+def _decode_kernel(block_size: int, pair: int, n_kv: int,
+                   soft_cap: Optional[float],
                    # refs
                    bt_ref, len_ref,          # scalar-prefetch (SMEM)
-                   qp_ref, k_hbm, v_hbm,     # inputs (2D cache views)
-                   o_ref,                    # output [1, Hq, F]
-                   k_vmem, v_vmem, sem):     # scratch
+                   q_ref, k_hbm, v_hbm,      # q [1, Hq, D]; 2D cache views
+                   o_ref,                    # output [1, Hq, D]
+                   k_vmem, v_vmem, sem):     # scratch [3, pair*bs, F]
     b = pl.program_id(0)
+    nb = pl.num_programs(0)
     seq_len = len_ref[b]
     n_pages = pl.cdiv(seq_len, block_size)
+    n_iters = pl.cdiv(seq_len, block_size * pair)
 
-    Hq, F = qp_ref.shape[1], qp_ref.shape[2]
-    qp = qp_ref[0].astype(jnp.float32)                # [Hq, F] (pre-scaled)
+    Hq, D = q_ref.shape[1], q_ref.shape[2]
+    F = n_kv * D
+    G = Hq // n_kv
+    W = block_size * pair
+
+    # Band mask [Hq, F]: query row h owns columns [D*(h//G), D*(h//G+1)).
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (Hq, F), 0) // G
+    col_head = jax.lax.broadcasted_iota(jnp.int32, (Hq, F), 1) // D
+    band = row_head == col_head
+    # qp [Hq, F]: q tiled across kv-head bands (lane concat — Mosaic has
+    # no 3D broadcast reshape), off-band zeroed (bf16).
+    q = q_ref[0]                                        # [Hq, D] pre-scaled
+    qp = jnp.where(band, jnp.concatenate([q] * n_kv, axis=1),
+                   jnp.zeros((Hq, F), q.dtype))
 
     m0 = jnp.full((Hq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((Hq, 1), jnp.float32)
     a0 = jnp.zeros((Hq, F), jnp.float32)
 
-    # Double-buffered page pipeline: fetch page p+1 while computing on p.
-    def get_k(slot, p):
+    def fetch(buf, hbm, slot, seq, t, j, kv):
+        # page index p = t*pair + j for sequence row `seq`; clamp to that
+        # row's last real page so a tail tile's extra DMA is a harmless
+        # re-fetch (its positions are masked in compute).
+        last = jnp.maximum(pl.cdiv(len_ref[seq], block_size) - 1, 0)
+        p = jnp.minimum(t * pair + j, last)
         return pltpu.make_async_copy(
-            k_hbm.at[pl.ds(bt_ref[b, p] * block_size, block_size)],
-            k_vmem.at[slot], sem.at[slot, 0])
+            hbm.at[pl.ds(bt_ref[seq, p] * block_size, block_size)],
+            buf.at[slot, pl.ds(j * block_size, block_size)],
+            sem.at[slot, j, kv])
 
-    def get_v(slot, p):
-        return pltpu.make_async_copy(
-            v_hbm.at[pl.ds(bt_ref[b, p] * block_size, block_size)],
-            v_vmem.at[slot], sem.at[slot, 1])
+    def start_tile(slot, seq, t):
+        for j in range(pair):
+            fetch(k_vmem, k_hbm, slot, seq, t, j, 0).start()
+            fetch(v_vmem, v_hbm, slot, seq, t, j, 1).start()
 
-    @pl.when(n_pages > 0)
+    def wait_tile(slot, seq, t):
+        for j in range(pair):
+            fetch(k_vmem, k_hbm, slot, seq, t, j, 0).wait()
+            fetch(v_vmem, v_hbm, slot, seq, t, j, 1).wait()
+
+    # Tile 0 lives in slot 2: the PREVIOUS program prefetched it during its
+    # last tile's compute (see below) iff it had 2+ tiles itself (a
+    # single-tile program is still READING slot 2 at its last tile — a
+    # prefetch there would overwrite live data); otherwise fetch it now.
+    # Slots 0/1 double-buffer tiles 1..n-1.
+    prev_iters = pl.cdiv(len_ref[jnp.maximum(b - 1, 0)], block_size * pair)
+    prefetched = jnp.logical_and(b > 0, prev_iters > 1)
+
+    @pl.when(jnp.logical_and(n_iters > 0, jnp.logical_not(prefetched)))
     def _():
-        get_k(0, 0).start()
-        get_v(0, 0).start()
+        start_tile(2, b, 0)
 
-    def body(p, carry):
+    def slot_of(t):
+        return jnp.where(t == 0, 2, jax.lax.rem(t, 2))
+
+    def body(t, carry):
         m, l, acc = carry
-        slot = jax.lax.rem(p, 2)
-        nxt = jax.lax.rem(p + 1, 2)
+        slot = slot_of(t)
 
-        @pl.when(p + 1 < n_pages)
+        @pl.when(t + 1 < n_iters)
         def _():
-            get_k(nxt, p + 1).start()
-            get_v(nxt, p + 1).start()
+            start_tile(jax.lax.rem(t + 1, 2), b, t + 1)
 
-        get_k(slot, p).wait()
-        get_v(slot, p).wait()
+        # Last tile (and not tile 0 — slot 2 is still live there): overlap
+        # the NEXT program's tile-0 fetch (slot 2) with this tile's
+        # compute — kills the per-program pipeline drain.  The issue
+        # condition must mirror `prefetched` above exactly: issued iff
+        # this program has 2+ tiles and the next program has pages.
+        @pl.when(jnp.logical_and(
+            jnp.logical_and(t + 1 >= n_iters, t >= 1),
+            jnp.logical_and(b + 1 < nb,
+                            len_ref[jnp.minimum(b + 1, nb - 1)] > 0)))
+        def _():
+            start_tile(2, jnp.minimum(b + 1, nb - 1), 0)
 
-        k = k_vmem[slot].astype(jnp.float32)          # [bs, F]
-        v = v_vmem[slot].astype(jnp.float32)
+        wait_tile(slot, b, t)
+
+        k = k_vmem[slot]                              # [W, F] bf16
+        v = v_vmem[slot]
         # Zero bands in qp make this the per-KV-head score despite the
-        # full-F contraction: [Hq, F] x [bs, F] -> [Hq, bs].
+        # full-F contraction: [Hq, F] x [W, F] -> [Hq, W].
         s = jax.lax.dot_general(
             qp, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if soft_cap is not None:
             s = soft_cap * jnp.tanh(s / soft_cap)
-        pos = p * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_size), 1)
+        pos = t * W + jax.lax.broadcasted_iota(jnp.int32, (1, W), 1)
         s = jnp.where(pos < seq_len, s, -jnp.inf)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         probs = jnp.exp(s - m_new)
         l_new = l * alpha + jnp.sum(probs, axis=-1, keepdims=True)
-        # [Hq, bs] x [bs, F] -> [Hq, F]; band h carries head h's output.
+        # [Hq, W] x [W, F] -> [Hq, F]; band h carries head h's output.
         pv = jax.lax.dot_general(
-            probs, v, (((1,), (0,)), ((), ())),
+            probs.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc * alpha + pv
 
-    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, a0))
+    m, l, acc = jax.lax.fori_loop(0, n_iters, body, (m0, l0, a0))
     # Padding rows (seq_len 0) skip the loop: l stays 0; guard the divide —
     # their output rows are discarded by the engine anyway.
     out = acc / jnp.maximum(l, 1e-30)
-    o_ref[0] = out.astype(o_ref.dtype)
+    # Band extraction on VMEM: head h's output is its own band of `out`;
+    # zero the off-bands and fold the D-wide column groups (static slices
+    # — Mosaic has no 3D reshape-reduce).
+    outm = jnp.where(band, out, 0.0)
+    out_d = outm[:, 0:D]
+    for kk in range(1, n_kv):
+        out_d = out_d + outm[:, kk * D:(kk + 1) * D]
+    o_ref[0] = out_d.astype(o_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block_size", "scale", "soft_cap", "interpret"))
+    static_argnames=("block_size", "scale", "soft_cap", "interpret", "pair"))
 def paged_decode_attention(
     q: jax.Array,             # [B, Hq, D] current (single) decode queries
-    k_cache: jax.Array,       # [S, Hkv, D] one layer's flat-slot keys
-    v_cache: jax.Array,       # [S, Hkv, D]
+    k_cache: jax.Array,       # [S, F = Hkv * D] one layer's flat-slot keys
+    v_cache: jax.Array,       # [S, F]
     block_tables: jax.Array,  # [B, P] int32 page ids
     seq_lens: jax.Array,      # [B] int32 valid context length
     *,
@@ -131,53 +191,57 @@ def paged_decode_attention(
     scale: Optional[float] = None,
     soft_cap: Optional[float] = None,
     interpret: bool = False,
+    pair: int = 2,
 ) -> jax.Array:
     """Decode-step attention over the paged cache; returns [B, Hq, D].
 
-    Numerics match ops/attention.py's masked gather path for T=1 (the
-    decode query at position seq_len-1 sees exactly slots pos < seq_len).
+    The cache is the engine's native 2D layout [S, F] with F flat
+    head-major (kv_cache.init_cache) — exactly the view the kernel's DMA
+    wants, no relayout at the boundary.  Numerics match ops/attention.py's
+    masked gather path for T=1 (the decode query at position seq_len-1
+    sees exactly slots pos < seq_len): bf16 MXU passes with f32
+    accumulation on both paths.
     """
     B, Hq, D = q.shape
-    S, Hkv, _ = k_cache.shape
-    if Hq % Hkv:
-        raise ValueError(f"Hq={Hq} not a multiple of Hkv={Hkv}")
-    G = Hq // Hkv
+    S, Fc = k_cache.shape
+    Hkv = Fc // D
+    if Fc % D or Hq % Hkv:
+        raise ValueError(f"bad geometry: q {q.shape}, cache {k_cache.shape}")
+    if not interpret and (Fc % 128 or block_size % 8):
+        # Mosaic DMA tiling: the cache's lane dim must be 128-aligned and
+        # the sublane (block) dim 8-aligned, or compilation dies deep in
+        # the DMA lowering.  Callers (engine auto-selection) should fall
+        # back to the gather path for such geometries.
+        raise ValueError(
+            f"pallas paged decode needs F % 128 == 0 and block_size % 8 "
+            f"== 0; got F={Fc}, block_size={block_size} (use the XLA "
+            "gather path for this geometry)")
     F = Hkv * D
     if scale is None:
         scale = D ** -0.5
 
-    # Scatter each query row into its KV head's column band (XLA side).
-    head_of_q = jnp.arange(Hq, dtype=jnp.int32) // G           # [Hq]
-    sel = jax.nn.one_hot(head_of_q, Hkv, dtype=jnp.float32)    # [Hq, Hkv]
-    qp = jnp.einsum(
-        "bhd,hk->bhkd", q.astype(jnp.float32) * scale, sel
-    ).reshape(B, Hq, F)
+    q_scaled = (q.astype(jnp.float32) * scale).astype(k_cache.dtype)
 
-    kernel = functools.partial(_decode_kernel, block_size, soft_cap)
+    kernel = functools.partial(_decode_kernel, block_size, pair, Hkv,
+                               soft_cap)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, Hq, F), lambda b, bt, sl: (b, 0, 0)),
+            pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),   # K stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),   # V stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, Hq, F), lambda b, bt, sl: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, block_size, F), k_cache.dtype),
-            pltpu.VMEM((2, block_size, F), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((3, pair * block_size, F), k_cache.dtype),
+            pltpu.VMEM((3, pair * block_size, F), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((3, pair, 2)),
         ],
     )
-    out_full = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((B, Hq, F), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(block_tables, seq_lens, qp, k_cache.reshape(S, F),
-      v_cache.reshape(S, F))
-
-    # Extract each head's band: [B, Hq, Hkv, D] -> [B, Hq, D].
-    out = out_full.reshape(B, Hq, Hkv, D)
-    return jnp.take_along_axis(
-        out, head_of_q[None, :, None, None], axis=2)[:, :, 0]
+    )(block_tables, seq_lens, q_scaled, k_cache, v_cache)

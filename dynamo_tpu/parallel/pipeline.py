@@ -47,8 +47,9 @@ def stack_layer_params(params: Dict) -> Dict:
 
 
 def init_pp_cache(cfg: kvc.KvCacheConfig) -> Dict:
-    """Stacked cache for the pp step: {'k': [L, slots, Hkv, D], 'v': ...}."""
-    shape = (cfg.num_layers, cfg.num_slots, cfg.num_kv_heads, cfg.head_dim)
+    """Stacked cache for the pp step: {'k': [L, slots, F], 'v': ...} —
+    per-layer 2D geometry matching kv_cache.init_cache, stacked on L."""
+    shape = (cfg.num_layers, cfg.num_slots, cfg.feature_dim)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype)}
 
@@ -73,7 +74,7 @@ def pp_param_pspecs(cfg: ModelConfig) -> Dict:
 
 
 def pp_cache_pspecs() -> Dict:
-    spec = P("pp", None, None, None)
+    spec = P("pp", None, None)
     return {"k": spec, "v": spec}
 
 
@@ -115,7 +116,7 @@ def make_pp_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         stage = jax.lax.axis_index("pp")
         last_stage = S - 1
         layers = params["layers"]  # stacked, local shard [L/S, ...]
-        k_cache, v_cache = cache["k"], cache["v"]  # [L/S, slots, H, D]
+        k_cache, v_cache = cache["k"], cache["v"]  # [L/S, slots, F]
 
         def stage_compute(x, meta, k_cache, v_cache, valid):
             """Run this stage's layers on one microbatch activation.

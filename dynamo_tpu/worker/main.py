@@ -59,6 +59,22 @@ def parse_args(argv=None):
                         "checkpoint directory (real weights + tokenizer)")
     p.add_argument("--num-blocks", type=int, default=512)
     p.add_argument("--block-size", type=int, default=64)
+    # Parallelism as a serving capability (reference: one-flag TP,
+    # `components/backends/sglang/launch/disagg.sh:25`): degrees multiply
+    # to the device count; the worker builds the mesh and the engine
+    # shards params/cache/step over it.
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree (heads/features over ICI)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="engine-internal data-parallel degree (batch axis)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel degree (MoE models)")
+    p.add_argument("--dp-attention", action="store_true",
+                   help="batch-sharded attention with slot-sharded KV "
+                        "(tp beyond the kv-head count; reference sglang "
+                        "--enable-dp-attention)")
+    p.add_argument("--decode-window", type=int, default=8,
+                   help="fused decode window length (1 disables)")
     p.add_argument("--speedup-ratio", type=float, default=10.0)
     p.add_argument("--metrics-interval", type=float, default=1.0)
     p.add_argument("--health-port", type=int, default=0,
@@ -99,9 +115,30 @@ async def build_engine(args, kv_event_sink):
 
     cfg, params, tok_spec, template = resolve_model(
         args.model or "llama-3-1b")
+    mesh = None
+    if args.tp * args.dp * args.ep > 1:
+        import jax
+
+        from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+        mesh_cfg = MeshConfig(dp=args.dp, ep=args.ep, tp=args.tp)
+        devices = jax.devices()
+        if mesh_cfg.size > len(devices):
+            raise SystemExit(
+                f"mesh {mesh_cfg.describe()} needs {mesh_cfg.size} devices; "
+                f"this host has {len(devices)}")
+        if mesh_cfg.size < len(devices):
+            logger.warning(
+                "mesh %s uses %d of %d local devices; the rest idle "
+                "(run more workers or raise --dp)",
+                mesh_cfg.describe(), mesh_cfg.size, len(devices))
+        mesh = make_mesh(mesh_cfg, devices[:mesh_cfg.size])
     core = EngineCore(
         EngineConfig(model=cfg,
                      num_blocks=args.num_blocks,
+                     mesh=mesh,
+                     dp_attention=args.dp_attention,
+                     decode_window=args.decode_window,
                      scheduler=SchedulerConfig(block_size=args.block_size)),
         params=params,
         kv_event_sink=kv_event_sink)

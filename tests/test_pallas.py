@@ -21,8 +21,8 @@ def test_kernel_matches_xla_gather_path():
     B, Hq, Hkv, D, bs, P = 3, 8, 4, 64, 8, 4
     S = 32 * bs
     q = jax.random.normal(jax.random.key(1), (B, Hq, D), jnp.float32)
-    kc = jax.random.normal(jax.random.key(2), (S, Hkv, D), jnp.bfloat16)
-    vc = jax.random.normal(jax.random.key(3), (S, Hkv, D), jnp.bfloat16)
+    kc = jax.random.normal(jax.random.key(2), (S, Hkv * D), jnp.bfloat16)
+    vc = jax.random.normal(jax.random.key(3), (S, Hkv * D), jnp.bfloat16)
     # Non-contiguous, per-sequence page assignments.
     bt = jnp.asarray([[3, 9, 17, 2], [11, 4, 0, 0], [21, 0, 0, 0]],
                      jnp.int32)
@@ -34,7 +34,7 @@ def test_kernel_matches_xla_gather_path():
     ctx_pos = jnp.broadcast_to(jnp.arange(P * bs, dtype=jnp.int32),
                                (B, P * bs))
     slots = kvc.slots_for_positions(bt, ctx_pos, bs)
-    k_ctx, v_ctx = kvc.gather_kv(kc, vc, slots)
+    k_ctx, v_ctx = kvc.gather_kv(kc, vc, slots, Hkv)
     ref = paged_attention(q[:, None], k_ctx, v_ctx,
                           (seq_lens - 1)[:, None], ctx_pos, seq_lens)[:, 0]
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),

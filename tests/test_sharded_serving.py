@@ -1,0 +1,204 @@
+"""Sharded serving fast paths (VERDICT r3 next-2): decode windows,
+speculative decoding, embeddings and the Pallas kernel all work under a
+mesh, and a `--tp` worker serves over the distributed runtime.
+
+Greedy output parity against the unsharded engine is the oracle: the
+serving path must not depend on how the model is partitioned.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+SCHED = dict(max_seqs=4, block_size=8, max_pages_per_seq=8,
+             max_prefill_chunk=16, decode_buckets=(2, 4),
+             prefill_buckets=(8, 16))
+
+
+def _run_engine(mesh=None, decode_window=1, spec=0, dp_attention=False,
+                use_pallas=None, n_tokens=12):
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64,
+        mesh=mesh, dp_attention=dp_attention,
+        decode_window=decode_window, window_pipeline_depth=2,
+        speculative_tokens=spec,
+        use_pallas_decode=use_pallas,
+        enable_prefix_cache=False,
+        scheduler=SchedulerConfig(**SCHED)))
+    core.add_request("a", [5, 6, 7, 8, 9, 10, 5, 6, 7, 8],
+                     SamplingParams(max_tokens=n_tokens))
+    core.add_request("b", list(range(20, 34)),
+                     SamplingParams(max_tokens=n_tokens))
+    outputs = {}
+    for _ in range(300):
+        for d in core.step():
+            outputs.setdefault(d.request_id, []).extend(d.token_ids)
+        if not core._requests:
+            break
+    assert not core._requests, "engine did not finish"
+    return outputs
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    """Unsharded single-step greedy output (the parity reference)."""
+    return _run_engine()
+
+
+def test_sharded_window_matches_unsharded(oracle):
+    mesh = make_mesh(MeshConfig(tp=2, dp=2), jax.devices()[:4])
+    got = _run_engine(mesh=mesh, decode_window=4)
+    assert got == oracle
+
+
+def test_sharded_single_step_matches_unsharded(oracle):
+    mesh = make_mesh(MeshConfig(tp=4), jax.devices()[:4])
+    got = _run_engine(mesh=mesh)
+    assert got == oracle
+
+
+def test_sharded_spec_decode_matches_unsharded(oracle):
+    mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    got = _run_engine(mesh=mesh, spec=3)
+    assert got == oracle
+
+
+def test_dp_attention_window_matches_unsharded(oracle):
+    mesh = make_mesh(MeshConfig(tp=2, dp=2), jax.devices()[:4])
+    got = _run_engine(mesh=mesh, decode_window=4, dp_attention=True)
+    assert got == oracle
+
+
+def test_sharded_pallas_window_matches_unsharded(oracle):
+    """The Pallas kernel under shard_map (interpret mode on CPU)."""
+    mesh = make_mesh(MeshConfig(tp=2, dp=2), jax.devices()[:4])
+    got = _run_engine(mesh=mesh, decode_window=4, use_pallas=True)
+    assert got == oracle
+
+
+def test_sp_ring_prefill_through_engine(oracle):
+    """A SERVED request's prefill demonstrably runs the ring path
+    (VERDICT r3 next-4: make_sp_prefill_step was test-only)."""
+    mesh = make_mesh(MeshConfig(sp=2, tp=2), jax.devices()[:4])
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64,
+        mesh=mesh, sp_prefill_threshold=8,
+        enable_prefix_cache=False,
+        scheduler=SchedulerConfig(**SCHED)))
+    core.add_request("a", [5, 6, 7, 8, 9, 10, 5, 6, 7, 8],
+                     SamplingParams(max_tokens=12))
+    core.add_request("b", list(range(20, 34)),
+                     SamplingParams(max_tokens=12))
+    outputs = {}
+    for _ in range(300):
+        for d in core.step():
+            outputs.setdefault(d.request_id, []).extend(d.token_ids)
+        if not core._requests:
+            break
+    assert core.sp_prefill_count == 2, "prefill did not run the ring path"
+    assert outputs == oracle
+
+
+def test_pp_engine_serving(oracle):
+    """A pp-mesh engine SERVES via the pipeline step (VERDICT r3 next-4:
+    make_pp_step was test-only)."""
+    mesh = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    got = _run_engine(mesh=mesh)
+    assert got == oracle
+
+
+def test_sharded_embeddings():
+    mesh = make_mesh(MeshConfig(tp=2, dp=2), jax.devices()[:4])
+    cfg = mcfg.get_config("tiny-test")
+
+    def embed(mesh_):
+        core = EngineCore(EngineConfig(
+            model=cfg, num_blocks=64, mesh=mesh_,
+            enable_prefix_cache=False,
+            scheduler=SchedulerConfig(**SCHED)))
+        return core.embed_tokens([[5, 6, 7, 8], list(range(20, 31))])
+
+    want = embed(None)
+    got = embed(mesh)
+    assert got.shape == (2, cfg.hidden_size)
+    np.testing.assert_allclose(want, got, rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.e2e
+def test_tp_worker_serves_http():
+    """A real-engine worker launched with --tp 2 --dp 2 serves a chat
+    completion end-to-end over the distributed runtime (the 'one flag'
+    contract, reference `sglang/launch/disagg.sh:25`)."""
+    import asyncio
+    import os
+    import subprocess
+    import sys
+    import time
+
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm.discovery import ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.service import ModelManager
+    from dynamo_tpu.runtime.control_plane_tcp import (
+        ControlPlaneClient, ControlPlaneServer)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    async def main():
+        cp_server = ControlPlaneServer()
+        cp_port = await cp_server.start()
+        cp = ControlPlaneClient("127.0.0.1", cp_port)
+        await cp.start()
+        runtime = DistributedRuntime(cp)
+        models = ModelManager()
+        watcher = ModelWatcher(runtime, models, migration_limit=0)
+        await watcher.start()
+        svc = HttpService(models)
+        http_port = await svc.start()
+
+        log = open(f"/tmp/dynamo_tpu_tp_worker_{os.getpid()}.log", "w+")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.worker",
+             "--control-plane", f"127.0.0.1:{cp_port}",
+             "--model", "tiny-test", "--model-name", "tiny-tp",
+             "--block-size", "8", "--tp", "2", "--dp", "2",
+             "--decode-window", "4"],
+            env=env, cwd=repo, stdout=log, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            await watcher.wait_for_model("tiny-tp", timeout=120)
+            base = f"http://127.0.0.1:{http_port}"
+            async with ClientSession() as s:
+                async with s.post(f"{base}/v1/chat/completions", json={
+                        "model": "tiny-tp",
+                        "messages": [{"role": "user", "content": "hello"}],
+                        "max_tokens": 8}) as r:
+                    body = await r.json()
+                    assert r.status == 200, body
+                    assert body["choices"][0]["message"]["content"]
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            log.flush(); log.seek(0)
+            print(log.read()[-2000:])
+            log.close()
+            await svc.stop()
+            await watcher.stop()
+            await runtime.shutdown()
+            await cp.close()
+            await cp_server.stop()
+
+    asyncio.run(main())

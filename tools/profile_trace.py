@@ -1,0 +1,64 @@
+"""Capture an XLA op-level trace of the decode window and print the top ops."""
+
+import glob
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine import kv_cache as kvc
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.models.llama import init_params, make_decode_window
+
+BATCH, CTX, BLOCK, WIDTH = 64, 512, 64, 16
+
+
+def main():
+    cfg = mcfg.get_config("llama-3-1b")
+    params = init_params(cfg, jax.random.key(0))
+    num_blocks = 1 + BATCH * WIDTH
+    win = jax.jit(
+        make_decode_window(cfg, BLOCK, 8, use_pallas_decode=True,
+                           greedy_only=True),
+        donate_argnums=(1,))
+    bt = np.zeros((BATCH, WIDTH), np.int32)
+    for i in range(BATCH):
+        bt[i] = np.arange(1 + i * WIDTH, 1 + (i + 1) * WIDTH)
+    bt = jnp.asarray(bt)
+    z = jnp.zeros((BATCH,), jnp.float32)
+    zi = jnp.zeros((BATCH,), jnp.int32)
+    ones = jnp.ones((BATCH,), jnp.float32)
+    keys = jax.random.split(jax.random.key(0), BATCH)
+
+    def fresh():
+        return (kvc.init_cache(kvc.KvCacheConfig.for_model(
+                    cfg, num_blocks=num_blocks, block_size=BLOCK)),
+                jnp.ones((BATCH,), jnp.int32))
+
+    cache, last = fresh()
+    for _ in range(2):  # warm
+        cache, out, _, _, _ = win(params, cache, last,
+                                  jnp.full((BATCH,), CTX, jnp.int32),
+                                  jnp.full((BATCH,), CTX + 1, jnp.int32),
+                                  bt, z, zi, ones, keys, zi)
+        last = out[-1]
+    jax.device_get(last)
+
+    logdir = "/tmp/jaxtrace"
+    with jax.profiler.trace(logdir):
+        for _ in range(3):
+            cache, out, _, _, _ = win(params, cache, last,
+                                      jnp.full((BATCH,), CTX, jnp.int32),
+                                      jnp.full((BATCH,), CTX + 1, jnp.int32),
+                                      bt, z, zi, ones, keys, zi)
+            last = out[-1]
+        jax.device_get(last)
+        time.sleep(0.5)
+
+    files = glob.glob(logdir + "/**/*.xplane.pb", recursive=True)
+    print("xplane files:", files)
+
+
+if __name__ == "__main__":
+    main()
