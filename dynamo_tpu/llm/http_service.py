@@ -28,8 +28,6 @@ from dynamo_tpu.runtime.metrics import FrontendMetrics, MetricsRegistry
 
 logger = logging.getLogger(__name__)
 
-N_STREAM_UNSUPPORTED = ("n > 1 with stream=true is not supported; request "
-                        "n choices unary or stream one")
 
 
 class HttpService:
@@ -158,8 +156,6 @@ class HttpService:
         logger.info("request %s: chat model=%s prompt_tokens=%d stream=%s",
                     rid, body.model, len(pre.token_ids), body.stream)
         if body.stream:
-            if body.n > 1:
-                return self._error(400, N_STREAM_UNSUPPORTED)
             return await self._stream_chat(request, handle, body, pre, rid)
         return await self._unary_chat(handle, body, pre, rid)
 
@@ -184,8 +180,6 @@ class HttpService:
                     "stream=%s", rid, body.model, len(pre.token_ids),
                     body.stream)
         if body.stream:
-            if body.n > 1:
-                return self._error(400, N_STREAM_UNSUPPORTED)
             return await self._stream_completion(request, handle, body, pre,
                                                  rid)
 
@@ -235,16 +229,13 @@ class HttpService:
                 out[name] = {"status": "error", "error": str(e)}
         return web.json_response(out)
 
-    async def responses(self, request: web.Request) -> web.Response:
+    async def responses(self, request: web.Request) -> web.StreamResponse:
         """/v1/responses (reference `protocols/openai/responses.rs`):
-        normalised onto the chat pipeline; unary only in this round."""
+        normalised onto the chat pipeline; unary and SSE streaming."""
         try:
             body = oai.ResponsesRequest.model_validate(await request.json())
         except Exception as e:
             return self._error(400, f"invalid request: {e}")
-        if body.stream:
-            return self._error(400, "streaming /v1/responses is not "
-                                    "supported yet; use stream=false")
         handle = self._lookup(body.model)
         if handle is None:
             return self._error(404, f"model {body.model!r} not found",
@@ -260,8 +251,12 @@ class HttpService:
         err = self._validate_context(handle, pre)
         if err is not None:
             return err
-        logger.info("request %s: responses model=%s prompt_tokens=%d",
-                    rid, body.model, len(pre.token_ids))
+        logger.info("request %s: responses model=%s prompt_tokens=%d "
+                    "stream=%s", rid, body.model, len(pre.token_ids),
+                    body.stream)
+        if body.stream:
+            return await self._stream_responses(request, handle, body, pre,
+                                                rid)
         start = time.monotonic()
         self.metrics.requests_total.inc(labels={"model": body.model})
         self.metrics.requests_in_flight.add(1, labels={"model": body.model})
@@ -292,6 +287,68 @@ class HttpService:
                 output_tokens=det.completion_tokens,
                 total_tokens=len(pre.token_ids) + det.completion_tokens))
         return web.json_response(resp.model_dump(exclude_none=True))
+
+    async def _stream_responses(self, request, handle, body, pre, rid):
+        """Responses-API SSE: `response.created` → N ×
+        `response.output_text.delta` → `response.completed` (the event
+        names OpenAI's Responses stream uses; the reference streams
+        internally and folds for unary, `http/service/openai.rs:222-226`)."""
+        start = time.monotonic()
+        self.metrics.requests_total.inc(labels={"model": body.model})
+        self.metrics.requests_in_flight.add(1, labels={"model": body.model})
+        response = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream",
+                     "Cache-Control": "no-cache"})
+        await response.prepare(request)
+        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
+        parts, reason = [], None
+        try:
+            created = oai.ResponsesResponse(
+                id=rid, model=body.model, status="in_progress")
+            await response.write(oai.sse_encode_event(
+                "response.created",
+                {"type": "response.created",
+                 "response": created.model_dump(exclude_none=True)}
+            ).encode())
+            async for out in self._token_stream(handle, pre, det,
+                                                body.model, start):
+                if out.text:
+                    parts.append(out.text)
+                    await response.write(oai.sse_encode_event(
+                        "response.output_text.delta",
+                        {"type": "response.output_text.delta",
+                         "delta": out.text}).encode())
+                if out.finished:
+                    reason = out.finish_reason
+                    break
+            status = {"stop": "completed", "length": "incomplete",
+                      "error": "failed"}.get(str(reason or "stop"),
+                                             "completed")
+            final = oai.ResponsesResponse(
+                id=rid, model=body.model, status=status,
+                output=[oai.ResponseOutputMessage(
+                    status=status,
+                    content=[oai.ResponseOutputText(text="".join(parts))])],
+                usage=oai.ResponsesUsage(
+                    input_tokens=len(pre.token_ids),
+                    output_tokens=det.completion_tokens,
+                    total_tokens=len(pre.token_ids)
+                    + det.completion_tokens))
+            await response.write(oai.sse_encode_event(
+                "response.completed",
+                {"type": "response.completed",
+                 "response": final.model_dump(exclude_none=True)}
+            ).encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            logger.info("client disconnected: %s", rid)
+            raise
+        finally:
+            self.metrics.requests_in_flight.add(-1,
+                                                labels={"model": body.model})
+            self._observe_done(body.model, start, len(pre.token_ids),
+                               det.completion_tokens)
+        await response.write_eof()
+        return response
 
     async def embeddings(self, request: web.Request) -> web.Response:
         """/v1/embeddings: last-token hidden-state embeddings (reference
@@ -353,7 +410,7 @@ class HttpService:
         """SSE stream of `text_completion` chunks (ADVICE r1: the unary-only
         handler broke OpenAI streaming clients)."""
 
-        def make_chunk(out, lps):
+        def make_chunk(i, out, lps):
             logprobs = None
             if lps:
                 logprobs = {
@@ -364,7 +421,8 @@ class HttpService:
             return oai.CompletionResponse(
                 id=rid, model=body.model,
                 choices=[oai.CompletionChoice(
-                    text=out.text or "", finish_reason=out.finish_reason,
+                    index=i, text=out.text or "",
+                    finish_reason=out.finish_reason,
                     logprobs=logprobs)])
 
         def make_usage_chunk(usage):
@@ -393,43 +451,63 @@ class HttpService:
             out.append(clone)
         return out
 
-    async def _collect_one(self, handle, pre, model, start, want_lp):
-        """Drain one engine stream → (text, finish_reason, det, lp_sink)."""
+    async def _collect_one(self, handle, pre, model, start, want_lp,
+                           on_first=None):
+        """Drain one engine stream → (text, finish_reason, det, lp_sink).
+        `on_first` fires at the first yielded output (choice-0's prompt
+        blocks are sealed by then — the signal siblings gate on)."""
         det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
         lp_sink = [] if want_lp else None
         parts, reason = [], None
         async for out in self._token_stream(handle, pre, det, model, start,
                                             lp_sink=lp_sink):
+            if on_first is not None:
+                on_first()
+                on_first = None
             parts.append(out.text)
             if out.finished:
                 reason = out.finish_reason
         return "".join(parts), reason, det, lp_sink
 
     async def _collect_choices(self, handle, pre, n, model, start, want_lp):
-        """n-choice unary collection.  Choice 0 runs FIRST so its sealed
-        prompt blocks are registered before choices 1..n-1 start — they
+        """n-choice unary collection.  Choice 0 starts FIRST; siblings
+        launch at its FIRST TOKEN — the shared prompt blocks are sealed
+        once prefill completes, so waiting for choice 0's whole stream
+        (ADVICE r3) bought nothing but latency.  Siblings still
         prefix-hit instead of paying n× prefill for the same prompt.
-        Sibling failures don't leak running generations: the remainder is
-        gathered with return_exceptions and the first error re-raised
-        only after every stream has settled."""
+        Failures don't leak running generations: everything is gathered
+        with return_exceptions and the first error re-raised only after
+        every stream has settled."""
         clones = self._fan_out(pre, n)
-        results = [await self._collect_one(handle, clones[0], model, start,
-                                           want_lp)]
-        if n > 1:
-            # Siblings start NOW: measuring their TTFT against the
-            # request's original start would fold choice 0's whole
-            # generation time into the histogram.
-            sib_start = time.monotonic()
-            rest = await asyncio.gather(
-                *(self._collect_one(handle, c, model, sib_start, want_lp)
-                  for c in clones[1:]),
-                return_exceptions=True)
-            for r in rest:
-                if isinstance(r, BaseException):
-                    raise r
-            results.extend(rest)
+        if n == 1:
+            r = await self._collect_one(handle, clones[0], model, start,
+                                        want_lp)
+            return [r], r[2].completion_tokens
+        sealed = asyncio.Event()
+
+        async def run0():
+            try:
+                return await self._collect_one(handle, clones[0], model,
+                                               start, want_lp,
+                                               on_first=sealed.set)
+            finally:
+                sealed.set()  # error/empty stream: don't strand siblings
+
+        async def run_sib(clone):
+            await sealed.wait()
+            # Sibling TTFT measures from its own start: folding choice
+            # 0's prefill into the histogram would skew it.
+            return await self._collect_one(handle, clone, model,
+                                           time.monotonic(), want_lp)
+
+        results = await asyncio.gather(
+            run0(), *(run_sib(c) for c in clones[1:]),
+            return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
         total_out = sum(det.completion_tokens for _, _, det, _ in results)
-        return results, total_out
+        return list(results), total_out
 
     async def _token_stream(self, handle, pre, det, model, start_ts,
                             lp_sink=None):
@@ -511,7 +589,7 @@ class HttpService:
         return web.json_response(resp.model_dump(exclude_none=True))
 
     async def _stream_chat(self, request, handle, body, pre, rid):
-        def make_chunk(out, lps):
+        def make_chunk(i, out, lps):
             logprobs = None
             if lps:
                 logprobs = oai.ChatLogprobs(content=[
@@ -521,6 +599,7 @@ class HttpService:
             return oai.ChatCompletionChunk(
                 id=rid, model=body.model,
                 choices=[oai.ChatStreamChoice(
+                    index=i,
                     delta=oai.ChatChoiceDelta(content=out.text or None),
                     finish_reason=out.finish_reason,
                     logprobs=logprobs)])
@@ -529,20 +608,33 @@ class HttpService:
             return oai.ChatCompletionChunk(
                 id=rid, model=body.model, choices=[], usage=usage)
 
-        # Leading chunk with the assistant role (OpenAI convention).
-        head = oai.ChatCompletionChunk(
-            id=rid, model=body.model,
-            choices=[oai.ChatStreamChoice(
-                delta=oai.ChatChoiceDelta(role="assistant", content=""))])
+        def head_chunk(i):
+            # Leading chunk with the assistant role (OpenAI convention),
+            # one per choice index.
+            return oai.ChatCompletionChunk(
+                id=rid, model=body.model,
+                choices=[oai.ChatStreamChoice(
+                    index=i,
+                    delta=oai.ChatChoiceDelta(role="assistant",
+                                              content=""))])
+
         return await self._stream_sse(request, handle, body, pre, rid,
                                       make_chunk, make_usage_chunk,
-                                      head_chunk=head)
+                                      head_chunk=head_chunk)
 
     async def _stream_sse(self, request, handle, body, pre, rid,
                           make_chunk, make_usage_chunk, head_chunk=None):
         """Shared SSE scaffolding for chat + text completion streams:
         metrics, disconnect-cancel, optional stream_options.include_usage
-        final chunk, and the [DONE] sentinel."""
+        final chunk, and the [DONE] sentinel.
+
+        n > 1 multiplexes n engine streams into the one SSE stream with
+        per-choice `index` (the reference streams everything internally
+        and folds for unary, `http/service/openai.rs:222-226`; r3
+        rejected stream+n>1 with a 400).  `make_chunk(i, out, lps)`
+        stamps the choice index.  Choice 0 starts first; siblings launch
+        at its first token so they prefix-hit the sealed prompt blocks.
+        """
         start = time.monotonic()
         self.metrics.requests_total.inc(labels={"model": body.model})
         self.metrics.requests_in_flight.add(1, labels={"model": body.model})
@@ -551,29 +643,61 @@ class HttpService:
                      "Cache-Control": "no-cache"})
         await response.prepare(request)
 
-        det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
-        lp_sink = [] if pre.sampling.logprobs else None
-        lp_sent = 0
+        clones = self._fan_out(pre, body.n)
+        dets = [StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
+                for _ in clones]
+        want_lp = bool(pre.sampling.logprobs)
+        queue: asyncio.Queue = asyncio.Queue()
+        sealed = asyncio.Event()
+
+        async def pump(i, clone):
+            try:
+                if i:
+                    await sealed.wait()
+                st = start if i == 0 else time.monotonic()
+                lp_sink = [] if want_lp else None
+                sent = 0
+                async for out in self._token_stream(handle, clone, dets[i],
+                                                    body.model, st,
+                                                    lp_sink=lp_sink):
+                    sealed.set()
+                    lps = []
+                    if lp_sink is not None:
+                        lps, sent = lp_sink[sent:], len(lp_sink)
+                    await queue.put(("chunk", i, out, lps))
+                    if out.finished:
+                        break
+            except BaseException as e:
+                await queue.put(("error", i, e, None))
+                raise
+            finally:
+                sealed.set()
+                await queue.put(("done", i, None, None))
+
+        tasks = [asyncio.create_task(pump(i, c))
+                 for i, c in enumerate(clones)]
         try:
             if head_chunk is not None:
-                await response.write(oai.sse_encode(head_chunk).encode())
-            async for out in self._token_stream(handle, pre, det,
-                                                body.model, start,
-                                                lp_sink=lp_sink):
-                lps = []
-                if lp_sink is not None:
-                    lps = lp_sink[lp_sent:]
-                    lp_sent = len(lp_sink)
-                await response.write(
-                    oai.sse_encode(make_chunk(out, lps)).encode())
-                if out.finished:
-                    break
+                for i in range(len(clones)):
+                    await response.write(
+                        oai.sse_encode(head_chunk(i)).encode())
+            remaining = len(clones)
+            while remaining:
+                kind, i, out, lps = await queue.get()
+                if kind == "done":
+                    remaining -= 1
+                elif kind == "error":
+                    raise out
+                else:
+                    await response.write(
+                        oai.sse_encode(make_chunk(i, out, lps)).encode())
             if (body.stream_options or {}).get("include_usage"):
                 n_in = len(pre.token_ids)
+                total_out = sum(d.completion_tokens for d in dets)
                 usage = oai.Usage(
                     prompt_tokens=n_in,
-                    completion_tokens=det.completion_tokens,
-                    total_tokens=n_in + det.completion_tokens)
+                    completion_tokens=total_out,
+                    total_tokens=n_in + total_out)
                 await response.write(
                     oai.sse_encode(make_usage_chunk(usage)).encode())
             await response.write(oai.SSE_DONE.encode())
@@ -583,9 +707,15 @@ class HttpService:
             logger.info("client disconnected: %s", rid)
             raise
         finally:
+            for t in tasks:
+                t.cancel()
+            # Retrieve every task's outcome: a second sibling error after
+            # the first was raised would otherwise log "Task exception was
+            # never retrieved" on every multi-choice failure.
+            await asyncio.gather(*tasks, return_exceptions=True)
             self.metrics.requests_in_flight.add(-1, labels={"model": body.model})
             self._observe_done(body.model, start, len(pre.token_ids),
-                               det.completion_tokens)
+                               sum(d.completion_tokens for d in dets))
         await response.write_eof()
         return response
 

@@ -100,12 +100,19 @@ class KvRoutedEngineClient:
             return
 
         async def pub():
-            try:
-                await self.runtime.cp.publish(ACTIVE_SEQS_SUBJECT, {
-                    "router": self._router_id, "kind": kind,
-                    "request_id": request_id, **fields})
-            except Exception:
-                pass  # sync is best-effort; local accounting still holds
+            # One retry (ADVICE r3): a dropped 'free' leaves a phantom
+            # reservation on peer routers skewing placement until the
+            # 900 s expire sweep; still best-effort after that — local
+            # accounting holds either way.
+            for attempt in (0, 1):
+                try:
+                    await self.runtime.cp.publish(ACTIVE_SEQS_SUBJECT, {
+                        "router": self._router_id, "kind": kind,
+                        "request_id": request_id, **fields})
+                    return
+                except Exception:
+                    if attempt == 0:
+                        await asyncio.sleep(0.2)
 
         try:
             asyncio.get_running_loop().create_task(pub())
@@ -116,15 +123,25 @@ class KvRoutedEngineClient:
         import time
 
         last_sweep = time.monotonic()
+        backoff = 1.0
         while True:
             try:
                 msg = await asyncio.wait_for(self._seq_sub.next(),
                                              timeout=30.0)
+                backoff = 1.0
             except asyncio.TimeoutError:
                 msg = None
+            except asyncio.CancelledError:
+                raise
             except ConnectionError:
-                logger.error("active_seqs subscription lost")
-                return
+                # ADVICE r3: don't go silently dark until restart.  The
+                # control-plane client reconnects and restores this SAME
+                # subscription; just keep draining after a pause.
+                logger.warning("active_seqs subscription lost; waiting "
+                               "%.0fs for reconnect", backoff)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+                continue
             # Periodic leak sweep: a remote router SIGKILLed between its
             # "add" and "free" would otherwise reserve phantom load
             # forever (ActiveSequences.expire_older_than exists for
@@ -175,12 +192,22 @@ class KvRoutedEngineClient:
             pass  # no loop (sync tests): drop
 
     async def _pump_events(self) -> None:
+        backoff = 1.0
         while True:
             try:
                 payload = await self._sub.next()
+                backoff = 1.0
+            except asyncio.CancelledError:
+                raise
             except ConnectionError:
-                logger.error("kv_events subscription lost; index frozen")
-                return
+                # ADVICE r3: a frozen index silently degrades routing
+                # until restart.  The control-plane client reconnects and
+                # restores this SAME subscription; keep draining.
+                logger.warning("kv_events subscription lost; waiting "
+                               "%.0fs for reconnect", backoff)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+                continue
             try:
                 self.router.apply_event(RouterEvent.from_dict(payload))
             except Exception:

@@ -48,12 +48,25 @@ class LoadMetricsWatcher:
                 pass
 
     async def _pump(self) -> None:
+        backoff = 1.0
         while True:
             try:
                 payload = await self._sub.next()
+                backoff = 1.0
+            except asyncio.CancelledError:
+                raise
             except ConnectionError:
-                logger.error("%s: load_metrics subscription lost", self.name)
-                return
+                # ADVICE r3: returning here left the consumer silently
+                # blind to load metrics until process restart.  The
+                # control-plane client reconnects underneath and restores
+                # this SAME subscription (a fresh subscribe() here would
+                # double-deliver); keep draining it after a pause.
+                logger.warning(
+                    "%s: load_metrics subscription lost; waiting %.0fs "
+                    "for reconnect", self.name, backoff)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+                continue
             try:
                 self._metrics[payload["worker_id"]] = (
                     ForwardPassMetrics.from_dict(payload["metrics"]),

@@ -92,9 +92,16 @@ class OpenAIPreprocessor:
         choice = request.tool_choice
         if choice == "none":
             tools = None
-        elif isinstance(choice, dict) and tools:
+        elif isinstance(choice, dict):
             forced = choice.get("function", {}).get("name")
-            if forced:
+            if forced and not tools:
+                # ADVICE r3: forcing a named function with no tools
+                # declared was silently ignored — inconsistent with the
+                # unknown-tool 400 below.  OpenAI semantics: client error.
+                raise ValueError(
+                    f"tool_choice forces tool {forced!r} but the request "
+                    "declares no tools")
+            if forced and tools:
                 tools = [t for t in tools
                          if t.get("function", {}).get("name") == forced]
                 if not tools:

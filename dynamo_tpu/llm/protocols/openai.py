@@ -343,6 +343,13 @@ def sse_encode(payload: BaseModel) -> str:
     return f"data: {payload.model_dump_json(exclude_none=True)}\n\n"
 
 
+def sse_encode_event(event: str, payload: dict) -> str:
+    """Named-event SSE frame (the Responses API's `event:` framing)."""
+    import json as _json
+
+    return f"event: {event}\ndata: {_json.dumps(payload)}\n\n"
+
+
 def sse_decode_line(line: str) -> Optional[dict]:
     """Parse one `data: ...` line; None for comments/blank/[DONE]."""
     line = line.strip()

@@ -74,12 +74,22 @@ class MetricsAggregator:
                 pass
 
     async def _pump_hits(self, sub) -> None:
+        backoff = 1.0
         while True:
             try:
                 payload = await sub.next()
+                backoff = 1.0
+            except asyncio.CancelledError:
+                raise
             except ConnectionError:
-                logger.error("kv_hit_rate subscription lost")
-                return
+                # ADVICE r3: don't go silently dark until restart.  The
+                # control-plane client reconnects and restores this SAME
+                # subscription; keep draining after a pause.
+                logger.warning("kv_hit_rate subscription lost; waiting "
+                               "%.0fs for reconnect", backoff)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+                continue
             try:
                 self._hit_isl.inc(float(payload["isl_blocks"]))
                 self._hit_overlap.inc(float(payload["overlap_blocks"]))

@@ -182,8 +182,10 @@ _POISON = object()  # sentinel pushed into stream queues on connection death
 
 
 class _RemoteWatch:
-    def __init__(self, client: "ControlPlaneClient", sid: int) -> None:
+    def __init__(self, client: "ControlPlaneClient", sid: int,
+                 prefix: str) -> None:
         self._client, self._sid = client, sid
+        self.prefix = prefix  # re-established on client reconnect
         self.queue: asyncio.Queue = asyncio.Queue()
 
     async def next(self) -> WatchEvent:
@@ -225,7 +227,17 @@ class _RemoteSubscription:
 
 
 class ControlPlaneClient:
-    """TCP client with the InProcessControlPlane interface."""
+    """TCP client with the InProcessControlPlane interface.
+
+    Reconnects automatically: on connection loss the rx loop fails all
+    pending calls, poisons stream queues ONCE (consumers see one
+    ConnectionError per outage), then dials back with backoff and
+    re-establishes every live watch/subscription under its original sid —
+    the server replays watch state as synthetic puts
+    (ControlPlaneState.watch_prefix), so watchers converge.  Leases are
+    NOT restored (they expire server-side by TTL; the keepalive loop logs
+    loudly — re-registration is the worker's job, the reference's
+    etcd-lease model)."""
 
     def __init__(self, host: str, port: int) -> None:
         self.host, self.port = host, port
@@ -237,23 +249,28 @@ class ControlPlaneClient:
         self._watches: Dict[int, _RemoteWatch] = {}
         self._subs: Dict[int, _RemoteSubscription] = {}
         self._rx_task: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
         self._keepalive_tasks: Dict[int, asyncio.Task] = {}
         self._send_lock = asyncio.Lock()
+        self._closed = False
 
     async def start(self) -> None:
+        self._closed = False
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port)
         self._rx_task = asyncio.create_task(self._rx_loop())
 
     async def close(self) -> None:
+        self._closed = True
         for t in self._keepalive_tasks.values():
             t.cancel()
-        if self._rx_task:
-            self._rx_task.cancel()
-            try:
-                await self._rx_task
-            except asyncio.CancelledError:
-                pass
+        for t in (self._rx_task, self._reconnect_task):
+            if t:
+                t.cancel()
+                try:
+                    await t
+                except asyncio.CancelledError:
+                    pass
         self._fail_all(ConnectionError("control plane client closed"))
         if self._writer:
             self._writer.close()
@@ -273,28 +290,62 @@ class ControlPlaneClient:
 
     async def _rx_loop(self) -> None:
         assert self._reader is not None
-        while True:
-            line = await self._reader.readline()
-            if not line:
-                self._fail_all(ConnectionError("control plane gone"))
-                return
-            msg = json.loads(line)
-            push = msg.get("push")
-            if push == "watch":
-                w = self._watches.get(msg["sid"])
-                if w:
-                    w.queue.put_nowait(WatchEvent(
-                        msg["kind"], msg["key"], msg.get("value")))
-            elif push == "sub":
-                s = self._subs.get(msg["sid"])
-                if s:
-                    s.queue.put_nowait(msg["payload"])
-            else:
-                fut = self._pending.pop(msg.get("id"), None)
-                if fut and not fut.done():
-                    fut.set_result(msg)
+        try:
+            while True:
+                line = await self._reader.readline()
+                if not line:
+                    break
+                msg = json.loads(line)
+                push = msg.get("push")
+                if push == "watch":
+                    w = self._watches.get(msg["sid"])
+                    if w:
+                        w.queue.put_nowait(WatchEvent(
+                            msg["kind"], msg["key"], msg.get("value")))
+                elif push == "sub":
+                    s = self._subs.get(msg["sid"])
+                    if s:
+                        s.queue.put_nowait(msg["payload"])
+                else:
+                    fut = self._pending.pop(msg.get("id"), None)
+                    if fut and not fut.done():
+                        fut.set_result(msg)
+        except (ConnectionResetError, OSError):
+            pass
+        if self._closed:
+            return
+        self._fail_all(ConnectionError("control plane gone"))
+        self._writer = None  # _call fails fast until reconnected
+        self._reconnect_task = asyncio.create_task(self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        backoff = 0.5
+        while not self._closed:
+            try:
+                self._reader, self._writer = await asyncio.open_connection(
+                    self.host, self.port)
+            except OSError:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 15.0)
+                continue
+            self._rx_task = asyncio.create_task(self._rx_loop())
+            try:
+                # Re-establish stream state under the original sids: the
+                # server replays watch state as synthetic puts; sub
+                # streams simply resume from now.
+                for sid, w in list(self._watches.items()):
+                    await self._call("watch", prefix=w.prefix, sid=sid)
+                for sid, s in list(self._subs.items()):
+                    await self._call("subscribe", subject=s.subject, sid=sid)
+            except Exception:
+                continue  # connection died again: dial once more
+            logger.info("control plane reconnected (%d watches, %d subs "
+                        "restored)", len(self._watches), len(self._subs))
+            return
 
     async def _call(self, op: str, **kw) -> dict:
+        if self._writer is None or self._writer.is_closing():
+            raise ConnectionError("control plane not connected")
         mid = next(self._mid)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[mid] = fut
@@ -359,7 +410,7 @@ class ControlPlaneClient:
 
     async def watch_prefix(self, prefix: str) -> _RemoteWatch:
         sid = next(self._sid)
-        w = _RemoteWatch(self, sid)
+        w = _RemoteWatch(self, sid, prefix)
         self._watches[sid] = w
         await self._call("watch", prefix=prefix, sid=sid)
         return w
