@@ -1,0 +1,254 @@
+"""Mooncake-format trace synthesis (prefix-structured workloads).
+
+Role of the reference's `benchmarks/data_generator/synthesizer.py` (442
+LoC, networkx prefix-tree learning over real traces): produce request
+traces whose PREFIX STRUCTURE — which requests share which cached
+blocks — matches a source trace, so KV-routing benefit is measured
+reproducibly without real user data (SURVEY §4).
+
+Trace record (mooncake jsonl):
+
+    {"timestamp": ms, "input_length": tokens, "output_length": tokens,
+     "hash_ids": [int, ...]}
+
+`hash_ids` name the request's input blocks at `block_size` granularity;
+equal ids across requests = shared prefix.  Tokens beyond
+len(hash_ids) * block_size are the request's unique suffix.
+
+Two generators:
+
+- `TraceSynthesizer` learns a transition-counted prefix tree + empirical
+  length/interval distributions from a source trace and samples fresh
+  traces with the same structure (knobs: speedup_ratio for request rate,
+  prompt_len_multiplier for suffixes) — the reference's learn-and-sample
+  loop without the networkx dependency (a dict tree with CDF sampling is
+  the same machine).
+- `synthesize_prefix_heavy` builds a trace from scratch: R root contexts
+  (system prompts) of `context_blocks` blocks, each spawning requests
+  that share the root and diverge into unique suffixes — the canonical
+  router-benchmark workload.
+
+`tokens_for_record` reconstructs token ids such that equal hash_ids
+yield byte-identical blocks (deterministic per-id streams), so replayed
+requests hit real prefix caches exactly as the trace intends.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from bisect import bisect_right
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+DEFAULT_BLOCK_SIZE = 512
+_END = -1  # terminal pseudo-child in the transition tree
+
+
+@dataclass
+class TraceRecord:
+    timestamp: float            # ms since trace start
+    input_length: int
+    output_length: int
+    hash_ids: List[int]
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "timestamp": self.timestamp,
+            "input_length": self.input_length,
+            "output_length": self.output_length,
+            "hash_ids": self.hash_ids,
+        })
+
+
+def load_trace(path: str) -> List[TraceRecord]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            out.append(TraceRecord(
+                timestamp=float(d["timestamp"]),
+                input_length=int(d["input_length"]),
+                output_length=int(d["output_length"]),
+                hash_ids=[int(h) for h in d["hash_ids"]]))
+    return out
+
+
+def save_trace(records: Iterable[TraceRecord], path: str) -> None:
+    with open(path, "w") as f:
+        for r in records:
+            f.write(r.to_json() + "\n")
+
+
+def tokens_for_record(rec: TraceRecord, block_size: int,
+                      vocab_size: int = 32_000,
+                      unique_seed: int = 0) -> List[int]:
+    """Token ids whose block contents depend only on hash_ids — equal ids
+    replay to byte-identical blocks; the tail past the hashed prefix is
+    unique per (record timestamp, unique_seed)."""
+    toks: List[int] = []
+    for h in rec.hash_ids:
+        rng = random.Random(f"block:{h}")
+        toks.extend(rng.randrange(1, vocab_size)
+                    for _ in range(block_size))
+    tail = rec.input_length - len(toks)
+    if tail > 0:
+        rng = random.Random(f"tail:{rec.timestamp}:{unique_seed}")
+        toks.extend(rng.randrange(1, vocab_size) for _ in range(tail))
+    return toks[: rec.input_length]
+
+
+class _Cdf:
+    """Empirical distribution with CDF sampling."""
+
+    def __init__(self, values: List[float]) -> None:
+        self.values = sorted(values) or [0.0]
+
+    def sample(self, rng: random.Random) -> float:
+        return self.values[rng.randrange(len(self.values))]
+
+
+class TraceSynthesizer:
+    """Learn prefix structure + load statistics; sample fresh traces."""
+
+    def __init__(self, records: List[TraceRecord],
+                 block_size: int = DEFAULT_BLOCK_SIZE) -> None:
+        if not records:
+            raise ValueError("empty source trace")
+        self.block_size = block_size
+        # Transition counts: (parent path node) → child hash_id counts.
+        # Keyed by the hash id itself (mooncake ids are globally unique
+        # per content, so the id IS the path identity).
+        self.root_counts: Dict[int, int] = defaultdict(int)
+        self.children: Dict[int, Dict[int, int]] = defaultdict(
+            lambda: defaultdict(int))
+        suffixes, osls, intervals = [], [], []
+        prev_ts: Optional[float] = None
+        for r in sorted(records, key=lambda r: r.timestamp):
+            if r.hash_ids:
+                self.root_counts[r.hash_ids[0]] += 1
+                for a, b in zip(r.hash_ids, r.hash_ids[1:]):
+                    self.children[a][b] += 1
+                self.children[r.hash_ids[-1]][_END] += 1
+            suffixes.append(r.input_length
+                            - len(r.hash_ids) * block_size)
+            osls.append(r.output_length)
+            if prev_ts is not None:
+                intervals.append(max(0.0, r.timestamp - prev_ts))
+            prev_ts = r.timestamp
+        self.suffix_dist = _Cdf([max(0, s) for s in suffixes])
+        self.osl_dist = _Cdf([float(o) for o in osls])
+        self.interval_dist = _Cdf(intervals or [0.0])
+
+    @staticmethod
+    def _sample_weighted(counts: Dict[int, int],
+                         rng: random.Random) -> int:
+        keys = list(counts)
+        cum, total = [], 0
+        for k in keys:
+            total += counts[k]
+            cum.append(total)
+        return keys[bisect_right(cum, rng.randrange(total))]
+
+    def synthesize(self, num_requests: int, *,
+                   speedup_ratio: float = 1.0,
+                   prompt_len_multiplier: float = 1.0,
+                   seed: int = 0) -> List[TraceRecord]:
+        rng = random.Random(seed)
+        out: List[TraceRecord] = []
+        ts = 0.0
+        for _ in range(num_requests):
+            hash_ids: List[int] = []
+            if self.root_counts:
+                node = self._sample_weighted(self.root_counts, rng)
+                while True:
+                    hash_ids.append(node)
+                    nxt = self._sample_weighted(self.children[node], rng)
+                    if nxt == _END:
+                        break
+                    node = nxt
+            suffix = int(self.suffix_dist.sample(rng)
+                         * prompt_len_multiplier)
+            out.append(TraceRecord(
+                timestamp=ts,
+                input_length=len(hash_ids) * self.block_size + suffix,
+                output_length=max(1, int(self.osl_dist.sample(rng))),
+                hash_ids=hash_ids))
+            ts += self.interval_dist.sample(rng) / max(speedup_ratio, 1e-9)
+        return out
+
+
+def synthesize_prefix_heavy(
+    num_requests: int, *,
+    num_roots: int = 4,
+    context_blocks: int = 4,
+    suffix_tokens: int = 64,
+    output_tokens: int = 32,
+    interval_ms: float = 10.0,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    seed: int = 0,
+) -> List[TraceRecord]:
+    """From-scratch prefix-heavy trace: each request picks one of
+    `num_roots` shared contexts (`context_blocks` blocks long) and adds a
+    unique suffix — the shape of multi-tenant system-prompt serving."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(num_requests):
+        root = rng.randrange(num_roots)
+        ids = [root * 1_000_003 + b for b in range(context_blocks)]
+        out.append(TraceRecord(
+            timestamp=i * interval_ms,
+            input_length=context_blocks * block_size + suffix_tokens,
+            output_length=output_tokens,
+            hash_ids=ids))
+    return out
+
+
+@dataclass
+class PrefixStats:
+    """Theoretical (infinite-cache) reuse statistics of a trace — the
+    reference `prefix_analyzer.py` report."""
+
+    num_requests: int = 0
+    total_input_tokens: int = 0
+    total_hashed_tokens: int = 0
+    total_reused_tokens: int = 0
+    unique_blocks: int = 0
+    per_request_hit_rate: List[float] = field(default_factory=list)
+
+    @property
+    def token_reuse_rate(self) -> float:
+        return (self.total_reused_tokens / self.total_input_tokens
+                if self.total_input_tokens else 0.0)
+
+    def to_dict(self) -> dict:
+        n = self.num_requests
+        return {
+            "num_requests": n,
+            "total_input_tokens": self.total_input_tokens,
+            "token_reuse_rate": round(self.token_reuse_rate, 4),
+            "unique_blocks": self.unique_blocks,
+            "mean_request_hit_rate": round(
+                sum(self.per_request_hit_rate) / n, 4) if n else 0.0,
+        }
+
+
+def analyze_prefixes(records: List[TraceRecord],
+                     block_size: int = DEFAULT_BLOCK_SIZE) -> PrefixStats:
+    seen: set = set()
+    st = PrefixStats()
+    for r in sorted(records, key=lambda r: r.timestamp):
+        st.num_requests += 1
+        st.total_input_tokens += r.input_length
+        st.total_hashed_tokens += len(r.hash_ids) * block_size
+        reused = sum(1 for h in r.hash_ids if h in seen)
+        st.total_reused_tokens += reused * block_size
+        st.per_request_hit_rate.append(
+            reused * block_size / r.input_length if r.input_length else 0.0)
+        seen.update(r.hash_ids)
+    st.unique_blocks = len(seen)
+    return st

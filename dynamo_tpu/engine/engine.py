@@ -1074,6 +1074,18 @@ class EngineCore:
                 out[h] = data
         return out
 
+    def export_blocks_device(self, hashes) -> Dict[int, object]:
+        """G1-resident blocks as DEVICE arrays (the device-direct transfer
+        plane's extract side; no host staging).  Engine thread only."""
+        out: Dict[int, object] = {}
+        if not self._managed_cache:
+            return out
+        for h in hashes:
+            data = self.allocator.manager.export_block_device(h)
+            if data is not None:
+                out[h] = data
+        return out
+
     def import_blocks(self, blocks: Dict[int, np.ndarray]) -> int:
         """Inject fetched blocks into G1 as registered prefix-cache entries;
         a subsequent add_request with the matching prompt prefix skips
@@ -1303,6 +1315,16 @@ class InferenceEngine:
         return np.concatenate(rows, axis=0) if rows else np.zeros((0, 0))
 
     async def import_blocks(self, blocks) -> int:
+        return await self.run_in_engine(
+            lambda: self.core.import_blocks(blocks))
+
+    async def export_blocks_device(self, hashes) -> Dict[int, object]:
+        return await self.run_in_engine(
+            lambda: self.core.export_blocks_device(hashes))
+
+    async def import_blocks_device(self, blocks) -> int:
+        # The inject op consumes device arrays directly (jnp.asarray is a
+        # no-op for them) — same core path, zero host staging.
         return await self.run_in_engine(
             lambda: self.core.import_blocks(blocks))
 

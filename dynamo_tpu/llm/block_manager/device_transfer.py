@@ -1,0 +1,265 @@
+"""Device-direct KV transfer plane (the NIXL analog, device edition).
+
+The reference's data plane is RDMA-registered memory with descriptor
+exchange (`lib/llm/src/block_manager/storage/nixl.rs:403`,
+`docs/architecture/disagg_serving.md:70-99`): workers register buffers
+with NIXL, publish metadata to etcd, and peers pull blocks NIC-to-NIC
+without host staging.  The TPU-native equivalent built here rides
+`jax.experimental.transfer` — PJRT's point-to-point transfer service
+(DCN/ICI transport on real TPU fleets, TCP on CPU test rigs):
+
+- every worker runs one `TransferServer`; its listen address is the
+  transfer descriptor root, published on the control plane under
+  `transfer/{namespace}/{instance_id}` (the etcd-metadata analog);
+- the HOLDER stages G1-resident device blocks for pull under a fresh
+  uuid (`await_pull`) and answers an `kv_offer` RPC with
+  {uuid, address, hashes, shape, dtype} — the per-transfer descriptor;
+- the PULLER connects (cached per peer address) and pulls the arrays
+  device-to-device, then injects them into its own G1 as registered
+  prefix-cache entries.  No numpy ever materialises on either host.
+
+The host-staged msgpack path (transfer.py) remains the fallback for
+blocks that have been offloaded out of G1 (G2/G3 bytes live on the host
+anyway) and for peers without a transfer plane — mirroring the
+reference's per-tier transfer-strategy selection
+(`block_manager/transfer/strategy.rs`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Dict, Iterable, List, Optional
+
+logger = logging.getLogger(__name__)
+
+KV_OFFER_ENDPOINT = "kv_offer"
+KV_PULLED_ENDPOINT = "kv_pulled"
+
+# Staged-offer cap: await_pull pins device arrays until the peer pulls,
+# and this jax version has no un-stage API — a peer that dies between
+# offer and pull strands that offer's blocks.  Refusing offers past the
+# cap (callers fall back to the host-staged plane) bounds the strandable
+# memory; pullers ack via KV_PULLED to retire the accounting.
+MAX_OUTSTANDING_OFFERS = 32
+
+
+def _routable_host() -> str:
+    """Best-effort routable address for descriptor advertisement (the
+    transfer server binds the wildcard; peers can't dial 0.0.0.0)."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))  # no traffic; routing lookup only
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def _jnp_dtype(name: str):
+    if name == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    import numpy as np
+
+    return np.dtype(name)
+
+
+_process_server = None
+# Process-wide uuid space: planes share the singleton server, so staged
+# transfers must not collide across planes.
+_uuid_counter = itertools.count(1)
+
+
+def _get_transfer_server():
+    """ONE TransferServer per process: PJRT's local bulk transport
+    CHECK-fails when two servers share a process, and one listener serves
+    any number of planes/engines anyway (connections are per peer).
+
+    Explicit TCP transport addresses: the default (empty) advertises the
+    same-PROCESS shared-memory bulk transport, which CHECK-fails for a
+    same-host cross-process peer; socket transport serves both same-host
+    and DCN peers."""
+    global _process_server
+    if _process_server is None:
+        import jax
+        from jax.experimental import transfer
+
+        client = jax.devices()[0].client
+        _process_server = transfer.start_transfer_server(
+            client, "0.0.0.0:0", ["0.0.0.0:0"])
+    return _process_server
+
+
+class KvTransferPlane:
+    """One per worker process: holder + puller halves of the device plane.
+
+    `engine` is an InferenceEngine (async export/import of device blocks);
+    deviceless callers (tests) may pass None and use stage/pull directly.
+    """
+
+    def __init__(self, engine=None) -> None:
+        self.engine = engine
+        self._server = None
+        self._conns: Dict[str, object] = {}
+        self._outstanding: Dict[int, int] = {}  # uuid → staged blocks
+        # Observability (tests + metrics).
+        self.offers = 0
+        self.refused_offers = 0
+        self.pulled_blocks = 0
+
+    def start(self) -> str:
+        self._server = _get_transfer_server()
+        return self.address
+
+    @property
+    def address(self) -> str:
+        addr = self._server.address()
+        host, _, port = addr.rpartition(":")
+        if host in ("0.0.0.0", "[::]", "::"):
+            return f"{_routable_host()}:{port}"
+        return addr
+
+    def stop(self) -> None:
+        # The process-singleton TransferServer has no explicit shutdown in
+        # this jax version; drop per-plane references only.
+        self._conns.clear()
+        self._server = None
+
+    # -- holder side -------------------------------------------------------
+
+    def stage(self, blocks: Dict[int, object],
+              order: Iterable[int]) -> Optional[dict]:
+        """Stage device arrays for one pull; returns the descriptor, or
+        None when the outstanding-offer cap is hit (the caller falls back
+        to the host-staged plane rather than stranding more memory)."""
+        present = [h for h in order if h in blocks]
+        if not present:
+            return None
+        if len(self._outstanding) >= MAX_OUTSTANDING_OFFERS:
+            self.refused_offers += 1
+            logger.warning("device transfer: %d offers outstanding "
+                           "(unpulled); refusing until peers ack",
+                           len(self._outstanding))
+            return None
+        arrays = [blocks[h] for h in present]
+        uid = next(_uuid_counter)
+        self._server.await_pull(uid, arrays)
+        self._outstanding[uid] = len(present)
+        self.offers += 1
+        a0 = arrays[0]
+        return {
+            "uuid": uid,
+            "address": self.address,
+            "hashes": present,
+            "shape": list(a0.shape),
+            "dtype": str(a0.dtype),
+        }
+
+    def mark_pulled(self, uid: int) -> None:
+        self._outstanding.pop(uid, None)
+
+    async def offer(self, hashes: List[int]) -> Optional[dict]:
+        """Export G1-resident blocks as device arrays and stage them."""
+        blocks = await self.engine.export_blocks_device(hashes)
+        return self.stage(blocks, hashes)
+
+    def make_offer_handler(self):
+        """RPC handler for KV_OFFER_ENDPOINT: {"hashes": [...]} → one
+        descriptor delta ({} when nothing is resident in G1 or the offer
+        cap is hit — the caller falls back to the host-staged kv_blocks
+        plane)."""
+
+        async def handler(payload: dict):
+            meta = await self.offer(payload.get("hashes", []))
+            yield meta if meta is not None else {}
+
+        return handler
+
+    def make_pulled_handler(self):
+        """RPC handler for KV_PULLED_ENDPOINT: the puller's ack retiring
+        the offer from the outstanding accounting."""
+
+        async def handler(payload: dict):
+            self.mark_pulled(payload.get("uuid"))
+            yield {"ok": True}
+
+        return handler
+
+    # -- puller side -------------------------------------------------------
+
+    def _connect(self, address: str):
+        conn = self._conns.get(address)
+        if conn is None:
+            conn = self._conns[address] = self._server.connect(address)
+        return conn
+
+    async def pull(self, meta: dict) -> Dict[int, object]:
+        """Pull the staged arrays device-to-device; returns hash → array."""
+        import jax
+
+        if not meta or meta.get("uuid") is None:
+            return {}
+        conn = self._connect(meta["address"])
+        dev = jax.devices()[0]
+        sds = [
+            jax.ShapeDtypeStruct(
+                tuple(meta["shape"]), _jnp_dtype(meta["dtype"]),
+                sharding=jax.sharding.SingleDeviceSharding(dev))
+            for _ in meta["hashes"]
+        ]
+        try:
+            # The pull blocks until bytes land; keep the event loop free.
+            arrays = await asyncio.to_thread(conn.pull, meta["uuid"], sds)
+        except Exception:
+            # A cached connection to a restarted peer stays dead forever;
+            # evict so the next pull re-dials.
+            self._conns.pop(meta["address"], None)
+            raise
+        self.pulled_blocks += len(arrays)
+        return dict(zip(meta["hashes"], arrays))
+
+
+async def pull_prefix_device(engine, plane: KvTransferPlane, rpc_client,
+                             prompt_tokens: List[int],
+                             block_size: int) -> int:
+    """Device-direct onboard of a peer's sealed prompt blocks: request a
+    descriptor over the RPC plane, pull device-to-device, inject.  Returns
+    tokens covered; 0 when the peer offered nothing (caller falls back to
+    the host-staged pull or local prefill)."""
+    from dynamo_tpu.tokens import compute_block_hashes
+
+    n_sealed = len(prompt_tokens) // block_size
+    if n_sealed == 0:
+        return 0
+    hashes = compute_block_hashes(prompt_tokens[: n_sealed * block_size],
+                                  block_size)
+    meta = None
+    async for msg in rpc_client.call(KV_OFFER_ENDPOINT, {"hashes": hashes}):
+        meta = msg
+    if not meta or meta.get("uuid") is None:
+        return 0
+    blocks = await plane.pull(meta)
+    # Ack the pull so the holder retires the offer from its outstanding
+    # accounting (fire-and-forget: a lost ack only consumes cap slack).
+    try:
+        async for _ in rpc_client.call(KV_PULLED_ENDPOINT,
+                                       {"uuid": meta["uuid"]}):
+            pass
+    except Exception:
+        pass
+    # Inject the longest contiguous prefix only — a gap breaks the chain.
+    contiguous = {}
+    for h in hashes:
+        if h not in blocks:
+            break
+        contiguous[h] = blocks[h]
+    if not contiguous:
+        return 0
+    await engine.import_blocks_device(contiguous)
+    return len(contiguous) * block_size

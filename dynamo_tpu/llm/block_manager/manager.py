@@ -257,6 +257,17 @@ class KvBlockManager:
                 return np.array(self._disk_data[dslot.index])
         return None
 
+    def export_block_device(self, block_hash: int):
+        """G1-resident block as a DEVICE array (no host staging) — the
+        extract side of the device-direct transfer plane
+        (device_transfer.py).  None when the block lives only in G2/G3
+        (those bytes are host-resident anyway; the host-staged path
+        serves them)."""
+        slot = self.device.registry.lookup(block_hash)
+        if slot is not None and self.extract_fn is not None:
+            return self.extract_fn(slot.index)
+        return None
+
     def import_block(self, block_hash: int, data: np.ndarray) -> bool:
         """Inject a fetched block into G1 and register it (inactive,
         matchable) — the onboard side of a remote transfer.  Returns False

@@ -163,15 +163,21 @@ class DisaggDecodeClient:
 
     def __init__(self, inner, engine, cp, namespace: str,
                  block_size: int, *,
-                 prefill_timeout: float = 120.0) -> None:
+                 prefill_timeout: float = 120.0,
+                 transfer_plane=None) -> None:
         """`inner`: the local EngineClient; `engine`: the InferenceEngine
-        (import_blocks side of the data plane)."""
+        (import_blocks side of the data plane); `transfer_plane`: the
+        device-direct KvTransferPlane when this worker runs one — blocks
+        then cross device-to-device, the host-staged pull remaining the
+        fallback."""
         self.inner = inner
         self.engine = engine
         self.cp = cp
         self.namespace = namespace
         self.block_size = block_size
         self.prefill_timeout = prefill_timeout
+        self.transfer_plane = transfer_plane
+        self.device_pulls = 0
         self._waiters: Dict[str, asyncio.Future] = {}
         self._rpc_clients: Dict[str, RpcClient] = {}
         self._sub = None
@@ -223,13 +229,41 @@ class DisaggDecodeClient:
                 "token_ids": list(request.token_ids),
             })
             done = await asyncio.wait_for(fut, self.prefill_timeout)
-            onboarded = await pull_prefix(
-                self.engine, self._rpc(done["address"]),
-                list(request.token_ids), self.block_size)
+            onboarded = 0
+            path = "host-staged"
+            if self.transfer_plane is not None:
+                # Device-direct first (NIXL-analog pull, no host hop);
+                # any failure falls through to the host-staged plane.
+                from dynamo_tpu.llm.block_manager.device_transfer import (
+                    pull_prefix_device)
+
+                try:
+                    onboarded = await pull_prefix_device(
+                        self.engine, self.transfer_plane,
+                        self._rpc(done["address"]),
+                        list(request.token_ids), self.block_size)
+                except (ConnectionError, OSError, RpcError,
+                        RuntimeError) as e:
+                    logger.warning("device-direct pull %s failed (%s); "
+                                   "using host-staged plane", rid, e)
+                if onboarded:
+                    self.device_pulls += 1
+                    path = "device-direct"
+            sealed = (len(request.token_ids) // self.block_size
+                      * self.block_size)
+            if onboarded < sealed:
+                # Host-staged plane covers what the device pull didn't:
+                # blocks offloaded to G2/G3 live host-side anyway (and a
+                # failed device pull covers nothing).  import skips the
+                # already-onboarded prefix.
+                host_onboarded = await pull_prefix(
+                    self.engine, self._rpc(done["address"]),
+                    list(request.token_ids), self.block_size)
+                onboarded = max(onboarded, host_onboarded)
             self.remote_prefills += 1
             self.tokens_onboarded += onboarded
-            logger.info("remote prefill %s: %d tokens onboarded from %s",
-                        rid, onboarded, done["address"])
+            logger.info("remote prefill %s: %d tokens onboarded from %s "
+                        "(%s)", rid, onboarded, done["address"], path)
         except (asyncio.TimeoutError, ConnectionError, OSError,
                 RpcError) as e:
             # RpcError: the peer's kv_blocks handler failed (e.g. blocks

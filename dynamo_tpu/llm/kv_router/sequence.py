@@ -150,19 +150,19 @@ class ActiveSequencesMultiWorker:
     def mark_prefill_complete(self, request_id: str) -> None:
         with self._lock:
             w = self._request_worker.get(request_id)
-            if w:
+            if w is not None:  # worker id 0 is falsy but real
                 self._worker(w).mark_prefill_complete(request_id)
 
     def push_token(self, request_id: str, n: int = 1) -> None:
         with self._lock:
             w = self._request_worker.get(request_id)
-            if w:
+            if w is not None:  # worker id 0 is falsy but real
                 self._worker(w).push_token(request_id, n)
 
     def free(self, request_id: str) -> None:
         with self._lock:
             w = self._request_worker.pop(request_id, None)
-            if w:
+            if w is not None:  # worker id 0 is falsy but real
                 self._worker(w).free(request_id)
 
     def remove_worker(self, worker: WorkerId) -> None:

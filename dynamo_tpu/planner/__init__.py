@@ -27,7 +27,14 @@ from dynamo_tpu.planner.connector import LocalConnector
 from dynamo_tpu.planner.predictor import (
     ConstantPredictor,
     MovingAveragePredictor,
+    TrendPredictor,
     make_predictor,
+)
+from dynamo_tpu.planner.sla import (
+    PrometheusScraper,
+    SlaObservation,
+    SlaPlanner,
+    SlaPlannerConfig,
 )
 
 __all__ = [
@@ -36,5 +43,10 @@ __all__ = [
     "LocalConnector",
     "ConstantPredictor",
     "MovingAveragePredictor",
+    "TrendPredictor",
     "make_predictor",
+    "SlaPlanner",
+    "SlaPlannerConfig",
+    "SlaObservation",
+    "PrometheusScraper",
 ]

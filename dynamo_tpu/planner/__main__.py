@@ -19,14 +19,30 @@ from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneClient
 def main(argv=None) -> None:
     p = argparse.ArgumentParser("dynamo_tpu.planner")
     p.add_argument("--control-plane", required=True, help="HOST:PORT")
+    p.add_argument("--mode", choices=("load", "sla"), default="load")
     p.add_argument("--min-replicas", type=int, default=1)
     p.add_argument("--max-replicas", type=int, default=8)
     p.add_argument("--kv-high", type=float, default=0.8)
     p.add_argument("--kv-low", type=float, default=0.3)
     p.add_argument("--adjustment-interval", type=float, default=5.0)
+    # SLA mode (reference planner_sla.py): profile + targets + the
+    # frontend exposition to scrape.
+    p.add_argument("--profile", default=None,
+                   help="sla: profile JSON from dynamo_tpu.planner.profiler")
+    p.add_argument("--ttft", type=float, default=0.5,
+                   help="sla: target time-to-first-token (s)")
+    p.add_argument("--itl", type=float, default=0.05,
+                   help="sla: target inter-token latency (s)")
+    p.add_argument("--metrics-url", default=None,
+                   help="sla: frontend /metrics URL to observe")
+    p.add_argument("--prefill-worker-args", default=None,
+                   help="sla: comma-joined args for the prefill pool "
+                        "(omit for aggregated deployments)")
     p.add_argument("worker_args", nargs="*",
                    help="args after -- go to spawned workers")
     args = p.parse_args(argv)
+    if args.mode == "sla" and (not args.profile or not args.metrics_url):
+        p.error("--mode sla needs --profile and --metrics-url")
     logging.basicConfig(level=logging.INFO)
 
     async def run():
@@ -35,11 +51,32 @@ def main(argv=None) -> None:
         await cp.start()
         connector = LocalConnector(args.control_plane,
                                    worker_args=args.worker_args)
-        planner = LoadPlanner(cp, connector, PlannerConfig(
-            min_replicas=args.min_replicas,
-            max_replicas=args.max_replicas,
-            kv_high=args.kv_high, kv_low=args.kv_low,
-            adjustment_interval=args.adjustment_interval))
+        if args.mode == "sla":
+            from dynamo_tpu.planner import (
+                PrometheusScraper, SlaPlanner, SlaPlannerConfig)
+            from dynamo_tpu.planner.interpolation import load_profile
+
+            prefill_connector = None
+            if args.prefill_worker_args is not None:
+                prefill_connector = LocalConnector(
+                    args.control_plane,
+                    worker_args=args.prefill_worker_args.split(","))
+            planner = SlaPlanner(
+                load_profile(args.profile),
+                PrometheusScraper(args.metrics_url).observe,
+                decode_connector=connector,
+                prefill_connector=prefill_connector,
+                config=SlaPlannerConfig(
+                    ttft_s=args.ttft, itl_s=args.itl,
+                    adjustment_interval_s=args.adjustment_interval,
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.max_replicas))
+        else:
+            planner = LoadPlanner(cp, connector, PlannerConfig(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                kv_high=args.kv_high, kv_low=args.kv_low,
+                adjustment_interval=args.adjustment_interval))
         await planner.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
@@ -48,6 +85,9 @@ def main(argv=None) -> None:
         await stop.wait()
         await planner.stop()
         await connector.shutdown()
+        pc = getattr(planner, "prefill_connector", None)
+        if pc is not None:
+            await pc.shutdown()
         await cp.close()
 
     asyncio.run(run())

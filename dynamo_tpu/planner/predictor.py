@@ -37,10 +37,39 @@ class MovingAveragePredictor:
         return sum(self._buf) / len(self._buf) if self._buf else 0.0
 
 
+class TrendPredictor:
+    """Next value = linear extrapolation over the last `window` points —
+    the lightweight stand-in for the reference's ARIMA rung (it catches
+    the monotone ramps an autoscaler must lead, without statsmodels)."""
+
+    def __init__(self, window: int = 8) -> None:
+        self._buf: Deque[float] = deque(maxlen=window)
+
+    def add_data_point(self, value: float) -> None:
+        self._buf.append(float(value))
+
+    def predict_next(self) -> float:
+        n = len(self._buf)
+        if n == 0:
+            return 0.0
+        if n == 1:
+            return self._buf[0]
+        xs = range(n)
+        mean_x = (n - 1) / 2.0
+        mean_y = sum(self._buf) / n
+        cov = sum((x - mean_x) * (y - mean_y)
+                  for x, y in zip(xs, self._buf))
+        var = sum((x - mean_x) ** 2 for x in xs)
+        slope = cov / var if var else 0.0
+        return max(0.0, mean_y + slope * (n - mean_x))
+
+
 def make_predictor(kind: str = "moving_average", **kw):
     if kind == "constant":
         return ConstantPredictor()
     if kind == "moving_average":
         return MovingAveragePredictor(**kw)
+    if kind == "trend":
+        return TrendPredictor(**kw)
     raise ValueError(f"unknown predictor {kind!r} "
-                     "(have: constant, moving_average)")
+                     "(have: constant, moving_average, trend)")

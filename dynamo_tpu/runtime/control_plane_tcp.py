@@ -253,6 +253,8 @@ class ControlPlaneClient:
         self._keepalive_tasks: Dict[int, asyncio.Task] = {}
         self._send_lock = asyncio.Lock()
         self._closed = False
+        self._reconnecting = False
+        self._conn_gen = 0  # bumps per (re)connect; stale rx loops exit
 
     async def start(self) -> None:
         self._closed = False
@@ -275,24 +277,34 @@ class ControlPlaneClient:
         if self._writer:
             self._writer.close()
 
-    def _fail_all(self, exc: Exception) -> None:
-        """Connection is gone: fail pending calls AND poison stream queues,
-        so watchers/subscribers surface the outage instead of waiting on a
-        frozen queue forever."""
+    def _fail_pending(self, exc: Exception) -> None:
         for fut in self._pending.values():
             if not fut.done():
                 fut.set_exception(exc)
         self._pending.clear()
+
+    def _fail_all(self, exc: Exception) -> None:
+        """Connection is gone: fail pending calls AND poison stream queues
+        ONCE, so watchers/subscribers surface the outage (one
+        ConnectionError per outage, not per reconnect attempt) instead of
+        waiting on a frozen queue forever."""
+        self._fail_pending(exc)
         for w in self._watches.values():
             w.queue.put_nowait(_POISON)
         for s in self._subs.values():
             s.queue.put_nowait(_POISON)
 
     async def _rx_loop(self) -> None:
-        assert self._reader is not None
+        # Capture this connection's identity: after a reconnect a stale rx
+        # loop must neither read the NEW socket nor trigger another
+        # reconnect (two live loops would clobber _reader/_writer and
+        # double-register every sid).
+        reader = self._reader
+        gen = self._conn_gen
+        assert reader is not None
         try:
             while True:
-                line = await self._reader.readline()
+                line = await reader.readline()
                 if not line:
                     break
                 msg = json.loads(line)
@@ -312,36 +324,60 @@ class ControlPlaneClient:
                         fut.set_result(msg)
         except (ConnectionResetError, OSError):
             pass
-        if self._closed:
-            return
+        if self._closed or gen != self._conn_gen:
+            return  # shut down, or a newer connection owns the client
         self._fail_all(ConnectionError("control plane gone"))
         self._writer = None  # _call fails fast until reconnected
+        self._schedule_reconnect()
+
+    def _schedule_reconnect(self) -> None:
+        if self._closed or self._reconnecting:
+            return
+        self._reconnecting = True
         self._reconnect_task = asyncio.create_task(self._reconnect_loop())
 
     async def _reconnect_loop(self) -> None:
         backoff = 0.5
-        while not self._closed:
-            try:
-                self._reader, self._writer = await asyncio.open_connection(
-                    self.host, self.port)
-            except OSError:
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 15.0)
-                continue
-            self._rx_task = asyncio.create_task(self._rx_loop())
-            try:
-                # Re-establish stream state under the original sids: the
-                # server replays watch state as synthetic puts; sub
-                # streams simply resume from now.
-                for sid, w in list(self._watches.items()):
-                    await self._call("watch", prefix=w.prefix, sid=sid)
-                for sid, s in list(self._subs.items()):
-                    await self._call("subscribe", subject=s.subject, sid=sid)
-            except Exception:
-                continue  # connection died again: dial once more
-            logger.info("control plane reconnected (%d watches, %d subs "
-                        "restored)", len(self._watches), len(self._subs))
-            return
+        try:
+            while not self._closed:
+                # Each attempt owns a fresh generation; rx loops of prior
+                # attempts see the bump and exit silently, and their
+                # pending calls are failed here rather than left hanging.
+                # (Streams were poisoned once at outage time — retries
+                # must not spam consumers with more ConnectionErrors.)
+                self._fail_pending(ConnectionError(
+                    "control plane reconnecting"))
+                try:
+                    self._reader, self._writer = \
+                        await asyncio.open_connection(self.host, self.port)
+                except OSError:
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 15.0)
+                    continue
+                self._conn_gen += 1
+                self._rx_task = asyncio.create_task(self._rx_loop())
+                try:
+                    # Re-establish stream state under the original sids:
+                    # the server replays watch state as synthetic puts;
+                    # sub streams simply resume from now.
+                    for sid, w in list(self._watches.items()):
+                        await asyncio.wait_for(
+                            self._call("watch", prefix=w.prefix, sid=sid),
+                            10.0)
+                    for sid, s in list(self._subs.items()):
+                        await asyncio.wait_for(
+                            self._call("subscribe", subject=s.subject,
+                                       sid=sid), 10.0)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    continue  # connection died again: dial once more
+                logger.info("control plane reconnected (%d watches, %d "
+                            "subs restored)", len(self._watches),
+                            len(self._subs))
+                return
+        finally:
+            self._reconnecting = False
 
     async def _call(self, op: str, **kw) -> dict:
         if self._writer is None or self._writer.is_closing():

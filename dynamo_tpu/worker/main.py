@@ -178,6 +178,7 @@ async def run(args) -> None:
 
     engine, metrics_fn, shutdown, card_fields, transfer_engine = \
         await build_engine(args, kv_event_sink)
+    transfer_plane = None
     if transfer_engine is not None:
         from dynamo_tpu.llm.block_manager.transfer import (
             KV_BLOCKS_ENDPOINT, make_kv_blocks_handler)
@@ -190,6 +191,22 @@ async def run(args) -> None:
         runtime.rpc.register(EMBED_ENDPOINT, embed_wire_handler(engine))
         runtime.rpc.register(CLEAR_KV_ENDPOINT,
                              clear_kv_wire_handler(engine))
+        if args.tp * args.dp * args.ep == 1:
+            # Device-direct transfer plane (NIXL analog): blocks cross
+            # worker↔worker device-to-device via PJRT's transfer service;
+            # the host-staged kv_blocks plane stays as fallback.  (v1 is
+            # single-device engines; sharded-cache staging is the next
+            # step.)
+            from dynamo_tpu.llm.block_manager.device_transfer import (
+                KV_OFFER_ENDPOINT, KV_PULLED_ENDPOINT, KvTransferPlane)
+
+            transfer_plane = KvTransferPlane(transfer_engine)
+            taddr = transfer_plane.start()
+            runtime.rpc.register(KV_OFFER_ENDPOINT,
+                                 transfer_plane.make_offer_handler())
+            runtime.rpc.register(KV_PULLED_ENDPOINT,
+                                 transfer_plane.make_pulled_handler())
+            logger.info("device transfer plane on %s", taddr)
 
     disagg_client = None
     prefill_task = None
@@ -207,13 +224,18 @@ async def run(args) -> None:
             await cp.put(disagg_config_key(args.namespace),
                          {"max_local_prefill_length": args.max_local_prefill})
         disagg_client = DisaggDecodeClient(
-            engine, transfer_engine, cp, args.namespace, args.block_size)
+            engine, transfer_engine, cp, args.namespace, args.block_size,
+            transfer_plane=transfer_plane)
         await disagg_client.start()
         serve_client = disagg_client
     else:
         serve_client = engine
 
     instance = await endpoint.serve(engine_wire_handler(serve_client))
+    # (Transfer-plane discovery needs no control-plane record: the peer's
+    # RPC address is already the instance record, and the per-transfer
+    # descriptor — uuid + transfer address — travels in the kv_offer
+    # reply, the NIXL-metadata analog.)
     if args.role == "prefill":
         # Prefill workers serve the queue, not the routed model: no
         # register_llm, so frontends never route decode traffic here

@@ -1,0 +1,157 @@
+"""Device-direct KV transfer plane (the NIXL analog, device edition).
+
+Same-process: worker A stages G1-resident device blocks, worker B pulls
+them device-to-device through the PJRT transfer service and serves the
+prompt with prefill skipped — no numpy hop on either side.
+
+Two-process: a holder process stages blocks and prints its descriptor; a
+puller process in a separate OS process pulls over localhost — the CPU
+stand-in for the cross-host DCN path (the driver's multi-chip dryrun
+model, SURVEY §7 'riskiest novel component')."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.engine.engine import EngineConfig, EngineCore, InferenceEngine
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.llm.block_manager.device_transfer import (
+    KV_OFFER_ENDPOINT,
+    KvTransferPlane,
+    pull_prefix_device,
+)
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
+from dynamo_tpu.tokens import compute_block_hashes
+
+TINY = mcfg.get_config("tiny-test")
+BS = 8
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _core():
+    return EngineCore(EngineConfig(
+        model=TINY, num_blocks=64,
+        scheduler=SchedulerConfig(
+            max_seqs=4, block_size=BS, max_pages_per_seq=8,
+            max_prefill_chunk=16,
+            decode_buckets=(1, 2, 4), prefill_buckets=(8, 16))))
+
+
+def test_device_pull_between_engines_same_process():
+    prompt = list(range(40, 70))  # 3 sealed blocks + tail
+
+    async def main():
+        core_a, core_b = _core(), _core()
+        eng_a, eng_b = InferenceEngine(core_a), InferenceEngine(core_b)
+        await eng_a.start()
+        await eng_b.start()
+
+        plane_a = KvTransferPlane(eng_a)
+        plane_a.start()
+        plane_b = KvTransferPlane(eng_b)
+        plane_b.start()
+
+        server = RpcServer()
+        server.register(KV_OFFER_ENDPOINT, plane_a.make_offer_handler())
+        addr = await server.start()
+
+        out_a = []
+        async for d in eng_a.generate("a", prompt,
+                                      SamplingParams(max_tokens=4)):
+            out_a.extend(d.token_ids)
+
+        client = RpcClient(addr)
+        covered = await pull_prefix_device(eng_b, plane_b, client, prompt,
+                                           BS)
+        assert covered == 24  # 3 sealed blocks of 8
+        assert plane_a.offers == 1
+        assert plane_b.pulled_blocks == 3
+
+        out_b = []
+        async for d in eng_b.generate("b", prompt,
+                                      SamplingParams(max_tokens=4)):
+            out_b.extend(d.token_ids)
+        assert out_b == out_a
+        assert core_b.allocator.manager.device.hits >= 3
+
+        # Unknown hashes: empty offer, puller reports 0 (fallback signal).
+        covered = await pull_prefix_device(
+            eng_b, plane_b, client, list(range(200, 216)), BS)
+        assert covered == 0
+
+        await client.close()
+        await server.stop()
+        await eng_a.stop()
+        await eng_b.stop()
+        return True
+
+    assert asyncio.run(asyncio.wait_for(main(), timeout=120))
+
+
+_HOLDER = r"""
+import sys, json
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from dynamo_tpu.llm.block_manager.device_transfer import KvTransferPlane
+
+plane = KvTransferPlane()
+plane.start()
+blocks = {{h: jnp.full((2, 2, 8, 16), h, jnp.float32) for h in (11, 22, 33)}}
+meta = plane.stage(blocks, [11, 22, 33])
+print("META " + json.dumps(meta), flush=True)
+sys.stdin.readline()  # stay alive until the puller is done
+"""
+
+_PULLER = r"""
+import sys, json, asyncio
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from dynamo_tpu.llm.block_manager.device_transfer import KvTransferPlane
+
+meta = json.loads(sys.argv[1])
+plane = KvTransferPlane()
+plane.start()
+blocks = asyncio.run(plane.pull(meta))
+ok = sorted(blocks) == [11, 22, 33] and all(
+    np.allclose(np.asarray(v), h) for h, v in blocks.items())
+print("PULL_OK" if ok else "PULL_BAD", flush=True)
+"""
+
+
+@pytest.mark.e2e
+def test_device_pull_across_processes():
+    """The DCN-path dryrun: holder and puller are separate OS processes;
+    blocks cross via the PJRT transfer service over localhost."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    holder = subprocess.Popen(
+        [sys.executable, "-c", _HOLDER.format(repo=REPO)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env)
+    try:
+        line = holder.stdout.readline().strip()
+        assert line.startswith("META "), line
+        meta = json.loads(line[5:])
+        assert meta["uuid"] and meta["hashes"] == [11, 22, 33]
+
+        out = subprocess.run(
+            [sys.executable, "-c", _PULLER.format(repo=REPO),
+             json.dumps(meta)],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert "PULL_OK" in out.stdout, (out.stdout, out.stderr[-2000:])
+    finally:
+        try:
+            holder.stdin.write("\n")
+            holder.stdin.flush()
+        except Exception:
+            pass
+        holder.terminate()
+        holder.wait(timeout=10)

@@ -177,3 +177,54 @@ def test_disagg_threshold_hot_reload():
             await cp.close()
 
     _run(main())
+
+
+def test_disagg_device_direct_data_plane():
+    """Disagg e2e where KV crosses on the DEVICE plane (VERDICT r3
+    next-3): the decode side pulls the prefill worker's blocks through
+    the PJRT transfer service — no host msgpack hop — with the
+    host-staged plane untouched (device_pulls proves the path taken)."""
+    from dynamo_tpu.llm.block_manager.device_transfer import (
+        KV_OFFER_ENDPOINT, KvTransferPlane)
+
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        await cp.put(disagg_config_key(NS), {"max_local_prefill_length": 12})
+
+        prefill = await _Worker().start()
+        prefill_plane = KvTransferPlane(prefill.engine)
+        prefill_plane.start()
+        prefill.rpc.register(KV_OFFER_ENDPOINT,
+                             prefill_plane.make_offer_handler())
+        decode = await _Worker().start()
+        decode_plane = KvTransferPlane(decode.engine)
+        decode_plane.start()
+        ploop = asyncio.create_task(prefill_worker_loop(
+            cp, NS, prefill.client, prefill.address))
+
+        dec = DisaggDecodeClient(decode.client, decode.engine, cp, NS, BS,
+                                 transfer_plane=decode_plane)
+        await dec.start()
+        try:
+            ref = await _Worker().start()
+            long_prompt = list(range(1, 28))  # 3 sealed blocks + tail
+            want = await _collect(ref.client, "ref", long_prompt)
+            await ref.stop()
+
+            got = await _collect(dec, "r1", long_prompt)
+            assert got == want
+            assert dec.remote_prefills == 1 and dec.local_fallbacks == 0
+            assert dec.device_pulls == 1          # device path carried it
+            assert dec.tokens_onboarded == 24
+            assert prefill_plane.offers == 1
+            assert decode_plane.pulled_blocks == 3
+            assert decode.engine.core.allocator.manager.onboarded_blocks == 3
+        finally:
+            ploop.cancel()
+            await dec.stop()
+            await prefill.stop()
+            await decode.stop()
+            await cp.close()
+
+    _run(main())

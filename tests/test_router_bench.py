@@ -1,0 +1,119 @@
+"""Trace synthesis + prefix analysis + the KV-vs-RR router benchmark
+(VERDICT r3 next-6), and the worker-id-0 accounting regression the
+benchmark caught."""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.data_generator.synthesizer import (  # noqa: E402
+    TraceRecord,
+    TraceSynthesizer,
+    analyze_prefixes,
+    load_trace,
+    save_trace,
+    synthesize_prefix_heavy,
+    tokens_for_record,
+)
+
+
+def test_trace_roundtrip(tmp_path):
+    recs = synthesize_prefix_heavy(10, num_roots=2, context_blocks=3,
+                                   block_size=16)
+    path = tmp_path / "trace.jsonl"
+    save_trace(recs, str(path))
+    back = load_trace(str(path))
+    assert [r.hash_ids for r in back] == [r.hash_ids for r in recs]
+    assert [r.input_length for r in back] == [r.input_length for r in recs]
+
+
+def test_tokens_replay_shared_prefixes_identically():
+    recs = synthesize_prefix_heavy(4, num_roots=1, context_blocks=2,
+                                   suffix_tokens=8, block_size=16)
+    t0 = tokens_for_record(recs[0], 16, unique_seed=0)
+    t1 = tokens_for_record(recs[1], 16, unique_seed=1)
+    # Shared context blocks are byte-identical; suffixes differ.
+    assert t0[:32] == t1[:32]
+    assert t0[32:] != t1[32:]
+
+
+def test_prefix_analyzer():
+    recs = synthesize_prefix_heavy(10, num_roots=1, context_blocks=4,
+                                   suffix_tokens=0, block_size=16)
+    st = analyze_prefixes(recs, 16)
+    assert st.num_requests == 10
+    assert st.unique_blocks == 4
+    # First request misses everything; the other 9 fully hit.
+    assert st.total_reused_tokens == 9 * 4 * 16
+    assert st.per_request_hit_rate[0] == 0.0
+    assert st.per_request_hit_rate[-1] == 1.0
+
+
+def test_synthesizer_learns_prefix_structure():
+    src = synthesize_prefix_heavy(50, num_roots=3, context_blocks=4,
+                                  suffix_tokens=32, block_size=16)
+    syn = TraceSynthesizer(src, block_size=16)
+    out = syn.synthesize(50, seed=1)
+    assert len(out) == 50
+    # Synthesized requests reuse the SOURCE trace's block ids (that is
+    # the point: same prefix structure), at full context depth.
+    src_ids = {h for r in src for h in r.hash_ids}
+    for r in out:
+        assert set(r.hash_ids) <= src_ids
+        assert len(r.hash_ids) == 4
+    # Reuse statistics land in the same regime as the source.
+    s_src = analyze_prefixes(src, 16).token_reuse_rate
+    s_out = analyze_prefixes(out, 16).token_reuse_rate
+    assert abs(s_src - s_out) < 0.2
+
+
+def test_worker_id_zero_accounting_regression():
+    """Worker id 0 is falsy; free/mark/push must still clear its load
+    (pre-fix, every request routed to worker 0 leaked phantom load and
+    the selector starved it — found by the router benchmark)."""
+    from dynamo_tpu.llm.kv_router.sequence import (
+        ActiveSequencesMultiWorker)
+
+    act = ActiveSequencesMultiWorker(block_size=16)
+    act.add_request("r", 0, 32, 0, expected_output_tokens=16)
+    assert act.decode_blocks()[0] > 0
+    act.mark_prefill_complete("r")
+    assert act.prefill_tokens()[0] == 0
+    act.free("r")
+    assert act.decode_blocks()[0] == 0
+
+
+def test_router_bench_kv_beats_rr():
+    """The artifact shape + the headline claim: KV routing improves both
+    hit rate and TTFT on a prefix-heavy trace in the cache-thrash regime
+    (reference claims 3x, architecture.md:91)."""
+    from benchmarks.router_bench import run
+
+    class Args:
+        trace = None
+        requests = 150
+        workers = 4
+        roots = 16
+        context_blocks = 24
+        suffix = 32
+        osl = 8
+        interval_ms = 400.0
+        trace_block = 64
+        speedup = 25.0
+        engine_blocks = 224
+
+    result = asyncio.run(asyncio.wait_for(run(Args()), 300))
+    # Hit-rate gain is the regression guard for the cost function — it is
+    # order-driven and stable.  TTFT is NOT asserted here: at CI time
+    # compression both modes run sub-millisecond and asyncio timer noise
+    # swamps the signal; the standalone bench (`python -m
+    # benchmarks.router_bench`, default knobs) is where the TTFT delta is
+    # measured (1.3-3.3x observed).
+    assert (result["kv"]["cache_hit_rate"]
+            > result["rr"]["cache_hit_rate"] + 0.2)
+    assert result["kv"]["ttft_ms_mean"] > 0  # artifact shape
+    assert result["trace"]["num_requests"] == 150

@@ -1,0 +1,221 @@
+"""SLA planner: interpolators, decision math, profiler sweep, and the
+e2e where synthetic load with an SLA target scales the fleet to the
+interpolated replica count (VERDICT r3 next-5)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.planner import (
+    SlaObservation,
+    SlaPlanner,
+    SlaPlannerConfig,
+    TrendPredictor,
+)
+from dynamo_tpu.planner.interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+
+# A hand-built profile with easy arithmetic:
+# - prefill: 1000 tok/s/chip flat, TTFT grows with ISL;
+# - decode: ITL degrades with kv load; 0.02s ITL is met up to kv=0.5
+#   where throughput is 500 tok/s/chip (columns beyond exceed the SLA).
+PROFILE = {
+    "prefill": {
+        "isl": [128, 512, 2048],
+        "ttft_s": [0.1, 0.4, 1.6],
+        "tok_s_per_chip": [1000.0, 1000.0, 1000.0],
+    },
+    "decode": {
+        "kv_usage": [0.2, 0.5, 0.8],
+        "context": [256, 1024],
+        "itl_s": [[0.01, 0.02, 0.05], [0.01, 0.02, 0.05]],
+        "tok_s_per_chip": [[200.0, 500.0, 800.0], [200.0, 500.0, 800.0]],
+    },
+}
+
+
+def test_interpolators():
+    pre = PrefillInterpolator(PROFILE)
+    assert pre.interpolate_ttft(128) == pytest.approx(0.1)
+    assert pre.interpolate_ttft(320) == pytest.approx(0.25)  # midpoint
+    assert pre.interpolate_thpt_per_chip(9999) == 1000.0     # clamped
+
+    dec = DecodeInterpolator(PROFILE)
+    assert dec.interpolate_itl(0.35, 256) == pytest.approx(0.015)
+    # Best throughput meeting ITL<=0.02 is the kv=0.5 column.
+    assert dec.find_best_throughput_per_chip(0.02, 256) == 500.0
+    # A looser SLA admits the most loaded column.
+    assert dec.find_best_throughput_per_chip(0.05, 1024) == 800.0
+    # An unmeetable SLA falls back to the least-loaded column.
+    assert dec.find_best_throughput_per_chip(0.001, 256) == 200.0
+
+
+def test_trend_predictor_leads_ramps():
+    p = TrendPredictor(window=4)
+    for v in (10, 20, 30, 40):
+        p.add_data_point(v)
+    assert p.predict_next() > 40  # extrapolates the ramp
+
+
+class FakeConnector:
+    def __init__(self, n=1):
+        self.n = n
+
+    def replicas(self):
+        return self.n
+
+    async def add_worker(self):
+        self.n += 1
+
+    async def remove_worker(self):
+        self.n -= 1
+
+
+def test_sla_decision_math():
+    planner = SlaPlanner(
+        PROFILE, observe=lambda: SlaObservation(),
+        decode_connector=FakeConnector(),
+        prefill_connector=FakeConnector(),
+        config=SlaPlannerConfig(
+            ttft_s=0.5, itl_s=0.02, adjustment_interval_s=10.0,
+            predictor="constant", max_replicas=16, max_chip_budget=32))
+    # 100 req / 10s at isl=512, osl=100:
+    # prefill load = 100*512/10 = 5120 tok/s → /1000 → 6 prefill chips;
+    # decode: best thpt at ITL<=0.02 is 500 → 100*100/10/500 = 2 chips.
+    d = planner.decide(SlaObservation(
+        num_requests=100, avg_isl=512, avg_osl=100))
+    assert d.num_prefill == 6
+    assert d.num_decode == 2
+
+    # Measured ITL 2x the profile expectation tightens the corrected SLA
+    # to 0.01 → only the kv=0.2 column (200 tok/s) qualifies → 5 chips.
+    d = planner.decide(SlaObservation(
+        num_requests=100, avg_isl=512, avg_osl=100,
+        itl_s=2 * 0.02))
+    assert d.d_correction == pytest.approx(2.0)
+    assert d.num_decode == 5
+
+    # Zero load floors at min_replicas.
+    d = planner.decide(SlaObservation())
+    assert d.num_prefill == 1 and d.num_decode == 1
+
+
+def test_sla_budget_clamp():
+    planner = SlaPlanner(
+        PROFILE, observe=lambda: SlaObservation(),
+        decode_connector=FakeConnector(),
+        config=SlaPlannerConfig(
+            ttft_s=0.5, itl_s=0.02, adjustment_interval_s=10.0,
+            predictor="constant", max_replicas=100, max_chip_budget=8))
+    d = planner.decide(SlaObservation(
+        num_requests=1000, avg_isl=2048, avg_osl=500))
+    total = d.num_prefill + d.num_decode
+    assert total <= 8
+
+
+def test_sla_e2e_converges_fleet():
+    """Synthetic load ramp drives connectors to the interpolated counts;
+    load drop scales back down."""
+
+    async def main():
+        obs_feed = []
+
+        def observe():
+            return obs_feed.pop(0) if obs_feed else SlaObservation()
+
+        pc, dc = FakeConnector(1), FakeConnector(1)
+        planner = SlaPlanner(
+            PROFILE, observe=observe,
+            decode_connector=dc, prefill_connector=pc,
+            config=SlaPlannerConfig(
+                ttft_s=0.5, itl_s=0.02, adjustment_interval_s=10.0,
+                predictor="constant", max_replicas=16, max_chip_budget=32))
+        obs_feed.append(SlaObservation(num_requests=100, avg_isl=512,
+                                       avg_osl=100))
+        await planner.step()
+        assert (pc.n, dc.n) == (6, 2)
+
+        obs_feed.append(SlaObservation(num_requests=10, avg_isl=128,
+                                       avg_osl=50))
+        await planner.step()
+        assert pc.n == 1  # 10*128/10=128 tok/s → 1 chip
+        assert dc.n == 1
+
+    asyncio.run(asyncio.wait_for(main(), 30))
+
+
+def test_profiler_sweep_feeds_interpolators():
+    """The mini-profiler sweeps a real (tiny, CPU) EngineCore and its
+    output drives the interpolators end to end."""
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models import config as mcfg
+    from dynamo_tpu.planner.profiler import profile_engine
+
+    def make():
+        return EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=64,
+            enable_prefix_cache=False, decode_window=1,
+            scheduler=SchedulerConfig(
+                max_seqs=4, block_size=8, max_pages_per_seq=8,
+                max_prefill_chunk=16, decode_buckets=(1, 2, 4),
+                prefill_buckets=(8, 16))))
+
+    profile = profile_engine(make, isl_grid=(8, 16),
+                             context_grid=(16,), kv_grid=(0.2, 0.6),
+                             decode_tokens=4)
+    assert len(profile["prefill"]["isl"]) == 2
+    assert all(t > 0 for t in profile["prefill"]["ttft_s"])
+    pre = PrefillInterpolator(profile)
+    assert pre.interpolate_thpt_per_chip(12) > 0
+    dec = DecodeInterpolator(profile)
+    assert dec.interpolate_itl(0.4, 16) > 0
+    assert dec.find_best_throughput_per_chip(10.0, 16) > 0
+
+
+def test_prometheus_scraper_against_live_frontend():
+    """The scraper diffs the real frontend exposition into interval
+    observations (isl/osl/ttft/itl averages)."""
+    import aiohttp  # noqa: F401 — skip when missing
+
+    from dynamo_tpu.planner import PrometheusScraper
+
+    async def main():
+        import aiohttp
+
+        from tests.test_http_service import _serve_tiny
+
+        svc, engine, port = await _serve_tiny()
+        try:
+            scraper = PrometheusScraper(
+                f"http://127.0.0.1:{port}/metrics")
+            base = await asyncio.to_thread(scraper.observe)  # baseline
+            assert base.num_requests >= 0
+            async with aiohttp.ClientSession() as s:
+                for _ in range(2):
+                    async with s.post(
+                            f"http://127.0.0.1:{port}/v1/completions",
+                            json={"model": "tiny", "prompt": "hello",
+                                  "max_tokens": 4}) as r:
+                        assert r.status == 200
+            obs = await asyncio.to_thread(scraper.observe)
+            assert obs.num_requests == 2
+            assert obs.avg_isl > 0
+            assert obs.avg_osl == pytest.approx(4.0)
+            assert obs.itl_s >= 0
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_sla_planner_cli_mode_parses():
+    """--mode sla flag wiring (no run; just argument validation path)."""
+    from dynamo_tpu.planner.__main__ import main
+
+    with pytest.raises(SystemExit):
+        # missing --profile/--metrics-url must error, not crash later
+        main(["--control-plane", "127.0.0.1:1", "--mode", "sla"])
