@@ -239,6 +239,7 @@ class EngineCore:
                             if self._moe else None)
         self._load_dev = None  # device-side accumulator (lazy sync)
         self._embed_step = None  # lazily compiled (embeddings route)
+        self._mm_step = None     # lazily compiled (multimodal prefill)
         self._window_fns: Dict[bool, Callable] = {}
         self._window_state: Optional[Dict] = None  # device-resident rows
         self._inflight: List = []  # dispatched-unsynced decode windows
@@ -318,13 +319,32 @@ class EngineCore:
         request_id: str,
         prompt_tokens: List[int],
         sampling: SamplingParams,
+        prompt_embeds=None,
     ) -> None:
         if request_id in self._requests:
             raise ValueError(f"duplicate request id {request_id}")
         if not prompt_tokens:
             raise ValueError("empty prompt")
+        if prompt_embeds is not None:
+            if self.mesh is not None:
+                raise ValueError("prompt_embeds (multimodal) on the "
+                                 "sharded engine path is not wired yet")
+            prompt_embeds = np.asarray(prompt_embeds)
+            if (prompt_embeds.ndim != 2
+                    or prompt_embeds.shape[0] > len(prompt_tokens)
+                    or prompt_embeds.shape[1]
+                    != self.config.model.hidden_size):
+                raise ValueError(
+                    f"prompt_embeds shape {prompt_embeds.shape} must be "
+                    f"[n <= {len(prompt_tokens)}, "
+                    f"{self.config.model.hidden_size}]")
         req = Request(request_id=request_id,
-                      prompt_tokens=list(prompt_tokens), sampling=sampling)
+                      prompt_tokens=list(prompt_tokens), sampling=sampling,
+                      prompt_embeds=prompt_embeds)
+        if prompt_embeds is not None:
+            # Placeholder tokens must neither match nor seed the prefix
+            # cache (different images share placeholder ids).
+            req.block_hashes = ()
         self._requests[request_id] = req
         self.scheduler.add_request(req)
 
@@ -617,6 +637,8 @@ class EngineCore:
             n = min(len(req.pages), P)
             bts[i, :n] = req.pages[:n]
 
+        mm_items = [w for w in batch.items
+                    if w.request.prompt_embeds is not None]
         if self._sp_eligible(batch):
             # Served long-context path: whole-prompt prefill over the ICI
             # ring, T sharded over sp (VERDICT r3 next-4 — the ring was
@@ -628,6 +650,33 @@ class EngineCore:
                 jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(seq_lens), jnp.asarray(bts),
                 jnp.asarray(sample_pos))
+        elif mm_items:
+            # Multimodal prefill: chunk positions inside a request's
+            # embedding span take the provided vision embeddings instead
+            # of token lookups (llm/multimodal.py).
+            H = self.config.model.hidden_size
+            embeds = np.zeros((R, T, H), np.float32)
+            mask = np.zeros((R, T), bool)
+            for i, work in enumerate(batch.items):
+                pe = work.request.prompt_embeds
+                if pe is None:
+                    continue
+                lo = work.start
+                hi = min(work.start + work.length, pe.shape[0])
+                if hi > lo:
+                    embeds[i, : hi - lo] = pe[lo:hi]
+                    mask[i, : hi - lo] = True
+            if self._mm_step is None:
+                self._mm_step = jax.jit(
+                    make_forward_step(self.config.model, self.block_size,
+                                      with_input_embeds=True),
+                    donate_argnums=(1,))
+            logits, self.cache = self._mm_step(
+                self.params, self.cache,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(seq_lens), jnp.asarray(bts),
+                jnp.asarray(sample_pos), jnp.asarray(embeds),
+                jnp.asarray(mask))
         else:
             logits, self.cache = self._run_step(
                 jnp.asarray(tokens), jnp.asarray(positions),
@@ -1125,6 +1174,10 @@ class EngineCore:
                      and self.config.enable_kv_events)
         if not self._managed_cache and not events_on:
             return  # nobody consumes seals: skip the per-step hashing
+        if req.prompt_embeds is not None:
+            # Multimodal prompts hash their PLACEHOLDER tokens — sealing
+            # them would prefix-match a different image's request.
+            return
         if req.request_id not in self._requests:
             return  # already finished and dropped
         seq = self._hash_seqs.get(req.request_id)
@@ -1226,9 +1279,10 @@ class InferenceEngine:
                 self._resolve(fut, None, e)
             else:
                 self._resolve(fut, result, None)
-        for rid, prompt, sampling in adds:
+        for rid, prompt, sampling, embeds in adds:
             try:
-                self.core.add_request(rid, prompt, sampling)
+                self.core.add_request(rid, prompt, sampling,
+                                      prompt_embeds=embeds)
             except ValueError as e:
                 self._dispatch(TokenDelta(
                     request_id=rid, token_ids=[], finished=True,
@@ -1264,6 +1318,7 @@ class InferenceEngine:
         request_id: str,
         prompt_tokens: List[int],
         sampling: SamplingParams,
+        prompt_embeds=None,
     ) -> AsyncIterator[TokenDelta]:
         """Submit and stream deltas until the request finishes.
 
@@ -1273,7 +1328,8 @@ class InferenceEngine:
         q: asyncio.Queue = asyncio.Queue()
         self._queues[request_id] = q
         with self._cmd_lock:
-            self._pending_adds.append((request_id, prompt_tokens, sampling))
+            self._pending_adds.append((request_id, prompt_tokens, sampling,
+                                       prompt_embeds))
         self._wake.set()
         try:
             while True:
@@ -1321,12 +1377,6 @@ class InferenceEngine:
     async def export_blocks_device(self, hashes) -> Dict[int, object]:
         return await self.run_in_engine(
             lambda: self.core.export_blocks_device(hashes))
-
-    async def import_blocks_device(self, blocks) -> int:
-        # The inject op consumes device arrays directly (jnp.asarray is a
-        # no-op for them) — same core path, zero host staging.
-        return await self.run_in_engine(
-            lambda: self.core.import_blocks(blocks))
 
     @property
     def metrics(self) -> ForwardPassMetrics:

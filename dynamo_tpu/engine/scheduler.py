@@ -66,6 +66,10 @@ class Request:
     # Memoized chained prompt-block hashes (admission retries must not
     # re-hash a long prompt every engine step); None = not yet computed.
     block_hashes: Optional[tuple] = None
+    # Multimodal: [n, hidden] embeddings for prompt positions [0, n)
+    # (placeholder token ids there); engine routes prefills carrying
+    # these through the input-embeds step variant.
+    prompt_embeds: Optional[object] = None
 
     @property
     def total_len(self) -> int:

@@ -1,0 +1,38 @@
+"""Declarative multi-process launcher — the local DynamoGraphDeployment.
+
+Role of the reference's K8s operator (`deploy/cloud/operator`, CRDs at
+`api/v1alpha1/dynamographdeployment_types.go:58`, graph → per-component
+deployments in `internal/dynamo/graph.go:145`) scoped to one host: a
+graph TOML declares the services (frontend / workers / planner / …),
+their replica counts and restart policies; the launcher spawns them as
+OS processes with the control-plane address injected, supervises them
+(restart with backoff per policy), and tears the graph down in reverse
+order on SIGTERM.
+
+    [graph]
+    namespace = "dynamo"
+    serve_control_plane = true        # host the control plane in-process
+    control_plane = "127.0.0.1:0"     # or point at an external one
+
+    [services.frontend]
+    module = "dynamo_tpu.frontend"
+    args = ["--http-port", "8000"]
+
+    [services.decode]
+    module = "dynamo_tpu.worker"
+    args = ["--model", "tiny-test", "--role", "decode",
+            "--max-local-prefill", "64"]
+    replicas = 2
+    restart = "always"                # always | on-failure | never
+
+Usage: `python -m dynamo_tpu.launcher graph.toml`.
+"""
+
+from dynamo_tpu.launcher.launcher import (
+    GraphSpec,
+    Launcher,
+    ServiceSpec,
+    load_graph,
+)
+
+__all__ = ["GraphSpec", "ServiceSpec", "Launcher", "load_graph"]

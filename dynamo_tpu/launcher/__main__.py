@@ -1,0 +1,3 @@
+from dynamo_tpu.launcher.launcher import main
+
+main()

@@ -232,13 +232,12 @@ async def pull_prefix_device(engine, plane: KvTransferPlane, rpc_client,
     descriptor over the RPC plane, pull device-to-device, inject.  Returns
     tokens covered; 0 when the peer offered nothing (caller falls back to
     the host-staged pull or local prefill)."""
-    from dynamo_tpu.tokens import compute_block_hashes
+    from dynamo_tpu.llm.block_manager.transfer import (
+        contiguous_prefix, sealed_hashes)
 
-    n_sealed = len(prompt_tokens) // block_size
-    if n_sealed == 0:
+    hashes = sealed_hashes(prompt_tokens, block_size)
+    if not hashes:
         return 0
-    hashes = compute_block_hashes(prompt_tokens[: n_sealed * block_size],
-                                  block_size)
     meta = None
     async for msg in rpc_client.call(KV_OFFER_ENDPOINT, {"hashes": hashes}):
         meta = msg
@@ -253,13 +252,10 @@ async def pull_prefix_device(engine, plane: KvTransferPlane, rpc_client,
             pass
     except Exception:
         pass
-    # Inject the longest contiguous prefix only — a gap breaks the chain.
-    contiguous = {}
-    for h in hashes:
-        if h not in blocks:
-            break
-        contiguous[h] = blocks[h]
+    contiguous = contiguous_prefix(hashes, blocks)
     if not contiguous:
         return 0
-    await engine.import_blocks_device(contiguous)
+    # Device arrays ride the same inject path (jnp.asarray passes them
+    # through without host staging).
+    await engine.import_blocks(contiguous)
     return len(contiguous) * block_size

@@ -83,27 +83,48 @@ async def fetch_blocks(rpc_client, hashes: Iterable[int],
     return out
 
 
-async def pull_prefix(engine, rpc_client, prompt_tokens: List[int],
-                      block_size: int) -> int:
-    """Fetch + inject every sealed prompt block a peer holds; returns the
-    number of tokens now covered by local cache.  This is the decode-side
-    onboard step of disaggregated P/D (reference: decode pulls KV via
-    NIXL after remote prefill, `disagg_serving.md:70-99`)."""
+def sealed_hashes(prompt_tokens: List[int], block_size: int) -> List[int]:
+    """Chained hashes of the prompt's SEALED (full) blocks — the shared
+    addressing step of both transfer planes."""
     from dynamo_tpu.tokens import compute_block_hashes
 
     n_sealed = len(prompt_tokens) // block_size
     if n_sealed == 0:
-        return 0
-    hashes = compute_block_hashes(prompt_tokens[: n_sealed * block_size],
-                                  block_size)
-    blocks = await fetch_blocks(rpc_client, hashes)
-    # Inject the longest contiguous prefix only — a gap breaks the chain.
-    contiguous: Dict[int, np.ndarray] = {}
+        return []
+    return list(compute_block_hashes(
+        prompt_tokens[: n_sealed * block_size], block_size))
+
+
+def contiguous_prefix(hashes: List[int], blocks: Dict[int, np.ndarray]
+                      ) -> Dict[int, np.ndarray]:
+    """The longest fetched prefix with no gaps — a gap breaks the hash
+    chain, and injecting past it would register unreachable blocks."""
+    out: Dict[int, np.ndarray] = {}
     for h in hashes:
         if h not in blocks:
             break
-        contiguous[h] = blocks[h]
+        out[h] = blocks[h]
+    return out
+
+
+async def pull_prefix(engine, rpc_client, prompt_tokens: List[int],
+                      block_size: int, covered_tokens: int = 0) -> int:
+    """Fetch + inject every sealed prompt block a peer holds; returns the
+    number of tokens now covered by local cache.  This is the decode-side
+    onboard step of disaggregated P/D (reference: decode pulls KV via
+    NIXL after remote prefill, `disagg_serving.md:70-99`).
+
+    `covered_tokens`: block-aligned prefix already resident locally
+    (e.g. from a partial device-direct pull) — those hashes are not
+    re-fetched over the wire."""
+    hashes = sealed_hashes(prompt_tokens, block_size)
+    skip = covered_tokens // block_size
+    want = hashes[skip:]
+    if not want:
+        return covered_tokens
+    blocks = await fetch_blocks(rpc_client, want)
+    contiguous = contiguous_prefix(want, blocks)
     if not contiguous:
-        return 0
+        return covered_tokens
     await engine.import_blocks(contiguous)
-    return len(contiguous) * block_size
+    return covered_tokens + len(contiguous) * block_size

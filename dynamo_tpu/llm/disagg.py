@@ -256,10 +256,10 @@ class DisaggDecodeClient:
                 # blocks offloaded to G2/G3 live host-side anyway (and a
                 # failed device pull covers nothing).  import skips the
                 # already-onboarded prefix.
-                host_onboarded = await pull_prefix(
+                onboarded = await pull_prefix(
                     self.engine, self._rpc(done["address"]),
-                    list(request.token_ids), self.block_size)
-                onboarded = max(onboarded, host_onboarded)
+                    list(request.token_ids), self.block_size,
+                    covered_tokens=onboarded)
             self.remote_prefills += 1
             self.tokens_onboarded += onboarded
             logger.info("remote prefill %s: %d tokens onboarded from %s "

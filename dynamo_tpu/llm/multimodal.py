@@ -1,0 +1,157 @@
+"""Multimodal pipeline: processor → encode worker → LLM engine.
+
+Role of the reference's `examples/multimodal_v1/components/` (processor
+parses image parts out of the chat request; `encode_worker.py` runs the
+vision tower and RDMA-transfers the embeddings to the LLM worker via
+`nixl_connect` descriptors; the LLM worker splices them into the
+prompt).  TPU-native mapping:
+
+- **EncodeWorker** — the vision tower (a deterministic stub here: the
+  skeleton's contract is embedding SHAPE and transport, not CLIP
+  quality; a real tower drops into `encode()`).  Serves the `encode`
+  RPC; embeddings travel on the DEVICE transfer plane
+  (block_manager/device_transfer.py — the nixl_connect analog) with an
+  inline-bytes fallback for plane-less peers.
+- **MultimodalProcessor** — frontend-side: parses `image_url` content
+  parts, fetches each image's embeddings from the encode worker, and
+  builds a PreprocessedRequest whose prompt is
+  [image placeholders][chat-template text] with `prompt_embeds`
+  occupying the placeholder span.
+- **engine** — `make_forward_step(with_input_embeds=True)`: masked
+  prefill positions take the provided embeddings instead of the token
+  lookup (engine routes any prefill batch carrying `prompt_embeds`
+  through that variant).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+ENCODE_ENDPOINT = "encode"
+PLACEHOLDER_TOKEN = 0
+
+
+class StubVisionEncoder:
+    """Deterministic image → [n_tokens, hidden] embeddings.
+
+    Stands in for a CLIP/SigLIP tower: embeddings are a seeded-normal
+    function of the image reference, so distinct images produce distinct
+    (reproducible) embeddings and tests can assert the embeddings
+    actually steer generation."""
+
+    def __init__(self, hidden_size: int, n_tokens: int = 16) -> None:
+        self.hidden_size = hidden_size
+        self.n_tokens = n_tokens
+
+    def encode(self, image_ref: str) -> np.ndarray:
+        seed = int.from_bytes(
+            hashlib.blake2b(image_ref.encode(), digest_size=4).digest(),
+            "little")
+        rng = np.random.default_rng(seed)
+        return rng.standard_normal(
+            (self.n_tokens, self.hidden_size)).astype(np.float32) * 0.02
+
+
+class EncodeWorker:
+    """Serves `encode` RPC: image ref → embedding descriptor or inline
+    bytes.  With a transfer plane the embeddings cross device-to-device
+    (the nixl_connect Descriptor flow); without one they ride the RPC
+    inline."""
+
+    def __init__(self, encoder: StubVisionEncoder,
+                 transfer_plane=None) -> None:
+        self.encoder = encoder
+        self.plane = transfer_plane
+        self.encoded = 0
+
+    def make_handler(self):
+        async def handler(payload: dict):
+            image = payload.get("image", "")
+            emb = self.encoder.encode(image)
+            self.encoded += 1
+            if self.plane is not None:
+                import jax.numpy as jnp
+
+                meta = self.plane.stage({0: jnp.asarray(emb)}, [0])
+                if meta is not None:
+                    yield {"kind": "descriptor", "meta": meta}
+                    return
+            yield {"kind": "inline", "data": emb.tobytes(),
+                   "shape": list(emb.shape), "dtype": "float32"}
+
+        return handler
+
+
+async def fetch_embeddings(rpc_client, image_ref: str,
+                           transfer_plane=None) -> np.ndarray:
+    """Processor-side: ask the encode worker for one image's embeddings,
+    pulling device-direct when both sides run a plane."""
+    reply = None
+    async for msg in rpc_client.call(ENCODE_ENDPOINT, {"image": image_ref}):
+        reply = msg
+    if reply is None:
+        raise ConnectionError("encode worker returned nothing")
+    if reply["kind"] == "descriptor":
+        if transfer_plane is None:
+            raise ValueError("encode worker offered a device descriptor "
+                             "but this processor has no transfer plane")
+        blocks = await transfer_plane.pull(reply["meta"])
+        return np.asarray(blocks[0])
+    arr = np.frombuffer(reply["data"], dtype=reply["dtype"])
+    return arr.reshape(reply["shape"]).copy()
+
+
+class MultimodalProcessor:
+    """Chat request with image parts → (token_ids, prompt_embeds).
+
+    Prompt layout follows the LLaVA-style prefix convention the
+    reference example uses: all image embedding spans first (placeholder
+    token ids), then the templated text tokens."""
+
+    def __init__(self, tokenizer, rpc_client, transfer_plane=None) -> None:
+        self.tokenizer = tokenizer
+        self.rpc = rpc_client
+        self.plane = transfer_plane
+
+    @staticmethod
+    def split_images(messages: List[dict]) -> Tuple[List[dict], List[str]]:
+        """Extract image_url parts; returns (text-only messages, refs)."""
+        images: List[str] = []
+        out: List[dict] = []
+        for m in messages:
+            content = m.get("content")
+            if isinstance(content, list):
+                texts = []
+                for part in content:
+                    if part.get("type") == "image_url":
+                        url = part.get("image_url")
+                        if isinstance(url, dict):
+                            url = url.get("url", "")
+                        images.append(url or "")
+                    elif part.get("type") == "text":
+                        texts.append(part.get("text", ""))
+                out.append({**m, "content": " ".join(texts)})
+            else:
+                out.append(m)
+        return out, images
+
+    async def build(self, messages: List[dict]
+                    ) -> Tuple[List[int], Optional[np.ndarray]]:
+        text_msgs, images = self.split_images(messages)
+        text = " ".join(m.get("content") or "" for m in text_msgs)
+        text_tokens = self.tokenizer.encode(text)
+        if not images:
+            return text_tokens, None
+        embeds = []
+        for ref in images:
+            embeds.append(await fetch_embeddings(self.rpc, ref,
+                                                 self.plane))
+        emb = np.concatenate(embeds, axis=0)
+        tokens = [PLACEHOLDER_TOKEN] * emb.shape[0] + list(text_tokens)
+        return tokens, emb

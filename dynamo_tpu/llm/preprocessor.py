@@ -57,6 +57,10 @@ class PreprocessedRequest:
     sampling: SamplingParams
     stop_sequences: List[str] = field(default_factory=list)
     annotations: Dict[str, str] = field(default_factory=dict)
+    # Multimodal: [n, hidden] embeddings occupying prompt positions
+    # [0, n) — the encode-worker output (llm/multimodal.py); token_ids
+    # carry placeholders there.
+    prompt_embeds: Optional[object] = None
 
 
 class OpenAIPreprocessor:

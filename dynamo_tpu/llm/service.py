@@ -40,7 +40,8 @@ class LocalEngineClient:
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:
         async for delta in self._engine.generate(
-                request.request_id, request.token_ids, request.sampling):
+                request.request_id, request.token_ids, request.sampling,
+                prompt_embeds=request.prompt_embeds):
             yield delta
 
     async def embed(self, token_lists):

@@ -1,0 +1,268 @@
+"""GGUF checkpoint loading: parse → dequantize → engine param pytree.
+
+Role of the reference's `lib/llm/src/gguf/` (922 LoC: header/metadata
+parser incl. tokenizer extraction, `gguf_metadata.rs`) — a local-file
+model format the no-egress environment fully supports.  The reader
+implements GGUF v2/v3:
+
+    magic "GGUF" | version u32 | n_tensors u64 | n_kv u64
+    metadata kv*: key (u64-len string), type u32, value
+    tensor info*: name, n_dims u32, dims u64[n] (ne order: fastest
+                  first), ggml_type u32, offset u64
+    padding to `general.alignment` (default 32), then tensor data
+
+Supported tensor types: F32, F16, and Q8_0 (32-element blocks of one
+f16 scale + 32 int8 — dequantised on load; the most common "good
+quality" quant).  Other quants raise with the type name.
+
+Weight conventions: GGML `ne` lists dims fastest-first, so a linear
+layer y = W @ x is stored [n_in (ne0), n_out (ne1)] row-major by out —
+i.e. the numpy view is [n_out, n_in], transposed on load into our
+x @ W convention like the HF loader.  attn_q/attn_k carry llama.cpp's
+interleaved-rope permutation (convert_hf_to_gguf.py `permute`); the
+inverse permutation restores the HF half-rotation layout our
+`models.llama.rope` uses (tests lock the round trip).
+
+The tokenizer metadata (`tokenizer.ggml.*`: tokens, scores, types,
+special token ids) is extracted and returned alongside the params — the
+`gguf_metadata.rs` tokenizer-extraction parity point.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+
+Params = Dict
+
+GGUF_MAGIC = b"GGUF"
+
+# ggml tensor types we materialise.
+GGML_F32 = 0
+GGML_F16 = 1
+GGML_Q8_0 = 8
+_TYPE_NAMES = {0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0",
+               7: "Q5_1", 8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K",
+               12: "Q4_K", 13: "Q5_K", 14: "Q6_K", 15: "Q8_K"}
+
+# metadata value types
+_U8, _I8, _U16, _I16, _U32, _I32, _F32, _BOOL, _STR, _ARR, _U64, _I64, \
+    _F64 = range(13)
+_SCALAR_FMT = {_U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I",
+               _I32: "<i", _F32: "<f", _U64: "<Q", _I64: "<q", _F64: "<d"}
+
+
+def _read_str(f: BinaryIO) -> str:
+    (n,) = struct.unpack("<Q", f.read(8))
+    return f.read(n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype in _SCALAR_FMT:
+        fmt = _SCALAR_FMT[vtype]
+        (v,) = struct.unpack(fmt, f.read(struct.calcsize(fmt)))
+        return v
+    if vtype == _BOOL:
+        return bool(f.read(1)[0])
+    if vtype == _STR:
+        return _read_str(f)
+    if vtype == _ARR:
+        (etype,) = struct.unpack("<I", f.read(4))
+        (n,) = struct.unpack("<Q", f.read(8))
+        if etype in _SCALAR_FMT:
+            fmt = _SCALAR_FMT[etype]
+            size = struct.calcsize(fmt)
+            raw = f.read(size * n)
+            return list(np.frombuffer(
+                raw, dtype=np.dtype(fmt[1:]).newbyteorder("<")))
+        return [_read_value(f, etype) for _ in range(n)]
+    raise ValueError(f"unknown gguf metadata type {vtype}")
+
+
+def _dequant(raw: bytes, ggml_type: int, n_elems: int) -> np.ndarray:
+    if ggml_type == GGML_F32:
+        return np.frombuffer(raw, np.float32, count=n_elems).copy()
+    if ggml_type == GGML_F16:
+        return np.frombuffer(raw, np.float16,
+                             count=n_elems).astype(np.float32)
+    if ggml_type == GGML_Q8_0:
+        # blocks of [f16 scale][32 x i8]; value = scale * q
+        n_blocks = n_elems // 32
+        rec = np.frombuffer(
+            raw, dtype=np.dtype([("d", "<f2"), ("q", "i1", (32,))]),
+            count=n_blocks)
+        return (rec["d"].astype(np.float32)[:, None]
+                * rec["q"].astype(np.float32)).reshape(n_elems)
+    raise ValueError(
+        f"unsupported ggml tensor type "
+        f"{_TYPE_NAMES.get(ggml_type, ggml_type)}; supported: F32, F16, "
+        "Q8_0")
+
+
+class GgufFile:
+    """Parsed GGUF: metadata dict + lazy tensor loading."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.metadata: Dict[str, Any] = {}
+        self.tensors: Dict[str, Tuple[List[int], int, int]] = {}
+        with open(path, "rb") as f:
+            if f.read(4) != GGUF_MAGIC:
+                raise ValueError(f"{path}: not a GGUF file")
+            (version,) = struct.unpack("<I", f.read(4))
+            if version not in (2, 3):
+                raise ValueError(f"{path}: unsupported GGUF v{version}")
+            n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
+            for _ in range(n_kv):
+                key = _read_str(f)
+                (vtype,) = struct.unpack("<I", f.read(4))
+                self.metadata[key] = _read_value(f, vtype)
+            infos = []
+            for _ in range(n_tensors):
+                name = _read_str(f)
+                (n_dims,) = struct.unpack("<I", f.read(4))
+                dims = list(struct.unpack(f"<{n_dims}Q",
+                                          f.read(8 * n_dims)))
+                ggml_type, offset = struct.unpack("<IQ", f.read(12))
+                infos.append((name, dims, ggml_type, offset))
+            align = int(self.metadata.get("general.alignment", 32))
+            base = f.tell()
+            base += (-base) % align
+            self._data_base = base
+            for name, dims, ggml_type, offset in infos:
+                self.tensors[name] = (dims, ggml_type, base + offset)
+
+    def tensor(self, name: str) -> np.ndarray:
+        """Dequantised tensor as f32, numpy shape [ne_last, ..., ne0]
+        (row-major view of GGML's fastest-first dims)."""
+        if name not in self.tensors:
+            raise KeyError(f"tensor {name!r} not in {self.path} "
+                           f"(have e.g. {sorted(self.tensors)[:5]})")
+        dims, ggml_type, pos = self.tensors[name]
+        n = 1
+        for d in dims:
+            n *= d
+        if ggml_type == GGML_F32:
+            nbytes = 4 * n
+        elif ggml_type == GGML_F16:
+            nbytes = 2 * n
+        elif ggml_type == GGML_Q8_0:
+            nbytes = (n // 32) * 34
+        else:
+            raise ValueError(
+                f"unsupported ggml tensor type "
+                f"{_TYPE_NAMES.get(ggml_type, ggml_type)}")
+        with open(self.path, "rb") as f:
+            f.seek(pos)
+            raw = f.read(nbytes)
+        return _dequant(raw, ggml_type, n).reshape(list(reversed(dims)))
+
+    # -- tokenizer extraction (gguf_metadata.rs parity) --------------------
+
+    def tokenizer(self) -> Optional[dict]:
+        """The embedded tokenizer, or None: model kind, vocab (tokens +
+        scores + types) and special token ids."""
+        tokens = self.metadata.get("tokenizer.ggml.tokens")
+        if tokens is None:
+            return None
+        out = {
+            "model": self.metadata.get("tokenizer.ggml.model", "llama"),
+            "tokens": list(tokens),
+            "scores": list(self.metadata.get("tokenizer.ggml.scores", [])),
+            "token_types": list(
+                self.metadata.get("tokenizer.ggml.token_type", [])),
+        }
+        for k in ("bos", "eos", "unknown", "padding"):
+            v = self.metadata.get(f"tokenizer.ggml.{k}_token_id")
+            if v is not None:
+                out[f"{k}_token_id"] = int(v)
+        return out
+
+
+def _unpermute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """Invert llama.cpp's rope permutation on a [out, in] q/k weight:
+    the converter reshapes [n_head, 2, out/head/2, in] and swaps axes
+    1 and 2; the inverse swaps from the POST-permute grouping
+    [n_head, out/head/2, 2, in]."""
+    out, in_ = w.shape
+    return (w.reshape(n_head, out // n_head // 2, 2, in_)
+             .swapaxes(1, 2).reshape(out, in_))
+
+
+def config_from_gguf(g: GgufFile, name: str = "") -> ModelConfig:
+    md = g.metadata
+    arch = md.get("general.architecture", "llama")
+
+    def key(suffix, default=None):
+        return md.get(f"{arch}.{suffix}", default)
+
+    n_heads = int(key("attention.head_count"))
+    emb = int(key("embedding_length"))
+    head_dim = int(key("attention.key_length", emb // n_heads))
+    vocab = md.get("tokenizer.ggml.tokens")
+    vocab_size = int(key("vocab_size", len(vocab) if vocab else 0))
+    return ModelConfig(
+        name=name or md.get("general.name", "gguf-model"),
+        vocab_size=vocab_size,
+        hidden_size=emb,
+        num_layers=int(key("block_count")),
+        num_heads=n_heads,
+        num_kv_heads=int(key("attention.head_count_kv", n_heads)),
+        head_dim=head_dim,
+        intermediate_size=int(key("feed_forward_length")),
+        max_context=int(key("context_length", 8192)),
+        rope_theta=float(key("rope.freq_base", 10_000.0)),
+        rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
+        tie_embeddings="output.weight" not in g.tensors,
+    )
+
+
+def load_gguf(path: str, dtype=None
+              ) -> Tuple[ModelConfig, Params, Optional[dict]]:
+    """Load a GGUF file → (config, params, tokenizer dict or None)."""
+    import jax.numpy as jnp
+
+    g = GgufFile(path)
+    cfg = config_from_gguf(g)
+    cfg.validate()
+    dtype = dtype or cfg.dtype
+
+    def lin(name: str, unpermute_heads: int = 0) -> "jnp.ndarray":
+        w = g.tensor(name)           # [out, in]
+        if unpermute_heads:
+            w = _unpermute(w, unpermute_heads)
+        return jnp.asarray(w.T).astype(dtype)     # ours: [in, out]
+
+    def vec(name: str) -> "jnp.ndarray":
+        return jnp.asarray(g.tensor(name)).astype(dtype)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"blk.{i}."
+        layers.append({
+            "attn": {
+                "wq": lin(p + "attn_q.weight", cfg.num_heads),
+                "wk": lin(p + "attn_k.weight", cfg.num_kv_heads),
+                "wv": lin(p + "attn_v.weight"),
+                "wo": lin(p + "attn_output.weight"),
+            },
+            "attn_norm": vec(p + "attn_norm.weight"),
+            "mlp_norm": vec(p + "ffn_norm.weight"),
+            "mlp": {
+                "w_gate": lin(p + "ffn_gate.weight"),
+                "w_up": lin(p + "ffn_up.weight"),
+                "w_down": lin(p + "ffn_down.weight"),
+            },
+        })
+    params: Params = {
+        "embed": jnp.asarray(g.tensor("token_embd.weight")).astype(dtype),
+        "final_norm": vec("output_norm.weight"),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = lin("output.weight")
+    return cfg, params, g.tokenizer()

@@ -339,7 +339,8 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                       mesh=None,
                       with_expert_load: bool = False,
                       sp_ring: bool = False,
-                      return_hidden: bool = False):
+                      return_hidden: bool = False,
+                      with_input_embeds: bool = False):
     """Build the jitted unified step for a given cache geometry.
 
     Separate factory (rather than passing block_size as a traced value)
@@ -371,6 +372,8 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
         seq_lens: jax.Array,          # [B]
         block_tables: jax.Array,      # [B, P]
         sample_positions=None,        # [B] chunk-local index, or None = all
+        input_embeds=None,            # [B, T, H] (with_input_embeds only)
+        embed_mask=None,              # [B, T] bool: row uses input_embeds
     ) -> Tuple[jax.Array, Dict]:
         B, T = tokens.shape
         P = block_tables.shape[1]
@@ -389,6 +392,12 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                 block_tables, ctx_positions, block_size)
 
         x = jnp.take(params["embed"], tokens, axis=0)
+        if with_input_embeds:
+            # Multimodal prefill: masked chunk positions take provided
+            # embeddings (the encode worker's vision-tower output) in
+            # place of the token lookup (llm/multimodal.py).
+            x = jnp.where(embed_mask[:, :, None],
+                          input_embeds.astype(x.dtype), x)
         k_layers = list(cache["k"])
         v_layers = list(cache["v"])
         expert_load = jnp.zeros((max(cfg.num_experts, 1),), jnp.int32)

@@ -176,6 +176,20 @@ def resolve_model(path_or_preset: str):
     """
     from dynamo_tpu.models import config as mcfg
 
+    if path_or_preset.endswith(".gguf") and os.path.isfile(path_or_preset):
+        from dynamo_tpu.models.gguf import load_gguf
+
+        cfg, params, tok = load_gguf(path_or_preset)
+        # Serving tokenizer: GGUF embeds a sentencepiece-style vocab; the
+        # byte tokenizer keeps the surface functional while the vocab
+        # (extracted — the gguf_metadata.rs parity point) rides the card
+        # for clients that want it.
+        spec = {"kind": "byte"}
+        if tok:
+            spec["gguf_tokenizer"] = {k: tok[k] for k in
+                                      ("model", "bos_token_id",
+                                       "eos_token_id") if k in tok}
+        return cfg, params, spec, None
     if os.path.isdir(path_or_preset):
         cfg, params = load_params(path_or_preset)
         spec = {"kind": "byte"}

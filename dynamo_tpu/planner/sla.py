@@ -217,6 +217,14 @@ class SlaPlanner:
             scale = cfg.max_chip_budget / total
             num_p = max(cfg.min_replicas, int(num_p * scale))
             num_d = max(cfg.min_replicas, int(num_d * scale))
+            floored = (num_p * cfg.chips_per_prefill_engine
+                       + num_d * cfg.chips_per_decode_engine)
+            if floored > cfg.max_chip_budget:
+                # min_replicas floors can make the budget unsatisfiable;
+                # deploying over budget silently would hide a config bug.
+                logger.warning(
+                    "sla: min_replicas floor forces %d chips against a "
+                    "budget of %d", floored, cfg.max_chip_budget)
         return SlaDecision(num_p, num_d, self.p_correction,
                            self.d_correction, nxt)
 
@@ -260,8 +268,18 @@ class SlaPlanner:
         return decision
 
     @staticmethod
-    async def _converge(connector, target: int) -> None:
-        while connector.replicas() < target:
+    async def _converge(connector, target: int, max_moves: int = 4) -> None:
+        """Step the fleet toward `target`, at most `max_moves` spawns or
+        drains per tick: an instantly-crashing worker otherwise turns
+        this into an unbounded spawn loop (replicas() reaps the corpse,
+        the loop spawns another, forever)."""
+        moves = 0
+        while connector.replicas() < target and moves < max_moves:
             await connector.add_worker()
-        while connector.replicas() > target:
+            moves += 1
+        while connector.replicas() > target and moves < max_moves:
             await connector.remove_worker()
+            moves += 1
+        if connector.replicas() != target:
+            logger.info("sla: fleet at %d of target %d (max %d moves per "
+                        "tick)", connector.replicas(), target, max_moves)

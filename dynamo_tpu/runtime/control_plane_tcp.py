@@ -340,21 +340,25 @@ class ControlPlaneClient:
         backoff = 0.5
         try:
             while not self._closed:
-                # Each attempt owns a fresh generation; rx loops of prior
-                # attempts see the bump and exit silently, and their
-                # pending calls are failed here rather than left hanging.
-                # (Streams were poisoned once at outage time — retries
-                # must not spam consumers with more ConnectionErrors.)
+                # Each attempt owns a fresh generation, bumped BEFORE the
+                # dial so the rx loop of any prior attempt exits silently
+                # (a bump only after success would let a failed attempt's
+                # rx re-poison every stream queue on its EOF — the
+                # per-retry spam the gen guard exists to prevent).
+                # Pending calls of the broken attempt are failed here
+                # rather than left hanging.
+                self._conn_gen += 1
                 self._fail_pending(ConnectionError(
                     "control plane reconnecting"))
                 try:
-                    self._reader, self._writer = \
+                    reader, writer = \
                         await asyncio.open_connection(self.host, self.port)
                 except OSError:
                     await asyncio.sleep(backoff)
                     backoff = min(backoff * 2, 15.0)
                     continue
                 self._conn_gen += 1
+                self._reader, self._writer = reader, writer
                 self._rx_task = asyncio.create_task(self._rx_loop())
                 try:
                     # Re-establish stream state under the original sids:

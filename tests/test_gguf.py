@@ -1,0 +1,235 @@
+"""GGUF loader: writer-fixture round trip, Q8_0 dequant, tokenizer
+extraction, and engine parity (VERDICT r3 next-8)."""
+
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.models.gguf import GgufFile, config_from_gguf, load_gguf
+from dynamo_tpu.models.llama import init_params
+
+TINY = mcfg.get_config("tiny-test")
+
+
+# -- minimal GGUF writer (test fixture; llama.cpp conventions) -------------
+
+_STR, _ARR = 8, 9
+_U32, _F32, _I32 = 4, 6, 5
+
+
+def _w_str(f, s: str):
+    b = s.encode()
+    f.write(struct.pack("<Q", len(b)) + b)
+
+
+def _w_kv(f, key, vtype, value):
+    _w_str(f, key)
+    f.write(struct.pack("<I", vtype))
+    if vtype == _U32:
+        f.write(struct.pack("<I", value))
+    elif vtype == _F32:
+        f.write(struct.pack("<f", value))
+    elif vtype == _STR:
+        _w_str(f, value)
+    elif vtype == _ARR:
+        etype, items = value
+        f.write(struct.pack("<IQ", etype, len(items)))
+        for it in items:
+            if etype == _STR:
+                _w_str(f, it)
+            elif etype == _F32:
+                f.write(struct.pack("<f", it))
+            elif etype == _I32:
+                f.write(struct.pack("<i", it))
+
+
+def _permute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """llama.cpp convert-time rope permutation on [out, in] weights."""
+    out, in_ = w.shape
+    return (w.reshape(n_head, 2, out // n_head // 2, in_)
+             .swapaxes(1, 2).reshape(out, in_))
+
+
+def write_gguf(path, cfg, params, tokens=None, q8_tensors=()):
+    """Write params (our pytree convention) as a llama-arch GGUF."""
+    tensors = {"token_embd.weight": np.asarray(params["embed"], np.float32),
+               "output_norm.weight": np.asarray(params["final_norm"],
+                                                np.float32)}
+    for i, layer in enumerate(params["layers"]):
+        p = f"blk.{i}."
+        a = layer["attn"]
+        # ours [in, out] → gguf stores [out, in] (+ rope permute on q/k)
+        tensors[p + "attn_q.weight"] = _permute(
+            np.asarray(a["wq"], np.float32).T, cfg.num_heads)
+        tensors[p + "attn_k.weight"] = _permute(
+            np.asarray(a["wk"], np.float32).T, cfg.num_kv_heads)
+        tensors[p + "attn_v.weight"] = np.asarray(a["wv"], np.float32).T
+        tensors[p + "attn_output.weight"] = np.asarray(a["wo"],
+                                                       np.float32).T
+        tensors[p + "attn_norm.weight"] = np.asarray(layer["attn_norm"],
+                                                     np.float32)
+        tensors[p + "ffn_norm.weight"] = np.asarray(layer["mlp_norm"],
+                                                    np.float32)
+        m = layer["mlp"]
+        tensors[p + "ffn_gate.weight"] = np.asarray(m["w_gate"],
+                                                    np.float32).T
+        tensors[p + "ffn_up.weight"] = np.asarray(m["w_up"], np.float32).T
+        tensors[p + "ffn_down.weight"] = np.asarray(m["w_down"],
+                                                    np.float32).T
+
+    def q8_encode(w):
+        flat = w.reshape(-1, 32)
+        scale = (np.abs(flat).max(axis=1) / 127.0).astype(np.float16)
+        q = np.round(flat / np.maximum(
+            scale.astype(np.float32)[:, None], 1e-12)).astype(np.int8)
+        out = bytearray()
+        for s, row in zip(scale, q):
+            out += s.tobytes() + row.tobytes()
+        return bytes(out)
+
+    with open(path, "wb") as f:
+        f.write(b"GGUF")
+        f.write(struct.pack("<I", 3))
+        n_kv = 10 + (1 if tokens else 0)
+        f.write(struct.pack("<QQ", len(tensors), n_kv))
+        _w_kv(f, "general.architecture", _STR, "llama")
+        _w_kv(f, "llama.embedding_length", _U32, cfg.hidden_size)
+        _w_kv(f, "llama.block_count", _U32, cfg.num_layers)
+        _w_kv(f, "llama.attention.head_count", _U32, cfg.num_heads)
+        _w_kv(f, "llama.attention.head_count_kv", _U32, cfg.num_kv_heads)
+        _w_kv(f, "llama.attention.key_length", _U32, cfg.head_dim)
+        _w_kv(f, "llama.feed_forward_length", _U32, cfg.intermediate_size)
+        _w_kv(f, "llama.context_length", _U32, cfg.max_context)
+        _w_kv(f, "llama.rope.freq_base", _F32, cfg.rope_theta)
+        _w_kv(f, "llama.vocab_size", _U32, cfg.vocab_size)
+        if tokens:
+            _w_kv(f, "tokenizer.ggml.tokens", _ARR, (_STR, tokens))
+        # tensor infos
+        blobs = {}
+        offset = 0
+        for name, w in tensors.items():
+            if name in q8_tensors:
+                blob, gtype = q8_encode(w), 8
+            else:
+                blob, gtype = w.astype("<f4").tobytes(), 0
+            blobs[name] = blob
+            _w_str(f, name)
+            dims = list(reversed(w.shape))  # ne order: fastest first
+            f.write(struct.pack("<I", len(dims)))
+            f.write(struct.pack(f"<{len(dims)}Q", *dims))
+            f.write(struct.pack("<IQ", gtype, offset))
+            offset += len(blob)
+            offset += (-offset) % 32
+        pos = f.tell()
+        f.write(b"\0" * ((-pos) % 32))
+        for name, blob in blobs.items():
+            f.write(blob)
+            f.write(b"\0" * ((-len(blob)) % 32))
+
+
+# -- tests ------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gguf_path(tmp_path_factory):
+    params = init_params(TINY, jax.random.key(0))
+    path = tmp_path_factory.mktemp("gguf") / "tiny.gguf"
+    write_gguf(str(path), TINY, params,
+               tokens=[f"<t{i}>" for i in range(TINY.vocab_size)])
+    return str(path), params
+
+
+def test_header_and_config(gguf_path):
+    path, _ = gguf_path
+    g = GgufFile(path)
+    assert g.metadata["general.architecture"] == "llama"
+    cfg = config_from_gguf(g)
+    assert cfg.hidden_size == TINY.hidden_size
+    assert cfg.num_layers == TINY.num_layers
+    assert cfg.num_kv_heads == TINY.num_kv_heads
+    assert cfg.vocab_size == TINY.vocab_size
+    assert cfg.tie_embeddings  # no output.weight written
+
+
+def test_roundtrip_params_exact(gguf_path):
+    path, params = gguf_path
+    cfg, loaded, tok = load_gguf(path, dtype=np.float32)
+    for name in ("embed", "final_norm"):
+        np.testing.assert_allclose(np.asarray(params[name]),
+                                   np.asarray(loaded[name]), atol=1e-6)
+    for lp, ll in zip(params["layers"], loaded["layers"]):
+        for k in ("wq", "wk", "wv", "wo"):
+            np.testing.assert_allclose(
+                np.asarray(lp["attn"][k]), np.asarray(ll["attn"][k]),
+                atol=1e-6, err_msg=k)
+        for k in ("w_gate", "w_up", "w_down"):
+            np.testing.assert_allclose(
+                np.asarray(lp["mlp"][k]), np.asarray(ll["mlp"][k]),
+                atol=1e-6, err_msg=k)
+    assert tok and len(tok["tokens"]) == TINY.vocab_size
+
+
+def test_q8_0_dequant(gguf_path):
+    _, params = gguf_path
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".gguf") as f:
+        write_gguf(f.name, TINY, params,
+                   q8_tensors={"blk.0.ffn_up.weight"})
+        _, loaded, _ = load_gguf(f.name, dtype=np.float32)
+    want = np.asarray(params["layers"][0]["mlp"]["w_up"])
+    got = np.asarray(loaded["layers"][0]["mlp"]["w_up"])
+    # Q8_0 is lossy: per-32-block int8 with f16 scale → ~1% error.
+    assert np.max(np.abs(want - got)) < 0.02 * max(np.max(np.abs(want)),
+                                                   1e-6)
+
+
+def test_gguf_serves_tokens(gguf_path):
+    """VERDICT done-criterion: load the fixture and produce tokens —
+    identical to the engine running the original pytree."""
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models.loader import resolve_model
+
+    path, params = gguf_path
+    cfg, loaded, spec, _ = resolve_model(path)
+    assert spec["kind"] == "byte"
+
+    def run(cfg_, params_):
+        core = EngineCore(EngineConfig(
+            model=cfg_, num_blocks=64, enable_prefix_cache=False,
+            scheduler=SchedulerConfig(
+                max_seqs=4, block_size=8, max_pages_per_seq=8,
+                max_prefill_chunk=16, decode_buckets=(1, 2, 4),
+                prefill_buckets=(8, 16))), params=params_)
+        core.add_request("g", [5, 6, 7, 8, 9], SamplingParams(max_tokens=6))
+        out = []
+        for _ in range(100):
+            for d in core.step():
+                out.extend(d.token_ids)
+            if not core._requests:
+                break
+        return out
+
+    got = run(cfg, loaded)
+    want = run(TINY, params)
+    assert got == want and len(got) == 6
+
+
+def test_unsupported_quant_raises(gguf_path, tmp_path):
+    path, params = gguf_path
+    # Corrupt one tensor's type id to Q4_K (12).
+    g = GgufFile(path)
+    import shutil
+
+    bad = tmp_path / "bad.gguf"
+    shutil.copy(path, bad)
+    # Easier: assert the reader's dequant guard directly.
+    from dynamo_tpu.models.gguf import _dequant
+
+    with pytest.raises(ValueError, match="Q4_K"):
+        _dequant(b"", 12, 0)

@@ -1,0 +1,150 @@
+"""Declarative launcher (operator-lite, VERDICT r3 next-9): one graph
+TOML brings up the disagg P/D topology; crashed replicas restart per
+policy; teardown drains in reverse order."""
+
+import asyncio
+import os
+import signal
+
+import pytest
+
+from dynamo_tpu.launcher import Launcher, load_graph
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_graph(tmp_path, body: str) -> str:
+    path = tmp_path / "graph.toml"
+    path.write_text(body)
+    return str(path)
+
+
+def test_graph_parsing(tmp_path):
+    path = _write_graph(tmp_path, """
+[graph]
+namespace = "ns"
+serve_control_plane = true
+
+[services.frontend]
+module = "dynamo_tpu.frontend"
+args = ["--http-port", "0"]
+
+[services.decode]
+module = "dynamo_tpu.worker"
+args = ["--mocker"]
+replicas = 2
+restart = "always"
+""")
+    spec = load_graph(path)
+    assert spec.namespace == "ns"
+    names = {s.name: s for s in spec.services}
+    assert names["decode"].replicas == 2
+    assert names["decode"].restart == "always"
+    assert names["frontend"].restart == "on-failure"
+
+
+def test_bad_restart_policy_rejected(tmp_path):
+    path = _write_graph(tmp_path, """
+[services.x]
+module = "m"
+restart = "sometimes"
+""")
+    with pytest.raises(ValueError, match="sometimes"):
+        load_graph(path)
+
+
+@pytest.mark.e2e
+def test_graph_brings_up_disagg_topology(tmp_path):
+    """One command: control plane + frontend + prefill/decode workers up,
+    a chat completion served end-to-end, a killed worker restarted."""
+    from aiohttp import ClientSession
+
+    path = _write_graph(tmp_path, """
+[graph]
+namespace = "dynamo"
+serve_control_plane = true
+
+[services.frontend]
+module = "dynamo_tpu.frontend"
+args = ["--http-port", "39471"]
+restart = "always"
+
+[services.prefill]
+module = "dynamo_tpu.worker"
+args = ["--model", "tiny-test", "--model-name", "tiny",
+        "--block-size", "8", "--role", "prefill"]
+restart = "always"
+
+[services.decode]
+module = "dynamo_tpu.worker"
+args = ["--model", "tiny-test", "--model-name", "tiny",
+        "--block-size", "8", "--role", "decode",
+        "--max-local-prefill", "8"]
+restart = "always"
+""")
+
+    async def main():
+        spec = load_graph(path)
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        launcher = Launcher(spec, env=env)
+        await launcher.start()
+        try:
+            base = "http://127.0.0.1:39471"
+            async with ClientSession() as s:
+                for _ in range(120):
+                    try:
+                        async with s.get(f"{base}/health") as r:
+                            if r.status == 200:
+                                body = await r.json()
+                                if "tiny" in body.get("models", []):
+                                    break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(1.0)
+                else:
+                    raise AssertionError(
+                        f"graph never became healthy: "
+                        f"{launcher.status()}")
+                async with s.post(f"{base}/v1/chat/completions", json={
+                        "model": "tiny",
+                        "messages": [{"role": "user",
+                                      "content": "long enough to go "
+                                                 "remote for prefill"}],
+                        "max_tokens": 4}) as r:
+                    assert r.status == 200, await r.text()
+
+                # Supervision: kill the decode worker; the launcher
+                # restarts it and the model becomes servable again.
+                decode = next(rep for rep in launcher._replicas
+                              if rep.svc.name == "decode")
+                os.kill(decode.proc.pid, signal.SIGKILL)
+                await asyncio.sleep(2.0)
+                for _ in range(120):
+                    if decode.restarts >= 1 and launcher.status()[
+                            "decode[0]"]["alive"]:
+                        break
+                    await asyncio.sleep(1.0)
+                assert decode.restarts >= 1
+                for _ in range(120):
+                    try:
+                        async with s.post(
+                                f"{base}/v1/chat/completions", json={
+                                    "model": "tiny",
+                                    "messages": [{"role": "user",
+                                                  "content": "again"}],
+                                    "max_tokens": 2}) as r:
+                            if r.status == 200:
+                                break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(1.0)
+                else:
+                    raise AssertionError("model never recovered after "
+                                         "worker restart")
+        finally:
+            await launcher.stop()
+            assert all(not s["alive"]
+                       for s in launcher.status().values()), \
+                launcher.status()
+
+    asyncio.run(asyncio.wait_for(main(), 420))

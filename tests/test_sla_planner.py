@@ -135,8 +135,18 @@ def test_sla_e2e_converges_fleet():
         obs_feed.append(SlaObservation(num_requests=100, avg_isl=512,
                                        avg_osl=100))
         await planner.step()
+        # Convergence is rate-limited (max 4 moves per tick — a crashing
+        # worker must not become an unbounded spawn loop): 1 → 5 first.
+        assert (pc.n, dc.n) == (5, 2)
+        obs_feed.append(SlaObservation(num_requests=100, avg_isl=512,
+                                       avg_osl=100))
+        await planner.step()
         assert (pc.n, dc.n) == (6, 2)
 
+        obs_feed.append(SlaObservation(num_requests=10, avg_isl=128,
+                                       avg_osl=50))
+        await planner.step()
+        assert (pc.n, dc.n) == (2, 1)  # drain rate-limited: 6 → 2
         obs_feed.append(SlaObservation(num_requests=10, avg_isl=128,
                                        avg_osl=50))
         await planner.step()
