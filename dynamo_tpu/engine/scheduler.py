@@ -70,6 +70,9 @@ class Request:
     # (placeholder token ids there); engine routes prefills carrying
     # these through the input-embeds step variant.
     prompt_embeds: Optional[object] = None
+    # dp-attention locality: the allocator shard this request's pages
+    # come from (derived from its slot at admission; None = shard-less).
+    locality_shard: Optional[int] = None
 
     @property
     def total_len(self) -> int:
@@ -91,13 +94,43 @@ class BlockAllocator:
     (dynamo_tpu.llm.block_manager.engine_source.ManagedBlockSource), which
     duck-types this interface; this one remains for scheduler unit tests
     and reuse-free configurations.  Watermark semantics follow the
-    reference mocker `KvManager`."""
+    reference mocker `KvManager`.
 
-    def __init__(self, num_blocks: int) -> None:
+    `num_shards > 1` partitions blocks [1, num_blocks) into contiguous
+    per-shard ranges (the dp-attention locality allocator: the cache's
+    slot axis shards over tp in contiguous ranges, so a page is LOCAL to
+    exactly one shard).  `allocate(n, shard=s)` draws strictly from
+    shard s — locality is a correctness invariant for the local-attention
+    decode path, so there is deliberately no cross-shard stealing; a
+    shard running dry is an OOM for its rows (preempt semantics), exactly
+    like a full replica."""
+
+    def __init__(self, num_blocks: int, num_shards: int = 1) -> None:
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (block 0 is the null block)")
+        if num_shards < 1 or (num_blocks % num_shards):
+            raise ValueError(
+                f"num_shards={num_shards} must divide num_blocks="
+                f"{num_blocks} (contiguous slot ranges shard evenly)")
         self.num_blocks = num_blocks
-        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self.num_shards = num_shards
+        self._shard_size = num_blocks // num_shards
+        if num_shards == 1:
+            self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+            self._shard_free: List[List[int]] = [self._free]
+        else:
+            # Shard s owns blocks [s*size, (s+1)*size); block 0 (null)
+            # reduces shard 0's usable range by one.
+            self._shard_free = [
+                [b for b in range(min((s + 1) * self._shard_size,
+                                      num_blocks) - 1,
+                                  max(s * self._shard_size, 1) - 1, -1)]
+                for s in range(num_shards)
+            ]
+            self._free = []  # unused in sharded mode (see properties)
+
+    def shard_of_block(self, block: int) -> int:
+        return block // self._shard_size
 
     # Prefix-cache interface (no-ops here).
     def prompt_hashes(self, prompt_tokens: Sequence[int]) -> tuple:
@@ -112,23 +145,38 @@ class BlockAllocator:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._shard_free)
+
+    def shard_free_blocks(self, shard: int) -> int:
+        return len(self._shard_free[shard])
 
     @property
     def usage(self) -> float:
         usable = self.num_blocks - 1
-        return 1.0 - len(self._free) / usable
+        return 1.0 - self.free_blocks / usable
 
-    def allocate(self, n: int) -> List[int]:
-        if n > len(self._free):
-            raise RuntimeError(f"out of KV blocks: want {n}, free {len(self._free)}")
-        return [self._free.pop() for _ in range(n)]
+    def allocate(self, n: int, shard: Optional[int] = None) -> List[int]:
+        if self.num_shards == 1:
+            pool = self._shard_free[0]
+        elif shard is None:
+            # Shard-less callers (embeddings scratch etc.) take the
+            # fullest pool — harmless, those pages are never decoded
+            # through the local-attention path.
+            pool = max(self._shard_free, key=len)
+        else:
+            pool = self._shard_free[shard]
+        if n > len(pool):
+            raise RuntimeError(
+                f"out of KV blocks: want {n}, free {len(pool)}"
+                + (f" in shard {shard}" if self.num_shards > 1 else ""))
+        return [pool.pop() for _ in range(n)]
 
     def release(self, pages: Sequence[int]) -> None:
         for p in pages:
             if p == 0:
                 raise ValueError("attempt to free the null block")
-            self._free.append(p)
+            self._shard_free[self.shard_of_block(p)
+                             if self.num_shards > 1 else 0].append(p)
 
 
 @dataclass(frozen=True)
@@ -145,6 +193,10 @@ class SchedulerConfig:
     watermark: float = 0.01               # min free-block fraction to admit
     decode_buckets: tuple = (1, 2, 4, 8, 16, 32, 64)
     prefill_buckets: tuple = (16, 32, 64, 128, 256, 512)
+    # dp-attention locality: slot → allocator shard (engine-installed;
+    # None = shard-less allocation).  A request's pages then come from
+    # the cache range local to its decode rows' tp shard.
+    shard_of_slot: Optional[Callable] = None
 
     def __post_init__(self):
         if self.max_seqs > max(self.decode_buckets):
@@ -261,9 +313,16 @@ class Scheduler:
                 req.prompt_tokens, req.block_hashes)
             need_total = self._pages_needed(len(req.prompt_tokens) + 1)
             need_new = max(0, need_total - len(cached_pages))
-            # Admit only if the new pages fit and leave the watermark.
-            if self.allocator.free_blocks - need_new < \
-                    self.config.watermark * usable:
+            shard = (self.config.shard_of_slot(slot)
+                     if self.config.shard_of_slot else None)
+            # Admit only if the new pages fit and leave the watermark
+            # (per-shard capacity when locality is on: a full shard is a
+            # full replica from its rows' point of view).
+            free_here = (self.allocator.shard_free_blocks(shard)
+                         if shard is not None
+                         and getattr(self.allocator, "num_shards", 1) > 1
+                         else self.allocator.free_blocks)
+            if free_here - need_new < self.config.watermark * usable:
                 if cached_pages:
                     self.allocator.release(cached_pages)
                 # Nothing running means nothing will ever free pages — the
@@ -274,7 +333,8 @@ class Scheduler:
                     req.finish_reason = FinishReason.LENGTH
                 break
             self.waiting.pop(0)
-            req.pages = list(cached_pages) + self.allocator.allocate(need_new)
+            req.locality_shard = shard
+            req.pages = list(cached_pages) + self._allocate(need_new, shard)
             # Cached prefix skips prefill compute, but at least the last
             # prompt token is always recomputed so admission yields logits.
             req.prefilled = min(cached_tokens, len(req.prompt_tokens) - 1)
@@ -285,15 +345,29 @@ class Scheduler:
 
     # -- page growth ------------------------------------------------------
 
+    def _allocate(self, n: int, shard: Optional[int]) -> List[int]:
+        """Allocator call, shard-aware when both sides support it (the
+        managed tiered source has no shard concept — locality mode runs
+        with the plain allocator)."""
+        if shard is not None and getattr(self.allocator,
+                                         "num_shards", 1) > 1:
+            return self.allocator.allocate(n, shard=shard)
+        return self.allocator.allocate(n)
+
     def ensure_capacity(self, req: Request, new_len: int) -> bool:
         """Grow req's page list to cover new_len tokens; False if OOM."""
         need = self._pages_needed(new_len)
         if need > self.config.max_pages_per_seq:
             return False
+        shard = req.locality_shard
+        sharded = (shard is not None
+                   and getattr(self.allocator, "num_shards", 1) > 1)
         while len(req.pages) < need:
-            if self.allocator.free_blocks == 0:
+            free = (self.allocator.shard_free_blocks(shard) if sharded
+                    else self.allocator.free_blocks)
+            if free == 0:
                 return False
-            req.pages.extend(self.allocator.allocate(1))
+            req.pages.extend(self._allocate(1, shard))
         return True
 
     # -- planning ---------------------------------------------------------

@@ -37,10 +37,20 @@ def parse_args(argv=None):
     p.add_argument("--serve-control-plane", action="store_true",
                    help="also host the control-plane server in this process")
     p.add_argument("--control-plane-port", type=int, default=4222)
+    p.add_argument("--control-plane-store", default=None,
+                   help="with --serve-control-plane: persistence backend "
+                        "('memory' or 'file:PATH' — unleased config "
+                        "survives restarts; runtime/kv_store.py)")
     p.add_argument("--router-mode", default="round_robin",
                    choices=["round_robin", "random", "kv"])
     p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--model-name", default="dynamo-tpu")
+    p.add_argument("--out", default="auto",
+                   choices=("auto", "engine", "mocker", "echo"),
+                   help="in-process backend (reference dynamo-run out= "
+                        "matrix): auto = engine when --model names real "
+                        "weights, echo streams the prompt back, mocker "
+                        "simulates a vLLM-style engine")
     p.add_argument("--mocker", action="store_true",
                    help="serve the mock engine (no accelerator)")
     p.add_argument("--model", default=None,
@@ -75,13 +85,17 @@ def parse_args(argv=None):
 
 
 async def build_model_handle(args) -> tuple:
-    """Returns (handle, shutdown coroutine)."""
+    """Returns (handle, shutdown coroutine).  Backend per the out=
+    matrix (`--out`, reference dynamo-run `opt.rs:7-32`)."""
+    out = args.out
+    if args.mocker:
+        out = "mocker"  # back-compat alias
     tokenizer = (HFTokenizer(args.tokenizer) if args.tokenizer
                  else ByteTokenizer())
     pre = OpenAIPreprocessor(tokenizer,
                              default_max_tokens=args.max_tokens_default)
 
-    if args.mocker:
+    if out == "mocker":
         from dynamo_tpu.llm.mocker import MockEngine, MockEngineArgs
 
         engine = MockEngine(MockEngineArgs(
@@ -92,14 +106,36 @@ async def build_model_handle(args) -> tuple:
                              preprocessor=pre, client=engine)
         return handle, engine.stop
 
+    if out == "echo":
+        from dynamo_tpu.llm.echo import EchoEngine
+
+        async def noop():
+            return None
+
+        handle = ModelHandle(name=args.model_name, tokenizer=tokenizer,
+                             preprocessor=pre, client=EchoEngine())
+        return handle, noop
+
     from dynamo_tpu.engine.engine import EngineConfig, EngineCore, InferenceEngine
     from dynamo_tpu.engine.scheduler import SchedulerConfig
-    from dynamo_tpu.models.config import get_config
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.models.loader import resolve_model
 
-    cfg = get_config(args.model or "llama-3-1b")
+    cfg, params, tok_spec, template = resolve_model(
+        args.model or "llama-3-1b")
+    if args.tokenizer is None and tok_spec.get("kind") != "byte":
+        # Real checkpoints carry their tokenizer + chat template; honor
+        # them unless the operator overrode --tokenizer.
+        card = ModelDeploymentCard(name=args.model_name,
+                                   tokenizer_spec=tok_spec,
+                                   chat_template=template)
+        tokenizer = card.build_tokenizer()
+        pre = OpenAIPreprocessor(tokenizer, chat_template=template,
+                                 default_max_tokens=args.max_tokens_default)
     core = EngineCore(EngineConfig(
         model=cfg, num_blocks=args.num_blocks,
-        scheduler=SchedulerConfig(block_size=args.block_size)))
+        scheduler=SchedulerConfig(block_size=args.block_size)),
+        params=params)
     engine = InferenceEngine(core)
     await engine.start()
     handle = ModelHandle(name=args.model_name, tokenizer=tokenizer,
@@ -243,9 +279,12 @@ async def run(args) -> None:
 
     cp_server = None
     if args.serve_control_plane:
+        from dynamo_tpu.runtime.control_plane import ControlPlaneState
         from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneServer
+        from dynamo_tpu.runtime.kv_store import make_backend
 
-        cp_server = ControlPlaneServer()
+        cp_server = ControlPlaneServer(ControlPlaneState(
+            backend=make_backend(args.control_plane_store)))
         port = await cp_server.start(port=args.control_plane_port)
         args.control_plane = args.control_plane or f"127.0.0.1:{port}"
         print(f"control plane on 127.0.0.1:{port}", flush=True)

@@ -41,6 +41,7 @@ class GraphSpec:
     namespace: str = "dynamo"
     control_plane: str = "127.0.0.1:0"
     serve_control_plane: bool = True
+    kv_store: Optional[str] = None  # 'file:PATH' persists unleased config
     log_dir: str = "/tmp"
     services: List[ServiceSpec] = field(default_factory=list)
 
@@ -53,6 +54,7 @@ def load_graph(path: str) -> GraphSpec:
         namespace=g.get("namespace", "dynamo"),
         control_plane=g.get("control_plane", "127.0.0.1:0"),
         serve_control_plane=bool(g.get("serve_control_plane", True)),
+        kv_store=g.get("kv_store"),
         log_dir=g.get("log_dir", "/tmp"),
     )
     for name, s in doc.get("services", {}).items():
@@ -104,11 +106,14 @@ class Launcher:
         """Start control plane (if hosted) + every service; returns the
         control-plane address."""
         if self.spec.serve_control_plane:
+            from dynamo_tpu.runtime.control_plane import ControlPlaneState
             from dynamo_tpu.runtime.control_plane_tcp import (
                 ControlPlaneServer)
+            from dynamo_tpu.runtime.kv_store import make_backend
 
             host, _, port = self.spec.control_plane.partition(":")
-            self._cp_server = ControlPlaneServer()
+            self._cp_server = ControlPlaneServer(ControlPlaneState(
+                backend=make_backend(self.spec.kv_store)))
             bound = await self._cp_server.start(host or "127.0.0.1",
                                                int(port or 0))
             self.cp_addr = f"{host or '127.0.0.1'}:{bound}"

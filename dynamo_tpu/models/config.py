@@ -43,6 +43,14 @@ class ModelConfig:
     num_experts_per_token: int = 2
     # Tie input embedding and LM head (small models).
     tie_embeddings: bool = False
+    # Gemma-family knobs (all default to the Llama conventions):
+    activation: str = "silu"              # "silu" | "gelu_tanh"
+    attn_soft_cap: Optional[float] = None  # attention-logit soft cap
+    final_soft_cap: Optional[float] = None  # lm-head-logit soft cap
+    post_norms: bool = False              # post-attn/post-mlp RMSNorms
+    rms_offset: bool = False              # norm scales by (1 + w)
+    embed_scale: bool = False             # embeddings x sqrt(hidden)
+    query_scale: Optional[float] = None   # replaces head_dim**-0.5
 
     @property
     def is_moe(self) -> bool:
@@ -64,6 +72,8 @@ class ModelConfig:
             raise ValueError("num_heads must be a multiple of num_kv_heads (GQA)")
         if self.is_moe and self.num_experts_per_token > self.num_experts:
             raise ValueError("num_experts_per_token > num_experts")
+        if self.activation not in ("silu", "gelu_tanh"):
+            raise ValueError(f"unknown activation {self.activation!r}")
 
     def param_count(self) -> int:
         """Approximate parameter count (for memory planning / bench labels)."""
@@ -150,9 +160,46 @@ MIXTRAL_8X7B = ModelConfig(
     num_experts_per_token=2,
 )
 
+TINY_GEMMA = TINY.replace(
+    name="tiny-gemma",
+    activation="gelu_tanh",
+    attn_soft_cap=50.0,
+    final_soft_cap=30.0,
+    post_norms=True,
+    rms_offset=True,
+    embed_scale=True,
+    query_scale=16.0 ** -0.5,
+)
+
+GEMMA2_9B = ModelConfig(
+    name="gemma-2-9b",
+    vocab_size=256_000,
+    hidden_size=3584,
+    num_layers=42,
+    num_heads=16,
+    num_kv_heads=8,
+    head_dim=256,
+    intermediate_size=14_336,
+    # Gemma-2 alternates sliding-window (4096) and global layers; this
+    # engine runs every layer global, which is EXACT while context stays
+    # within the window — max_context is clamped accordingly.
+    max_context=4096,
+    rope_theta=10_000.0,
+    rms_norm_eps=1e-6,
+    tie_embeddings=True,
+    activation="gelu_tanh",
+    attn_soft_cap=50.0,
+    final_soft_cap=30.0,
+    post_norms=True,
+    rms_offset=True,
+    embed_scale=True,
+    query_scale=224.0 ** -0.5,
+)
+
 PRESETS = {
     c.name: c
-    for c in (TINY, TINY_MOE, LLAMA3_1B, LLAMA3_8B, LLAMA3_70B, MIXTRAL_8X7B)
+    for c in (TINY, TINY_MOE, TINY_GEMMA, LLAMA3_1B, LLAMA3_8B,
+              LLAMA3_70B, MIXTRAL_8X7B, GEMMA2_9B)
 }
 
 

@@ -42,11 +42,21 @@ Params = Dict
 
 
 def config_from_hf(hf: dict, name: str = "") -> ModelConfig:
-    """Map an HF config.json dict to our ModelConfig."""
+    """Map an HF config.json dict to our ModelConfig (Llama/Mistral/Qwen
+    family, Mixtral MoE, Gemma-2)."""
     arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
-    moe = "Mixtral" in arch or "num_local_experts" in hf
+    gemma2 = "Gemma2" in arch or hf.get("model_type") == "gemma2"
     num_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+    max_context = hf.get("max_position_embeddings", 8192)
+    if gemma2 and hf.get("sliding_window"):
+        # Gemma-2 alternates sliding-window and global layers; this
+        # engine runs every layer global, which is EXACT while context
+        # stays within the window — clamp rather than silently diverge.
+        max_context = min(max_context, int(hf["sliding_window"]))
+    query_scale = None
+    if gemma2 and hf.get("query_pre_attn_scalar"):
+        query_scale = float(hf["query_pre_attn_scalar"]) ** -0.5
     return ModelConfig(
         name=name or hf.get("model_type", "hf-model"),
         vocab_size=hf["vocab_size"],
@@ -56,12 +66,22 @@ def config_from_hf(hf: dict, name: str = "") -> ModelConfig:
         num_kv_heads=hf.get("num_key_value_heads", num_heads),
         head_dim=head_dim,
         intermediate_size=hf["intermediate_size"],
-        max_context=hf.get("max_position_embeddings", 8192),
+        max_context=max_context,
         rope_theta=float(hf.get("rope_theta", 10_000.0)),
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         num_experts=hf.get("num_local_experts", 0),
         num_experts_per_token=hf.get("num_experts_per_tok", 2),
-        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        # HF omits defaulted keys from config.json; Gemma-2's default is
+        # TIED embeddings (Llama's is untied).
+        tie_embeddings=bool(hf.get("tie_word_embeddings", gemma2)),
+        activation="gelu_tanh" if gemma2 else "silu",
+        attn_soft_cap=hf.get("attn_logit_softcapping") if gemma2 else None,
+        final_soft_cap=(hf.get("final_logit_softcapping")
+                        if gemma2 else None),
+        post_norms=gemma2,
+        rms_offset=gemma2,
+        embed_scale=gemma2,
+        query_scale=query_scale,
     )
 
 
@@ -130,8 +150,18 @@ def load_params(model_dir: str,
                 "wo": lin(p + "self_attn.o_proj.weight"),
             },
             "attn_norm": vec(p + "input_layernorm.weight"),
-            "mlp_norm": vec(p + "post_attention_layernorm.weight"),
         }
+        if cfg.post_norms:
+            # Gemma-2 naming: post_attention_layernorm is a TRUE
+            # post-norm; the pre-MLP norm is pre_feedforward_layernorm
+            # (in Llama, post_attention_layernorm is the pre-MLP norm).
+            layer["post_attn_norm"] = vec(
+                p + "post_attention_layernorm.weight")
+            layer["mlp_norm"] = vec(p + "pre_feedforward_layernorm.weight")
+            layer["post_mlp_norm"] = vec(
+                p + "post_feedforward_layernorm.weight")
+        else:
+            layer["mlp_norm"] = vec(p + "post_attention_layernorm.weight")
         if cfg.is_moe:
             experts_gate = []
             experts_up = []

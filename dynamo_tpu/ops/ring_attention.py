@@ -37,6 +37,7 @@ def ring_causal_attention(
     axis_name: Optional[str] = None,  # None → single shard (degenerates
                                       # to masked causal attention)
     scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
 ) -> jax.Array:
     """Blockwise-causal attention; call inside `shard_map` with the T axis
     sharded over `axis_name` (or standalone with axis_name=None).
@@ -71,6 +72,8 @@ def ring_causal_attention(
         vf = v_cur.astype(jnp.float32)
         # [B, Hkv, G, T, Tk]
         s = jnp.einsum("btkgd,bckd->bkgtc", qg, kf)
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
         mask = (kv_pos[:, None, :] <= q_positions[:, :, None]
                 )[:, None, None, :, :]
         s = jnp.where(mask, s, NEG)

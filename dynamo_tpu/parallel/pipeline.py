@@ -92,6 +92,8 @@ def make_pp_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
     cfg.validate()
     if cfg.is_moe:
         raise ValueError("pp v1 supports dense models only")
+    if cfg.post_norms:
+        raise ValueError("pp v1 does not wire Gemma-style post-norms")
     S = mesh.shape["pp"]
     if cfg.num_layers % S != 0:
         raise ValueError(f"pp={S} must divide num_layers={cfg.num_layers}")

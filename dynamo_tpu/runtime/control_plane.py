@@ -56,8 +56,16 @@ class ControlPlaneState:
     must run on the owning event loop; notification fan-out is async-safe
     via call_soon."""
 
-    def __init__(self) -> None:
-        self._kv: Dict[str, Tuple[dict, Optional[int]]] = {}  # key → (val, lease)
+    def __init__(self, backend=None) -> None:
+        # Pluggable persistence for UNLEASED keys (runtime/kv_store.py —
+        # the reference's key_value_store backends); leased keys are
+        # liveness records and never persist.
+        from dynamo_tpu.runtime.kv_store import MemoryBackend
+
+        self._backend = backend or MemoryBackend()
+        self._kv: Dict[str, Tuple[dict, Optional[int]]] = {
+            k: (v, None) for k, v in self._backend.load().items()
+        }  # key → (val, lease)
         self._leases: Dict[int, float] = {}                   # lease → deadline
         self._lease_ttl: Dict[int, float] = {}
         self._lease_seq = itertools.count(1)
@@ -110,6 +118,8 @@ class ControlPlaneState:
         if lease is not None and lease not in self._leases:
             raise KeyError(f"unknown lease {lease}")
         self._kv[key] = (value, lease)
+        if lease is None:
+            self._backend.put(key, value)
         self._notify(WatchEvent("put", key, value))
 
     def get(self, key: str) -> Optional[dict]:
@@ -121,7 +131,9 @@ class ControlPlaneState:
 
     def delete(self, key: str) -> bool:
         if key in self._kv:
-            del self._kv[key]
+            _, lease = self._kv.pop(key)
+            if lease is None:
+                self._backend.delete(key)
             self._notify(WatchEvent("delete", key))
             return True
         return False

@@ -233,3 +233,53 @@ def test_tool_choice_and_history_rendering():
         ], tools=tools)
     text = pre.render_chat(req)
     assert "call_1" in text and "12C" in text
+
+
+def test_echo_engine_out_matrix():
+    """`--out echo` (reference dynamo-run out=echo, engines.rs:71):
+    streams the prompt back, capped by max_tokens."""
+    import asyncio
+
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.llm.echo import EchoEngine
+    from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+
+    async def main():
+        eng = EchoEngine(delay_ms=0.1)
+        req = PreprocessedRequest(
+            request_id="e", model="m", token_ids=[7, 8, 9, 10],
+            sampling=SamplingParams(max_tokens=3))
+        toks, finish = [], None
+        async for d in eng.generate(req):
+            toks.extend(d.token_ids)
+            if d.finished:
+                finish = d.finish_reason
+        assert toks == [7, 8, 9]
+        assert str(finish.value) == "length"
+
+    asyncio.run(main())
+
+
+def test_frontend_out_matrix_builds_handles():
+    """build_model_handle honors --out auto|echo|mocker."""
+    import asyncio
+    from types import SimpleNamespace
+
+    from dynamo_tpu.frontend.main import build_model_handle
+
+    def args(**kw):
+        base = dict(out="auto", mocker=False, tokenizer=None,
+                    model="tiny-test", model_name="m", num_blocks=64,
+                    block_size=8, max_tokens_default=8, speedup_ratio=10.0)
+        base.update(kw)
+        return SimpleNamespace(**base)
+
+    async def main():
+        for out, want_client in (("echo", "EchoEngine"),
+                                 ("mocker", "MockEngine"),
+                                 ("auto", "LocalEngineClient")):
+            handle, shutdown = await build_model_handle(args(out=out))
+            assert type(handle.client).__name__ == want_client, out
+            await shutdown()
+
+    asyncio.run(asyncio.wait_for(main(), 120))

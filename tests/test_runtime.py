@@ -364,3 +364,42 @@ def test_tcp_client_reconnects_and_restores_streams():
         await server2.stop()
 
     _run(main())
+
+
+def test_kv_file_backend_persists_unleased_only(tmp_path):
+    """File backend (reference key_value_store pluggability): unleased
+    config survives a control-plane restart; leased liveness records die
+    with their leases."""
+    from dynamo_tpu.runtime.kv_store import FileBackend, make_backend
+
+    path = str(tmp_path / "kv.json")
+
+    async def main():
+        state = ControlPlaneState(backend=FileBackend(path))
+        cp = InProcessControlPlane(state)
+        await cp.start()
+        lease = await cp.lease_grant(ttl=5.0, auto_keepalive=False)
+        await cp.put("disagg/ns/config", {"max_local_prefill_length": 64})
+        await cp.put("instances/ns/backend/gen:1", {"addr": "x"},
+                     lease=lease)
+        await cp.close()
+
+        # Restart with the same snapshot.
+        state2 = ControlPlaneState(backend=FileBackend(path))
+        cp2 = InProcessControlPlane(state2)
+        await cp2.start()
+        assert await cp2.get("disagg/ns/config") == {
+            "max_local_prefill_length": 64}
+        assert await cp2.get("instances/ns/backend/gen:1") is None
+        # Deletes propagate to the snapshot.
+        await cp2.delete("disagg/ns/config")
+        await cp2.close()
+        state3 = ControlPlaneState(backend=FileBackend(path))
+        assert state3.get("disagg/ns/config") is None
+
+    _run(main())
+    # Spec parsing.
+    assert type(make_backend(None)).__name__ == "MemoryBackend"
+    assert type(make_backend(f"file:{path}")).__name__ == "FileBackend"
+    with pytest.raises(ValueError):
+        make_backend("redis://nope")
