@@ -165,6 +165,47 @@ class EngineCore:
             dtype=config.cache_dtype,
         )
         self.mesh = config.mesh
+        # Multi-process mesh (SURVEY §2.5 multinode analog): every process
+        # runs this same EngineCore in SPMD lockstep — process 0 leads
+        # (scheduler + serving), followers replay its command stream
+        # (parallel/multihost.py).  Host→device inputs then ride
+        # make_array_from_callback (via sharding._finalize wrappers) and
+        # host reads come off replicated outputs.
+        self._mh = False
+        if self.mesh is not None:
+            from dynamo_tpu.parallel.multihost import mesh_spans_processes
+
+            self._mh = mesh_spans_processes(self.mesh)
+        # Host-side staging for device inputs: single-process uploads
+        # eagerly (device-resident caching matters on a tunneled chip);
+        # multihost keeps numpy and lets the step wrappers build global
+        # arrays per call (per-step data changes anyway).
+        self._dev = (lambda x: x) if self._mh else jnp.asarray
+        # Per-request-set-CONSTANT window state must not re-convert every
+        # dispatch (the same reason the single-process path caches device
+        # arrays): multihost converts ONCE to a global array with the
+        # batch sharding; the step wrapper then passes it through.
+        if self._mh:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from dynamo_tpu.parallel.multihost import to_global
+
+            _axes = (("dp", "tp") if config.dp_attention else "dp")
+
+            def _dev_row(x, _s=NamedSharding(self.mesh,
+                                             PartitionSpec(_axes))):
+                return to_global(x, _s)
+
+            def _dev_row2(x, _s=NamedSharding(self.mesh,
+                                              PartitionSpec(_axes, None))):
+                return to_global(x, _s)
+
+            self._dev_row, self._dev_row2 = _dev_row, _dev_row2
+        else:
+            self._dev_row = self._dev_row2 = jnp.asarray
+        # Lockstep broadcast channel (leader only; followers and
+        # single-process engines leave it None).
+        self._lockstep = None
 
         if params is None:
             params = init_params(cfg, jax.random.key(config.seed))
@@ -213,6 +254,10 @@ class EngineCore:
                     f"must divide by dp*tp={self._n_local_shards}")
         self._pp = (self.mesh is not None
                     and self.mesh.shape.get("pp", 1) > 1)
+        if self._mh and self._pp:
+            raise ValueError("pipeline parallelism under a multi-process "
+                             "mesh is not wired yet (multihost v1 covers "
+                             "tp/dp/dp-attention)")
         self._sp_step = None
         self.sp_prefill_count = 0  # served prefills that ran the ring path
         if self._pp:
@@ -300,8 +345,17 @@ class EngineCore:
             )
             from dynamo_tpu.llm.block_manager.manager import TieredConfig
 
-            self._extract_jit, self._inject_jit = kvc.make_block_ops(
-                self.block_size)
+            if self._mh:
+                from dynamo_tpu.parallel.sharding import (
+                    cache_pspecs as _cps)
+
+                self._extract_jit, self._inject_jit = kvc.make_block_ops(
+                    self.block_size, mesh=self.mesh,
+                    cache_specs=_cps(cfg.num_layers, config.dp_attention,
+                                     self._dp_local))
+            else:
+                self._extract_jit, self._inject_jit = kvc.make_block_ops(
+                    self.block_size)
             self.allocator = ManagedBlockSource(
                 TieredConfig(
                     device_blocks=config.num_blocks,
@@ -389,6 +443,13 @@ class EngineCore:
                     f"prompt_embeds shape {prompt_embeds.shape} must be "
                     f"[n <= {len(prompt_tokens)}, "
                     f"{self.config.model.hidden_size}]")
+        if self._lockstep is not None:
+            from dynamo_tpu.parallel.multihost import encode_sampling
+
+            self._lockstep.broadcast({
+                "op": "add", "rid": request_id,
+                "prompt": list(prompt_tokens),
+                "sampling": encode_sampling(sampling)})
         req = Request(request_id=request_id,
                       prompt_tokens=list(prompt_tokens), sampling=sampling,
                       prompt_embeds=prompt_embeds)
@@ -402,6 +463,9 @@ class EngineCore:
     def cancel(self, request_id: str) -> None:
         req = self._requests.get(request_id)
         if req and req.state is not RequestState.FINISHED:
+            if self._lockstep is not None:
+                self._lockstep.broadcast({"op": "cancel",
+                                          "rid": request_id})
             self._finish(req, FinishReason.CANCELLED)
 
     def has_request(self, request_id: str) -> bool:
@@ -424,6 +488,8 @@ class EngineCore:
         K-token window, sync the window from `window_pipeline_depth`
         dispatches ago.  Any scheduling change drains the pipeline first
         so host bookkeeping never diverges from device state."""
+        if self._lockstep is not None:
+            self._lockstep.broadcast({"op": "step"})
         plan = self.scheduler.plan()
         deltas: List[TokenDelta] = []
 
@@ -488,6 +554,7 @@ class EngineCore:
         # change with a server-side perf flag).
         return (self.config.speculative_tokens > 0
                 and not self._pp  # pp step has no all-positions logits
+                and not self._mh  # spec path not audited for lockstep v1
                 and plan.decode is not None
                 and plan.prefill is None
                 and not self.scheduler.waiting
@@ -649,7 +716,7 @@ class EngineCore:
         if not self._moe:
             return None
         if self._load_dev is not None:
-            self.expert_load += np.asarray(jax.device_get(self._load_dev),
+            self.expert_load += np.asarray(self._fetch_host(self._load_dev),
                                            dtype=np.int64)
             self._load_dev = None
         return self.expert_load
@@ -698,9 +765,9 @@ class EngineCore:
             self.sp_prefill_count += len(batch.items)
             logits, self.cache = self._sp_step(
                 self.params, self.cache,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(seq_lens), jnp.asarray(bts),
-                jnp.asarray(sample_pos))
+                self._dev(tokens), self._dev(positions),
+                self._dev(seq_lens), self._dev(bts),
+                self._dev(sample_pos))
         elif mm_items:
             # Multimodal prefill: chunk positions inside a request's
             # embedding span take the provided vision embeddings instead
@@ -730,9 +797,9 @@ class EngineCore:
                 jnp.asarray(mask))
         else:
             logits, self.cache = self._run_step(
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(seq_lens), jnp.asarray(bts),
-                jnp.asarray(sample_pos))
+                self._dev(tokens), self._dev(positions),
+                self._dev(seq_lens), self._dev(bts),
+                self._dev(sample_pos))
 
         deltas: List[TokenDelta] = []
         done_rows: List[int] = []
@@ -744,7 +811,7 @@ class EngineCore:
         if done_rows:
             # Sample first tokens for rows whose prompt completed (logits
             # already point at each row's last real chunk position).
-            sel = logits[jnp.asarray(done_rows)]
+            sel = self._select_rows(logits, done_rows)
             reqs = [batch.items[i].request for i in done_rows]
             sampled, lps = self._sample_rows(sel, reqs)
             for j, req in enumerate(reqs):
@@ -795,11 +862,12 @@ class EngineCore:
             return []
 
         logits, self.cache = self._run_step(
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(seq_lens), jnp.asarray(bts),
-            jnp.zeros((bucket,), jnp.int32))
+            self._dev(tokens), self._dev(positions),
+            self._dev(seq_lens), self._dev(bts),
+            self._dev(np.zeros((bucket,), np.int32)))
 
-        sampled, lps = self._sample_rows(logits[jnp.asarray(rows)], live)
+        sampled, lps = self._sample_rows(self._select_rows(logits, rows),
+                                         live)
         deltas = []
         for i, req in enumerate(live):
             # Publish blocks sealed by *previous* tokens before appending:
@@ -824,7 +892,8 @@ class EngineCore:
                     self.config.decode_window,
                     greedy_only=greedy_only,
                     use_pallas_decode=self._use_pallas,
-                    dp_attention=self.config.dp_attention)
+                    dp_attention=self.config.dp_attention,
+                    dp_local=self._dp_local)
             else:
                 from dynamo_tpu.models.llama import make_decode_window
 
@@ -885,7 +954,7 @@ class EngineCore:
             for i, req in zip(rows, reqs):
                 n = min(len(req.pages), width)
                 bts[i, :n] = req.pages[:n]
-            st["bts"] = jnp.asarray(bts)
+            st["bts"] = self._dev_row2(bts)
             st["pages_sig"] = pages_sig
         self._window_state = st
 
@@ -896,7 +965,7 @@ class EngineCore:
             for i, req in zip(rows, reqs):
                 toks[i] = (req.output_tokens[-1] if req.output_tokens
                            else req.prompt_tokens[-1])
-            last_tokens = jnp.asarray(toks)
+            last_tokens = self._dev_row(toks)
 
         (self.cache, out, st["pos"], st["seq"], st["off"]) = \
             self._window_fn(greedy_only)(
@@ -947,32 +1016,36 @@ class EngineCore:
             top_p[i] = req.sampling.top_p
             offsets[i] = (req.prior_output + len(req.output_tokens)
                           + lag * K)
+        # Keys are RAW uint32 key data (wrapped on device by the window
+        # fn): host-buildable numpy, which the multihost global-array
+        # conversion requires (typed key arrays can't cross it).
         if greedy_only:
-            base_keys = jax.random.split(jax.random.key(0), bucket)
+            key_data = np.zeros((bucket, 2), np.uint32)  # unused by argmax
         else:
             # One base key per request-set build; per-token randomness
             # comes from fold_in(base, offset) with offsets advancing on
             # device, so seeded streams stay reproducible and unseeded
             # rows never repeat a key.
             self._rng, sub = jax.random.split(self._rng)
-            base_keys = jax.random.split(sub, bucket)
+            key_data = np.array(jax.random.key_data(
+                jax.random.split(sub, bucket)))  # copy: jax views are RO
             for i, req in zip(rows, reqs):
                 if req.sampling.seed is not None:
-                    base_keys = base_keys.at[i].set(
-                        jax.random.key(req.sampling.seed))
+                    key_data[i] = np.asarray(jax.random.key_data(
+                        jax.random.key(req.sampling.seed)))
         pos_host = positions0.copy()
         return {
             "sig": sig,
             "pages_sig": tuple(len(r.pages) for r in reqs),
             "pos_host": pos_host,
-            "pos": jnp.asarray(positions0),
-            "seq": jnp.asarray(seq_lens0),
-            "bts": jnp.asarray(bts),
-            "temp": jnp.asarray(temp),
-            "topk": jnp.asarray(top_k),
-            "topp": jnp.asarray(top_p),
-            "keys": base_keys,
-            "off": jnp.asarray(offsets),
+            "pos": self._dev_row(positions0),
+            "seq": self._dev_row(seq_lens0),
+            "bts": self._dev_row2(bts),
+            "temp": self._dev_row(temp),
+            "topk": self._dev_row(top_k),
+            "topp": self._dev_row(top_p),
+            "keys": self._dev_row2(key_data),
+            "off": self._dev_row(offsets),
         }
 
     def _sync_one_window(self) -> List[TokenDelta]:
@@ -1016,6 +1089,26 @@ class EngineCore:
         self._hash_seqs.pop(req.request_id, None)
         self._published_blocks.pop(req.request_id, None)
         self.scheduler.preempt(req)
+
+    def _fetch_host(self, arr) -> np.ndarray:
+        """Device → host read valid under any topology (multihost
+        allgathers non-replicated arrays; every process reaches this
+        point in lockstep)."""
+        if self._mh:
+            from dynamo_tpu.parallel.multihost import fetch
+
+            return fetch(arr)
+        return np.asarray(arr)
+
+    def _select_rows(self, logits: jax.Array, rows: List[int]) -> jax.Array:
+        """Row-gather of the logits the sampler needs.  Multihost: pull
+        the (replicated) logits to host and re-enter as a process-LOCAL
+        array, so the whole sampling path below runs identically-local on
+        every process (no cross-process eager ops, no reverse channel —
+        followers derive the same tokens from the same bytes)."""
+        if self._mh:
+            return jnp.asarray(self._fetch_host(logits)[np.asarray(rows)])
+        return logits[jnp.asarray(rows)]
 
     def _sample_rows(self, logits: jax.Array, reqs: List[Request]
                      ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
@@ -1094,6 +1187,8 @@ class EngineCore:
         """Admin flush of all reusable cached blocks (reference
         `clear_kv_blocks.rs`); returns the number dropped.  Must run on
         the engine thread."""
+        if self._lockstep is not None:
+            self._lockstep.broadcast({"op": "clear"})
         clear = getattr(self.allocator, "clear_cache", None)
         return clear() if clear is not None else 0
 
@@ -1109,6 +1204,10 @@ class EngineCore:
         if self._pp:
             raise ValueError("embeddings are not wired for the pp engine "
                              "(pipeline stages have no return_hidden path)")
+        if self._mh:
+            raise ValueError("embeddings are not wired for multihost v1 "
+                             "(the embed route isn't in the lockstep "
+                             "command stream)")
         if self._embed_step is None:
             if self.mesh is not None:
                 from dynamo_tpu.parallel.sharding import (
@@ -1116,7 +1215,8 @@ class EngineCore:
 
                 self._embed_step = make_sharded_embed_step(
                     self.config.model, self.block_size, self.mesh,
-                    dp_attention=self.config.dp_attention)
+                    dp_attention=self.config.dp_attention,
+                    dp_local=self._dp_local)
             else:
                 from dynamo_tpu.models.llama import make_forward_step as mfs
 
@@ -1183,6 +1283,10 @@ class EngineCore:
         out: Dict[int, np.ndarray] = {}
         if not self._managed_cache:
             return out
+        if self._lockstep is not None:
+            # Followers must join the extract collectives (sharded cache).
+            self._lockstep.broadcast({"op": "export",
+                                      "hashes": [int(h) for h in hashes]})
         for h in hashes:
             data = self.allocator.manager.export_block(h)
             if data is not None:
@@ -1207,6 +1311,11 @@ class EngineCore:
         their prefill (the decode-side onboard of disaggregated P/D)."""
         if not self._managed_cache:
             return 0
+        if self._lockstep is not None:
+            from dynamo_tpu.parallel.multihost import encode_blocks
+
+            self._lockstep.broadcast({"op": "import",
+                                      "blocks": encode_blocks(blocks)})
         n = 0
         for h, data in blocks.items():
             if self.allocator.manager.import_block(h, data):
@@ -1220,13 +1329,14 @@ class EngineCore:
         dispatch is async and the result is an independent staging buffer,
         so the block manager's offload path can defer the host transfer
         off-thread (np.asarray on the handle syncs when bytes are
-        needed)."""
-        return self._extract_jit(self.cache, jnp.int32(page))
+        needed).  (Multihost: the sharded extract jit replicates its
+        output, so that off-thread read stays collective-free.)"""
+        return self._extract_jit(self.cache, np.int32(page))
 
     def _inject_block(self, page: int, data: np.ndarray) -> None:
         """Host array → device block (onboard/transfer-in)."""
-        self.cache = self._inject_jit(self.cache, jnp.int32(page),
-                                      jnp.asarray(data))
+        self.cache = self._inject_jit(self.cache, np.int32(page),
+                                      self._dev(data))
 
     def _on_block_evicted(self, block_hash: int) -> None:
         """Managed source evicted a block from G1 → router must forget it."""

@@ -166,7 +166,7 @@ def gather_kv(
             v.reshape(B, C, num_kv_heads, D))
 
 
-def make_block_ops(block_size: int):
+def make_block_ops(block_size: int, mesh=None, cache_specs=None):
     """Jitted whole-block extract/inject against the cache pytree.
 
     These are the device ends of every tier/wire movement — G1→G2 offload,
@@ -174,6 +174,12 @@ def make_block_ops(block_size: int):
     the reference's `block_copy.cu` scatter/gather kernel,
     `lib/llm/src/kernels/block_copy.cu:41`).  The page id is traced so one
     compiled program serves every page.
+
+    `mesh` + `cache_specs` (PartitionSpec pytree for the cache): build the
+    multihost variant — extract gathers the block REPLICATED so every
+    process can host-read it, inject takes host bytes on every process.
+    Required when the cache spans processes (the default jits would try
+    to host-read remote shards).
 
     Returns (extract, inject):
       extract(cache, page) -> [2, L, block_size, F] (K stacked on V)
@@ -202,4 +208,17 @@ def make_block_ops(block_size: int):
                   for i, layer in enumerate(cache["v"])],
         }
 
-    return jax.jit(extract), jax.jit(inject, donate_argnums=(0,))
+    if mesh is None:
+        return jax.jit(extract), jax.jit(inject, donate_argnums=(0,))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dynamo_tpu.parallel.multihost import wrap_global_inputs
+
+    cache_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), cache_specs)
+    rep = NamedSharding(mesh, P())
+    ex = jax.jit(extract, in_shardings=(cache_sh, rep), out_shardings=rep)
+    inj = jax.jit(inject, in_shardings=(cache_sh, rep, rep),
+                  out_shardings=cache_sh, donate_argnums=(0,))
+    return (wrap_global_inputs(ex, (cache_sh, rep)),
+            wrap_global_inputs(inj, (cache_sh, rep, rep)))

@@ -348,7 +348,7 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
 
     Returns run(params, cache, last_tokens[B], positions0[B], seq_lens0[B],
                 block_tables[B,P], temp[B], top_k[B], top_p[B],
-                base_keys[B], key_offsets[B])
+                base_key_data[B,2] uint32, key_offsets[B])
         -> (cache, tokens[K, B], positions0+K, seq_lens0+K, key_offsets+K).
 
     The advanced positions/seq_lens/offsets come back as DEVICE arrays so
@@ -362,9 +362,15 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
                              mesh=mesh, dp_local=dp_local)
 
     def run(params, cache, last_tokens, positions0, seq_lens0, block_tables,
-            temp, top_k, top_p, base_keys, key_offsets):
+            temp, top_k, top_p, base_key_data, key_offsets):
         B = last_tokens.shape[0]
         zero_pos = jnp.zeros((B,), jnp.int32)
+        # Keys travel as RAW uint32 key data [B, 2] and wrap on device:
+        # host code can then build them as plain numpy, which the
+        # multihost path requires (typed key arrays can't cross the
+        # host→global-array boundary).
+        base_keys = (None if greedy_only
+                     else jax.random.wrap_key_data(base_key_data))
         # Padding rows (seq_lens0 == 0) must stay dead across device-side
         # advances: their seq_lens pin at 0 (attention loop skipped, no
         # unbounded block-table indices) and their positions pin at the

@@ -1,0 +1,325 @@
+"""Multi-host (multi-process) engine execution.
+
+The reference treats multi-node serving as first-class — TRT-LLM srun
+launchers (`components/backends/trtllm/multinode/srun_disaggregated.sh`),
+SGLang SLURM jobs (`components/backends/sglang/slurm_jobs/`), and the
+operator's LeaderWorkerSet annotations
+(`deploy/cloud/operator/internal/dynamo/graph.go:145`).  There, multi-node
+means "one engine (vLLM/TRT-LLM) spanning N ranks via NCCL/MPI".  The
+TPU-native analog is one `EngineCore` spanning N JAX *processes* over a
+global device mesh: `jax.distributed.initialize` joins the processes,
+`jax.sharding.Mesh` spans every process's devices, and XLA collectives
+ride ICI within a slice / DCN across slices.
+
+Design — SPMD lockstep (the shadow engine):
+
+  Every process builds an IDENTICAL `EngineCore` (same config, same seed,
+  same params) over the same global mesh.  The *leader* (process 0) runs
+  the real serving stack (control plane, RPC, scheduler); *followers* run
+  a tiny command loop.  The leader broadcasts each engine-thread mutation
+  — add_request / cancel / step / import_blocks / clear — over a TCP
+  lockstep channel BEFORE executing it locally; followers replay the same
+  calls in the same order.  Because the scheduler and allocator are
+  deterministic pure-Python state machines, every process derives the
+  same device program sequence, which is exactly SPMD's requirement.
+  Host-visible results (sampled tokens) come off replicated device
+  outputs, so followers never need a reverse channel.
+
+  This mirrors how the reference's delegated engines work internally
+  (vLLM MP executor broadcasts scheduler output to all ranks each step;
+  TRT-LLM's orchestrator does the same over MPI) — but here it is OUR
+  engine, so the broadcast seam is ours too.
+
+Data movement rules under a multi-process mesh (enforced by helpers):
+  * host → device: numpy inputs must become global arrays via
+    `jax.make_array_from_callback` (each process serves its addressable
+    shards from the same host bytes) — plain `jnp.asarray` commits to one
+    process's devices and cannot enter a global computation.
+  * device → host: only fully-replicated arrays can be read locally;
+    anything else goes through `multihost_utils.process_allgather`,
+    which is itself a collective every process must join (safe here:
+    lockstep means every process reaches the same read).
+
+CPU test rig: 2 processes x N virtual CPU devices
+(`--xla_force_host_platform_device_count`) with gloo collectives —
+the no-TPU fixture SURVEY §4 calls for, validated in
+tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import struct
+import threading
+from typing import Callable, Iterable, Optional
+
+import msgpack
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# process bootstrap
+
+
+def setup_cpu_rig(devices_per_process: int) -> None:
+    """Force this process onto `devices_per_process` virtual CPU devices
+    with gloo cross-process collectives.  MUST run before any jax import
+    in the process (worker mains call it first thing when
+    --multihost-cpu-devices is given)."""
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count="
+        f"{devices_per_process}").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def initialize(coordinator: str, num_processes: int, process_id: int) -> None:
+    """Join the jax.distributed cluster (the NCCL/MPI-rendezvous analog).
+    After this, `jax.devices()` is the GLOBAL device list and meshes span
+    every process."""
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    logger.info("multihost: process %d/%d joined via %s (%d global devices)",
+                process_id, num_processes, coordinator,
+                len(jax.devices()))
+
+
+def mesh_spans_processes(mesh) -> bool:
+    """True when the mesh's devices live in more than one process —
+    the signal for every multihost-aware code path."""
+    procs = {d.process_index for d in mesh.devices.flat}
+    return len(procs) > 1
+
+
+# ---------------------------------------------------------------------------
+# host <-> device helpers
+
+
+def to_global(x, sharding):
+    """Host bytes (identical on every process) → global jax.Array."""
+    import jax
+
+    x = np.asarray(x)
+    return jax.make_array_from_callback(
+        x.shape, sharding, lambda idx, x=x: np.asarray(x[idx]))
+
+
+def _needs_convert(x, sharding) -> bool:
+    import jax
+
+    if not isinstance(x, jax.Array):
+        return True
+    try:
+        return x.sharding.device_set != sharding.device_set
+    except Exception:
+        return True
+
+
+def wrap_global_inputs(fn: Callable, in_shardings) -> Callable:
+    """Wrap a jitted fn so numpy / process-local args are converted to
+    global arrays per the fn's in_shardings tree.  Arrays already on the
+    global device set pass through when their sharding matches (donation
+    still applies); a replicated prior output feeding a sharded slot is
+    explicitly resharded (multiprocess jit refuses implicit resharding)."""
+    import jax
+
+    def leaf(a, s):
+        if _needs_convert(a, s):
+            return to_global(a, s)
+        if a.sharding != s:
+            return jax.device_put(a, s)
+        return a
+
+    def wrapped(*args):
+        conv = tuple(jax.tree.map(leaf, arg, sh)
+                     for arg, sh in zip(args, in_shardings))
+        return fn(*conv)
+
+    return wrapped
+
+
+def fetch(arr) -> np.ndarray:
+    """Device → host under any topology.  Fully-replicated (or
+    single-process) arrays read locally; otherwise every process joins a
+    process_allgather (lockstep guarantees they all reach this point)."""
+    import jax
+
+    if not isinstance(arr, jax.Array) or arr.is_fully_replicated:
+        return np.asarray(arr)
+    if len({d.process_index for d in arr.sharding.device_set}) <= 1:
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+# ---------------------------------------------------------------------------
+# lockstep command channel (leader → followers)
+
+_FRAME = struct.Struct(">I")
+
+
+class LockstepLeader:
+    """TCP fan-out of engine commands to follower processes.  Commands are
+    msgpack dicts; ordering per connection is the protocol's only
+    guarantee (and the only one SPMD needs).  Sends happen on the engine
+    thread — each frame is tiny (ids + token lists), so blocking socket
+    writes are fine next to a multi-ms device step."""
+
+    def __init__(self, port: int = 0, num_followers: int = 0):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self.num_followers = num_followers
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+
+    def wait_for_followers(self, timeout: float = 120.0) -> None:
+        self._srv.settimeout(timeout)
+        while len(self._conns) < self.num_followers:
+            conn, addr = self._srv.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            logger.info("lockstep: follower %d/%d connected from %s",
+                        len(self._conns), self.num_followers, addr)
+
+    def broadcast(self, cmd: dict) -> None:
+        blob = msgpack.packb(cmd, use_bin_type=True)
+        frame = _FRAME.pack(len(blob)) + blob
+        with self._lock:
+            for c in self._conns:
+                c.sendall(frame)
+
+    def close(self) -> None:
+        try:
+            self.broadcast({"op": "stop"})
+        except Exception:
+            pass
+        for c in self._conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._srv.close()
+
+
+class LockstepFollower:
+    def __init__(self, host: str, port: int, timeout: float = 120.0):
+        import time
+
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5.0)
+                break
+            except OSError:
+                # Leader may still be compiling/binding; followers retry
+                # until the join deadline (srun ranks start unordered).
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._buf = b""
+
+    def recv(self) -> dict:
+        while len(self._buf) < _FRAME.size:
+            self._more()
+        (n,) = _FRAME.unpack(self._buf[:_FRAME.size])
+        while len(self._buf) < _FRAME.size + n:
+            self._more()
+        blob = self._buf[_FRAME.size:_FRAME.size + n]
+        self._buf = self._buf[_FRAME.size + n:]
+        return msgpack.unpackb(blob, raw=False)
+
+    def _more(self) -> None:
+        chunk = self._sock.recv(1 << 16)
+        if not chunk:
+            raise ConnectionError("lockstep leader closed the channel")
+        self._buf += chunk
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+# ---------------------------------------------------------------------------
+# follower replay loop
+
+
+def _decode_sampling(d: dict):
+    from dynamo_tpu.engine.sampling import SamplingParams
+
+    return SamplingParams(
+        temperature=d["temperature"], top_k=d["top_k"], top_p=d["top_p"],
+        max_tokens=d["max_tokens"],
+        stop_token_ids=tuple(d["stop_token_ids"]),
+        seed=d["seed"], logprobs=d["logprobs"])
+
+
+def encode_sampling(s) -> dict:
+    return {"temperature": s.temperature, "top_k": s.top_k,
+            "top_p": s.top_p, "max_tokens": s.max_tokens,
+            "stop_token_ids": list(s.stop_token_ids), "seed": s.seed,
+            "logprobs": s.logprobs}
+
+
+def run_follower(core, chan: LockstepFollower,
+                 stop_event: Optional[threading.Event] = None) -> None:
+    """Replay the leader's engine-thread command stream on a shadow
+    EngineCore until the leader stops.  Every device computation the
+    leader launches, this process launches identically — that IS the
+    multihost execution contract."""
+    while stop_event is None or not stop_event.is_set():
+        cmd = chan.recv()
+        op = cmd["op"]
+        if op == "stop":
+            logger.info("lockstep: leader closed; follower exiting")
+            return
+        elif op == "step":
+            core.step()
+        elif op == "add":
+            try:
+                core.add_request(cmd["rid"], cmd["prompt"],
+                                 _decode_sampling(cmd["sampling"]))
+            except ValueError:
+                logger.warning("follower: rejected add %s (mirrors "
+                               "leader rejection)", cmd["rid"])
+        elif op == "cancel":
+            core.cancel(cmd["rid"])
+        elif op == "import":
+            blocks = {
+                int(h): np.frombuffer(
+                    raw, dtype=np.dtype(dt)).reshape(shape)
+                for h, (raw, dt, shape) in cmd["blocks"].items()}
+            core.import_blocks(blocks)
+        elif op == "export":
+            # Join the leader's extract computations (collective gathers
+            # under a sharded cache); the host copy lands leader-side.
+            core.export_blocks([int(h) for h in cmd["hashes"]])
+        elif op == "clear":
+            core.clear_prefix_cache()
+        else:
+            raise ValueError(f"unknown lockstep op {op!r}")
+
+
+def encode_blocks(blocks: dict) -> dict:
+    """numpy block dict → msgpack-able {hash: (bytes, dtype, shape)}."""
+    return {str(h): (np.ascontiguousarray(a).tobytes(), str(a.dtype),
+                     list(a.shape))
+            for h, a in blocks.items()}

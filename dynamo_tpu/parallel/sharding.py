@@ -157,10 +157,36 @@ def validate(cfg: ModelConfig, mesh: Mesh,
 
 
 def shard_pytree(tree, pspecs, mesh: Mesh):
-    """Place a pytree on the mesh according to a matching pspec pytree."""
+    """Place a pytree on the mesh according to a matching pspec pytree.
+
+    Under a multi-process mesh, host leaves become GLOBAL arrays via
+    make_array_from_callback (each process serves its addressable shards
+    from identical host bytes — plain device_put would commit to one
+    process's devices)."""
+    from dynamo_tpu.parallel.multihost import mesh_spans_processes, to_global
+
+    if mesh_spans_processes(mesh):
+        import numpy as _np
+
+        return jax.tree.map(
+            lambda x, s: to_global(_np.asarray(x), NamedSharding(mesh, s)),
+            tree, pspecs)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, pspecs
     )
+
+
+def _finalize(fn, in_shardings, mesh: Mesh):
+    """Multihost-aware jit wrapper: when the mesh spans processes, host
+    (numpy / process-local) inputs are converted to global arrays per the
+    in_shardings tree before the call; single-process meshes return the
+    jit untouched (zero overhead on the tuned serving path)."""
+    from dynamo_tpu.parallel.multihost import (
+        mesh_spans_processes, wrap_global_inputs)
+
+    if mesh_spans_processes(mesh):
+        return wrap_global_inputs(fn, in_shardings)
+    return fn
 
 
 def make_sp_prefill_step(cfg: ModelConfig, block_size: int, mesh: Mesh):
@@ -175,8 +201,10 @@ def make_sp_prefill_step(cfg: ModelConfig, block_size: int, mesh: Mesh):
     the regular step but with tokens/positions sharded P(dp, sp).
     """
     from dynamo_tpu.models.llama import make_forward_step
+    from dynamo_tpu.parallel.multihost import mesh_spans_processes
 
     validate(cfg, mesh)
+    mh = mesh_spans_processes(mesh)
     # MoE under sp: dense compute (the dispatch shard_map shards tokens
     # over dp×ep, which conflicts with the sp sharding of a prefill chunk).
     step = make_forward_step(cfg, block_size, moe_mode="dense", mesh=mesh,
@@ -193,16 +221,18 @@ def make_sp_prefill_step(cfg: ModelConfig, block_size: int, mesh: Mesh):
         NamedSharding(mesh, P("dp")),              # sample_positions [B]
     )
     out_shardings = (
-        NamedSharding(mesh, P("dp", None)),        # logits [B, V]
+        # Logits are host-read (sampling); multihost replicates them so
+        # every process can read locally (no off-thread collectives).
+        NamedSharding(mesh, P(None, None) if mh else P("dp", None)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      cache_pspecs(cfg.num_layers)),
     )
-    return jax.jit(
+    return _finalize(jax.jit(
         step,
         in_shardings=in_shardings,
         out_shardings=out_shardings,
         donate_argnums=(1,),
-    )
+    ), in_shardings, mesh)
 
 
 def resolve_moe_mode(cfg: ModelConfig, mesh: Mesh,
@@ -239,8 +269,10 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
     slot indexing).
     """
     from dynamo_tpu.models.llama import make_decode_window
+    from dynamo_tpu.parallel.multihost import mesh_spans_processes
 
     validate(cfg, mesh, dp_attention)
+    mh = mesh_spans_processes(mesh)
     if cfg.is_moe:
         raise ValueError("decode windows don't thread the MoE expert-load "
                          "aux; serve MoE models without windows")
@@ -266,23 +298,28 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
         b,                                         # temp [B]
         b,                                         # top_k [B]
         b,                                         # top_p [B]
-        b,                                         # base_keys [B] (keyed)
+        b2,                                        # base_key_data [B, 2]
         b,                                         # key_offsets [B]
     )
     out_shardings = (
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
-        NamedSharding(mesh, P(None, batch_axes)),  # tokens [K, B]
+        # Tokens are the one host-read output: multihost replicates them
+        # so the fetch thread can read locally (collectives are illegal
+        # off the lockstep thread).
+        NamedSharding(mesh, P(None, None) if mh else P(None, batch_axes)),
         b,                                         # positions0 + K
         b,                                         # seq_lens0 + K
         b,                                         # key_offsets + K
     )
-    return jax.jit(run, in_shardings=in_shardings,
-                   out_shardings=out_shardings, donate_argnums=(1,))
+    return _finalize(jax.jit(run, in_shardings=in_shardings,
+                             out_shardings=out_shardings,
+                             donate_argnums=(1,)), in_shardings, mesh)
 
 
 def make_sharded_embed_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
-                            dp_attention: bool = False):
+                            dp_attention: bool = False,
+                            dp_local: bool = False):
     """Jit the return_hidden step under a mesh (the /v1/embeddings path on
     a sharded engine — r3 raised NotImplementedError here)."""
     from dynamo_tpu.models.llama import make_forward_step
@@ -290,7 +327,7 @@ def make_sharded_embed_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
     validate(cfg, mesh, dp_attention)
     moe_mode = resolve_moe_mode(cfg, mesh)
     step = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
-                             return_hidden=True)
+                             return_hidden=True, dp_local=dp_local)
     batch_axes = ("dp", "tp") if dp_attention else "dp"
     b = NamedSharding(mesh, P(batch_axes))
     b2 = NamedSharding(mesh, P(batch_axes, None))
@@ -298,16 +335,17 @@ def make_sharded_embed_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      param_pspecs(cfg, moe_mode, dp_attention)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
         b2, b2, b, b2, b,
     )
     out_shardings = (
         b2,                                        # hidden [B, H]
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
     )
-    return jax.jit(step, in_shardings=in_shardings,
-                   out_shardings=out_shardings, donate_argnums=(1,))
+    return _finalize(jax.jit(step, in_shardings=in_shardings,
+                             out_shardings=out_shardings,
+                             donate_argnums=(1,)), in_shardings, mesh)
 
 
 def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
@@ -354,6 +392,9 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
     else:
         step = inner
     batch_axes = ("dp", "tp") if dp_attention else "dp"
+    from dynamo_tpu.parallel.multihost import mesh_spans_processes
+
+    mh = mesh_spans_processes(mesh)
     in_shardings = (
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      param_pspecs(cfg, moe_mode, dp_attention)),
@@ -366,15 +407,18 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         NamedSharding(mesh, P(batch_axes)),        # sample_positions [B]
     )
     out_shardings = [
-        NamedSharding(mesh, P(batch_axes, None)),  # logits [B, V]
+        # Logits are host-read (sampling); multihost replicates them so
+        # every process reads locally.
+        NamedSharding(mesh,
+                      P(None, None) if mh else P(batch_axes, None)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
     ]
     if with_expert_load:
         out_shardings.append(NamedSharding(mesh, P(None)))
-    return jax.jit(
+    return _finalize(jax.jit(
         step,
         in_shardings=in_shardings,
         out_shardings=tuple(out_shardings),
         donate_argnums=(1,),
-    )
+    ), in_shardings, mesh)

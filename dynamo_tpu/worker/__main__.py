@@ -1,3 +1,42 @@
-from dynamo_tpu.worker.main import main
+"""`python -m dynamo_tpu.worker` entry.
+
+Multihost flags are pre-scanned BEFORE the heavy imports: the CPU-rig env
+(XLA_FLAGS / platform) must be set before jax initialises, and
+jax.distributed must join before any engine module touches a device.
+"""
+
+import sys
+
+
+def _flag(name: str):
+    argv = sys.argv[1:]
+    if name in argv:
+        i = argv.index(name)
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    for a in argv:
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def _prescan() -> None:
+    n_cpu = _flag("--multihost-cpu-devices")
+    coord = _flag("--coordinator")
+    if not (n_cpu or coord):
+        return
+    from dynamo_tpu.parallel import multihost
+
+    if n_cpu and int(n_cpu) > 0:
+        multihost.setup_cpu_rig(int(n_cpu))
+    if coord:
+        multihost.initialize(coord,
+                             int(_flag("--num-processes") or 1),
+                             int(_flag("--process-id") or 0))
+
+
+_prescan()
+
+from dynamo_tpu.worker.main import main  # noqa: E402
 
 main()

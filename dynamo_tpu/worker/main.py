@@ -73,6 +73,22 @@ def parse_args(argv=None):
                    help="batch-sharded attention with slot-sharded KV "
                         "(tp beyond the kv-head count; reference sglang "
                         "--enable-dp-attention)")
+    # Multi-host: one EngineCore spanning N processes (SPMD lockstep,
+    # parallel/multihost.py; reference srun_disaggregated.sh / LWS
+    # multinode).  All ranks take IDENTICAL flags; rank 0 serves, ranks
+    # 1..N-1 follow.  --coordinator/--num-processes/--process-id are
+    # consumed by worker/__main__.py BEFORE jax init.
+    p.add_argument("--coordinator", default=None,
+                   help="jax.distributed coordinator HOST:PORT "
+                        "(multihost; all ranks pass the same value)")
+    p.add_argument("--num-processes", type=int, default=1)
+    p.add_argument("--process-id", type=int, default=0)
+    p.add_argument("--lockstep", default=None,
+                   help="leader's lockstep channel HOST:PORT (followers "
+                        "connect; the leader binds the PORT part)")
+    p.add_argument("--multihost-cpu-devices", type=int, default=0,
+                   help="CPU test rig: force N virtual CPU devices + "
+                        "gloo collectives in this process")
     p.add_argument("--decode-window", type=int, default=8,
                    help="fused decode window length (1 disables)")
     p.add_argument("--speedup-ratio", type=float, default=10.0)
@@ -87,10 +103,81 @@ def parse_args(argv=None):
          "metrics_interval": 1.0},
         section="worker"))
     args = p.parse_args(argv)
-    if not args.control_plane:
+    if not args.control_plane and args.process_id == 0:
         p.error("--control-plane is required (flag, DYN_CONTROL_PLANE, "
                 "or dynamo.toml)")
     return args
+
+
+def build_mesh(args):
+    """Mesh from the parallelism flags; under multihost the degrees MUST
+    span every process's chips — a prefix-sliced mesh that happens to fit
+    one rank's devices would leave follower ranks shadowing computations
+    on devices they can't address (and the lockstep channel pure
+    overhead)."""
+    if args.tp * args.dp * args.ep <= 1:
+        if args.num_processes > 1:
+            raise SystemExit(
+                "--num-processes > 1 needs parallelism degrees that span "
+                "the cluster (tp*dp*ep > 1); a meshless engine is "
+                "process-local by construction")
+        return None
+    import jax
+
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    mesh_cfg = MeshConfig(dp=args.dp, ep=args.ep, tp=args.tp)
+    devices = jax.devices()
+    if mesh_cfg.size > len(devices):
+        raise SystemExit(
+            f"mesh {mesh_cfg.describe()} needs {mesh_cfg.size} devices; "
+            f"{'the cluster' if args.num_processes > 1 else 'this host'} "
+            f"has {len(devices)}")
+    if mesh_cfg.size < len(devices):
+        logger.warning(
+            "mesh %s uses %d of %d devices; the rest idle "
+            "(run more workers or raise --dp)",
+            mesh_cfg.describe(), mesh_cfg.size, len(devices))
+    mesh = make_mesh(mesh_cfg, devices[:mesh_cfg.size])
+    if args.num_processes > 1:
+        from dynamo_tpu.parallel.multihost import mesh_spans_processes
+
+        if not mesh_spans_processes(mesh):
+            raise SystemExit(
+                f"mesh {mesh_cfg.describe()} fits rank 0's devices alone; "
+                "multihost requires degrees that span all "
+                f"{args.num_processes} processes' chips (raise --tp/--dp)")
+    return mesh
+
+
+def run_follower_rank(args) -> None:
+    """Ranks 1..N-1 of a multihost worker: build the identical shadow
+    EngineCore and replay the leader's lockstep command stream
+    (parallel/multihost.py; the srun-rank analog)."""
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models.loader import resolve_model
+    from dynamo_tpu.parallel.multihost import LockstepFollower, run_follower
+
+    if args.mocker:
+        raise SystemExit("--mocker has no multihost mode (no device state "
+                         "to span processes)")
+    if not args.lockstep:
+        raise SystemExit("follower ranks need --lockstep HOST:PORT")
+    cfg, params, _tok, _tpl = resolve_model(args.model or "llama-3-1b")
+    core = EngineCore(
+        EngineConfig(model=cfg,
+                     num_blocks=args.num_blocks,
+                     mesh=build_mesh(args),
+                     dp_attention=args.dp_attention,
+                     decode_window=args.decode_window,
+                     scheduler=SchedulerConfig(block_size=args.block_size)),
+        params=params)
+    host, port = _split(args.lockstep)
+    chan = LockstepFollower(host, port)
+    print(f"worker rank {args.process_id}/{args.num_processes} following "
+          f"lockstep at {args.lockstep}", flush=True)
+    run_follower(core, chan)
 
 
 async def build_engine(args, kv_event_sink):
@@ -115,24 +202,7 @@ async def build_engine(args, kv_event_sink):
 
     cfg, params, tok_spec, template = resolve_model(
         args.model or "llama-3-1b")
-    mesh = None
-    if args.tp * args.dp * args.ep > 1:
-        import jax
-
-        from dynamo_tpu.parallel import MeshConfig, make_mesh
-
-        mesh_cfg = MeshConfig(dp=args.dp, ep=args.ep, tp=args.tp)
-        devices = jax.devices()
-        if mesh_cfg.size > len(devices):
-            raise SystemExit(
-                f"mesh {mesh_cfg.describe()} needs {mesh_cfg.size} devices; "
-                f"this host has {len(devices)}")
-        if mesh_cfg.size < len(devices):
-            logger.warning(
-                "mesh %s uses %d of %d local devices; the rest idle "
-                "(run more workers or raise --dp)",
-                mesh_cfg.describe(), mesh_cfg.size, len(devices))
-        mesh = make_mesh(mesh_cfg, devices[:mesh_cfg.size])
+    mesh = build_mesh(args)
     core = EngineCore(
         EngineConfig(model=cfg,
                      num_blocks=args.num_blocks,
@@ -178,6 +248,19 @@ async def run(args) -> None:
 
     engine, metrics_fn, shutdown, card_fields, transfer_engine = \
         await build_engine(args, kv_event_sink)
+    lockstep = None
+    if args.num_processes > 1:
+        from dynamo_tpu.parallel.multihost import LockstepLeader
+
+        if transfer_engine is None:
+            raise SystemExit("--num-processes > 1 requires a real engine")
+        port = (_split(args.lockstep)[1] if args.lockstep else 0)
+        lockstep = LockstepLeader(port=port,
+                                  num_followers=args.num_processes - 1)
+        logger.info("multihost leader: lockstep on :%d, waiting for %d "
+                    "follower(s)", lockstep.port, args.num_processes - 1)
+        await asyncio.to_thread(lockstep.wait_for_followers)
+        transfer_engine.core._lockstep = lockstep
     transfer_plane = None
     if transfer_engine is not None:
         from dynamo_tpu.llm.block_manager.transfer import (
@@ -312,6 +395,8 @@ async def run(args) -> None:
     if status is not None:
         await status.stop()
     await shutdown()
+    if lockstep is not None:
+        lockstep.close()  # broadcasts "stop"; follower ranks exit
     await runtime.shutdown()
     await cp.close()
 
@@ -323,7 +408,11 @@ def _split(addr: str):
 
 def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
-    asyncio.run(run(parse_args(argv)))
+    args = parse_args(argv)
+    if args.num_processes > 1 and args.process_id > 0:
+        run_follower_rank(args)   # ranks 1..N-1: shadow engine, no serving
+        return
+    asyncio.run(run(args))
 
 
 if __name__ == "__main__":

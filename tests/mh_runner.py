@@ -1,0 +1,81 @@
+"""Subprocess body for the multihost tests: one leader + one follower
+process, each owning 4 virtual CPU devices, running ONE EngineCore over
+the 8-device global mesh in SPMD lockstep (parallel/multihost.py).
+
+Reference analog: the per-rank worker body an srun/LWS multinode launch
+starts (`components/backends/trtllm/multinode/srun_disaggregated.sh`) —
+every rank builds the same engine; rank 0 additionally drives it.
+
+Invoked by tests/test_multihost.py, never by pytest collection:
+    python tests/mh_runner.py <leader|follower> <coord_port> <lock_port> \
+        <mode>
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    role, coord_port, lock_port, mode = sys.argv[1:5]
+    devices_per_proc = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+    from dynamo_tpu.parallel import multihost
+
+    multihost.setup_cpu_rig(devices_per_proc)
+    multihost.initialize(f"127.0.0.1:{coord_port}", 2,
+                         0 if role == "leader" else 1)
+
+    import jax
+
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models import config as mcfg
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = mcfg.get_config("tiny-test")
+    total = len(jax.devices())
+    tp = min(cfg.num_kv_heads, max(1, total // 2))
+    mesh = make_mesh(MeshConfig(dp=total // tp, tp=tp), jax.devices())
+    dp_attention = mode == "dp_attention"
+    core = EngineCore(EngineConfig(
+        model=cfg, num_blocks=64, mesh=mesh,
+        dp_attention=dp_attention,
+        enable_prefix_cache=(mode == "prefix"),
+        decode_window=4,
+        scheduler=SchedulerConfig(block_size=16)))
+
+    if role == "follower":
+        chan = multihost.LockstepFollower("127.0.0.1", int(lock_port))
+        multihost.run_follower(core, chan)
+        # Emit the follower's mirrored request log so the test can assert
+        # true shadow-state convergence, not just absence of crashes.
+        print("FOLLOWER_DONE " + json.dumps(sorted(core._requests.keys())),
+              flush=True)
+        return
+
+    leader = multihost.LockstepLeader(port=int(lock_port), num_followers=1)
+    leader.wait_for_followers()
+    core._lockstep = leader
+
+    prompts = {
+        "req-a": [1, 2, 3, 4, 5, 6, 7, 8],
+        "req-b": [9, 8, 7, 6, 5],
+        "req-c": [42, 43],
+    }
+    sampled = {"req-c": SamplingParams(temperature=0.8, top_k=20,
+                                       seed=1234, max_tokens=12)}
+    for rid, toks in prompts.items():
+        core.add_request(rid, toks,
+                         sampled.get(rid, SamplingParams(max_tokens=12)))
+    out: dict = {rid: [] for rid in prompts}
+    steps = 0
+    while core.has_work and steps < 200:
+        for d in core.step():
+            out[d.request_id].extend(d.token_ids)
+        steps += 1
+    leader.close()
+    print("LEADER_TOKENS " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,228 @@
+"""Multi-host (multi-process) engine execution — VERDICT r4 missing #1.
+
+Two subprocesses (leader + follower), each with 4 virtual CPU devices and
+gloo collectives, run ONE EngineCore over the 8-device global mesh in
+SPMD lockstep; tokens must match the same engine run single-process on
+the test's own 8-device mesh (identical mesh shape + shardings → same
+computation graph, greedy decode → identical tokens).
+
+Reference analog: multinode TP via srun/MPI inside TRT-LLM
+(`components/backends/trtllm/multinode/srun_disaggregated.sh`), LWS
+multinode in the operator (`internal/dynamo/graph.go:145`).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUNNER = os.path.join(REPO, "tests", "mh_runner.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _clean_env() -> dict:
+    env = dict(os.environ)
+    # The runner sets its own platform/device-count flags (setup_cpu_rig);
+    # drop the test process's 8-device forcing so each subprocess gets 4.
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run_pair(mode: str, timeout: float = 300.0):
+    coord, lock = _free_port(), _free_port()
+    env = _clean_env()
+    follower = subprocess.Popen(
+        [sys.executable, RUNNER, "follower", str(coord), str(lock), mode],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    leader = subprocess.Popen(
+        [sys.executable, RUNNER, "leader", str(coord), str(lock), mode],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        lo, _ = leader.communicate(timeout=timeout)
+        fo, _ = follower.communicate(timeout=timeout)
+    finally:
+        for p in (leader, follower):
+            if p.poll() is None:
+                p.kill()
+    assert leader.returncode == 0, f"leader failed:\n{lo}\n--follower--\n{fo}"
+    assert follower.returncode == 0, f"follower failed:\n{fo}"
+    tokens = None
+    for line in lo.splitlines():
+        if line.startswith("LEADER_TOKENS "):
+            tokens = json.loads(line[len("LEADER_TOKENS "):])
+    assert tokens is not None, f"no leader tokens in:\n{lo}"
+    follower_rids = None
+    for line in fo.splitlines():
+        if line.startswith("FOLLOWER_DONE "):
+            follower_rids = json.loads(line[len("FOLLOWER_DONE "):])
+    assert follower_rids == [], \
+        f"follower retained requests {follower_rids} (state diverged)"
+    return tokens
+
+
+def _single_process_reference(mode: str):
+    """The same workload on the test process's own 8-device mesh."""
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models import config as mcfg
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = mcfg.get_config("tiny-test")
+    mesh = make_mesh(MeshConfig(dp=2, tp=4), jax.devices())
+    core = EngineCore(EngineConfig(
+        model=cfg, num_blocks=64, mesh=mesh,
+        dp_attention=(mode == "dp_attention"),
+        enable_prefix_cache=(mode == "prefix"),
+        decode_window=4,
+        scheduler=SchedulerConfig(block_size=16)))
+    prompts = {
+        "req-a": [1, 2, 3, 4, 5, 6, 7, 8],
+        "req-b": [9, 8, 7, 6, 5],
+        "req-c": [42, 43],
+    }
+    sampled = {"req-c": SamplingParams(temperature=0.8, top_k=20,
+                                       seed=1234, max_tokens=12)}
+    for rid, toks in prompts.items():
+        core.add_request(rid, toks,
+                         sampled.get(rid, SamplingParams(max_tokens=12)))
+    out: dict = {rid: [] for rid in prompts}
+    steps = 0
+    while core.has_work and steps < 200:
+        for d in core.step():
+            out[d.request_id].extend(d.token_ids)
+        steps += 1
+    return out
+
+
+@pytest.mark.parametrize("mode", ["plain", "prefix"])
+def test_multihost_decode_matches_single_process(mode):
+    got = _run_pair(mode)
+    want = _single_process_reference(mode)
+    for rid in want:
+        assert got[rid] == want[rid], (
+            f"{rid}: multihost {got[rid]} != single-process {want[rid]}")
+    assert all(len(v) > 0 for v in got.values())
+
+
+@pytest.mark.e2e
+def test_disagg_decode_on_two_process_mesh(tmp_path):
+    """VERDICT r4 next-1 'done' criterion: a disagg e2e with DECODE on a
+    2-process tp mesh — prefill runs on a separate single-process worker,
+    KV onboards into the multi-process decode engine (import_blocks rides
+    the lockstep channel so both ranks inject identically)."""
+    import asyncio
+    import time
+
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm.discovery import ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.service import ModelManager
+    from dynamo_tpu.runtime.control_plane_tcp import (
+        ControlPlaneClient, ControlPlaneServer)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    env = _clean_env()
+    coord, lock = _free_port(), _free_port()
+    procs = []
+
+    def spawn(name, extra):
+        log = open(tmp_path / f"{name}.log", "w+")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.worker",
+             "--model", "tiny-test", "--block-size", "8",
+             "--decode-window", "4"] + extra,
+            env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+            text=True)
+        p._log = log
+        procs.append(p)
+        return p
+
+    async def main():
+        cp_server = ControlPlaneServer()
+        cp_port = await cp_server.start()
+        cp = ControlPlaneClient("127.0.0.1", cp_port)
+        await cp.start()
+        runtime = DistributedRuntime(cp)
+        models = ModelManager()
+        watcher = ModelWatcher(runtime, models, migration_limit=0)
+        await watcher.start()
+        svc = HttpService(models)
+        http_port = await svc.start()
+
+        cp_addr = f"127.0.0.1:{cp_port}"
+        mh = ["--multihost-cpu-devices", "1",
+              "--coordinator", f"127.0.0.1:{coord}",
+              "--num-processes", "2", "--tp", "2",
+              "--lockstep", f"127.0.0.1:{lock}"]
+        spawn("decode-follower", mh + ["--process-id", "1"])
+        decode = spawn("decode-leader", mh + [
+            "--process-id", "0", "--control-plane", cp_addr,
+            "--model-name", "tiny-mh", "--role", "decode",
+            "--max-local-prefill", "8"])
+        spawn("prefill", ["--control-plane", cp_addr,
+                          "--role", "prefill"])
+
+        await watcher.wait_for_model("tiny-mh", timeout=180)
+        base = f"http://127.0.0.1:{http_port}"
+        async with ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json={
+                    "model": "tiny-mh",
+                    "messages": [{"role": "user",
+                                  "content": "a fairly long prompt that "
+                                             "exceeds the local prefill "
+                                             "threshold for sure"}],
+                    "max_tokens": 8}) as r:
+                body = await r.json()
+                assert r.status == 200, body
+                assert body["choices"][0]["message"]["content"]
+
+        # The decode leader must have onboarded remote-prefilled KV.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            decode._log.flush()
+            decode._log.seek(0)
+            log = decode._log.read()
+            if "remote prefill" in log and "onboarded" in log:
+                break
+            await asyncio.sleep(0.5)
+        assert "onboarded" in log, f"no remote prefill in decode log:\n{log}"
+
+        await watcher.stop()
+        await svc.stop()
+        await runtime.shutdown()
+        await cp.close()
+        await cp_server.stop()
+
+    try:
+        asyncio.run(asyncio.wait_for(main(), timeout=300))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        time.sleep(1)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p._log.flush()
+            p._log.seek(0)
+            out = p._log.read()
+            if out:
+                print(f"--- {p.args[-1]} (rc={p.poll()}) ---")
+                print(out[-2500:])
