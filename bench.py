@@ -174,9 +174,16 @@ def bench_raw_step(cfg, params, use_pallas_decode):
     t0 = time.perf_counter()
     run(1)  # compile
     compile_s = time.perf_counter() - t0
+    # Median of 3 slopes: the shared chip's tenancy jitter produced a
+    # single-slope reading of 1.24 ms/step in r5 — below the 4.3 ms HBM
+    # roofline, i.e. physically impossible — and one bad slope must not
+    # define the round's headline number.
     n1, n2 = 4, 20
-    t1, t2 = run(n1), run(n2)
-    step_s = max((t2 - t1) / (n2 - n1), 1e-9)
+    slopes = []
+    for _ in range(3):
+        t1, t2 = run(n1), run(n2)
+        slopes.append(max((t2 - t1) / (n2 - n1), 1e-9))
+    step_s = sorted(slopes)[1]
     return BATCH / step_s, step_s, compile_s
 
 
@@ -216,8 +223,11 @@ def bench_window(cfg, params, window: int):
 
     run(1)  # compile
     n1, n2 = 2, 6
-    t1, t2 = run(n1), run(n2)
-    win_s = max((t2 - t1) / (n2 - n1), 1e-9)
+    slopes = []
+    for _ in range(3):
+        t1, t2 = run(n1), run(n2)
+        slopes.append(max((t2 - t1) / (n2 - n1), 1e-9))
+    win_s = sorted(slopes)[1]  # median of 3 (shared-chip jitter)
     return BATCH * window / win_s, win_s / window
 
 
@@ -233,6 +243,10 @@ def bench_serving_path(cfg, params, decode_window, n_waves=3):
     transient dominated a ~2 s decode and 'serving/raw' mostly measured
     compile amortisation, not the serving path.)"""
     n_out = 256
+    # Waves use an UNBOUNDED mixed budget so the ramp runs full-batch
+    # prefill and the timed decode phase measures the full 64-row fleet
+    # (the r4-comparable serving number).  The interference section below
+    # swaps in the default bounded budget — that is the knob it measures.
     core = EngineCore(
         EngineConfig(
             model=cfg,
@@ -243,6 +257,7 @@ def bench_serving_path(cfg, params, decode_window, n_waves=3):
                 max_seqs=BATCH, block_size=BLOCK,
                 max_pages_per_seq=MAX_PAGES,
                 max_prefill_chunk=512, max_batched_tokens=8192,
+                mixed_prefill_tokens=8192,
                 decode_buckets=(16, 64), prefill_buckets=(512,)),
         ),
         params=params,
@@ -250,7 +265,20 @@ def bench_serving_path(cfg, params, decode_window, n_waves=3):
     serving_runs, prefill_runs = [], []
     for wave in range(n_waves):
         rng = np.random.default_rng(wave)
+        # Pure prefill measurement: max_tokens=1 requests never decode,
+        # so the phase is 100% prefill batches.  (Decode windows now
+        # interleave with prefill chunks — VERDICT r4 weak #4 — so timing
+        # a normal wave's prefill phase would charge decode-window time
+        # to the prefill metric.)
         t0 = time.perf_counter()
+        for i in range(BATCH):
+            prompt = rng.integers(1, cfg.vocab_size, size=CTX).tolist()
+            core.add_request(f"p{wave}r{i}", prompt,
+                             SamplingParams(max_tokens=1))
+        while core.has_work:
+            core.step()
+        prefill_runs.append(BATCH * CTX / (time.perf_counter() - t0))
+
         for i in range(BATCH):
             prompt = rng.integers(1, cfg.vocab_size, size=CTX).tolist()
             core.add_request(f"w{wave}r{i}", prompt,
@@ -258,7 +286,6 @@ def bench_serving_path(cfg, params, decode_window, n_waves=3):
         while any(r.state.value in ("waiting", "prefill")
                   for r in core._requests.values()):
             core.step()
-        prefill_runs.append(BATCH * CTX / (time.perf_counter() - t0))
 
         produced = 0
         t0 = time.perf_counter()
@@ -273,7 +300,13 @@ def bench_serving_path(cfg, params, decode_window, n_waves=3):
     # disagg exists is prefill stalling decode ITL, and no number
     # captured it): steady decode of half the fleet, then inject fresh
     # prompts mid-flight and measure decode throughput across the
-    # injection window vs the same run's undisturbed phase.
+    # injection window vs the same run's undisturbed phase.  This section
+    # measures the BOUNDED mixed budget (the serving default).
+    import dataclasses as _dc
+
+    core.scheduler.config = _dc.replace(
+        core.scheduler.config,
+        mixed_prefill_tokens=SchedulerConfig().mixed_prefill_tokens)
     half = BATCH // 2
     rng = np.random.default_rng(99)
     for i in range(half):

@@ -1,0 +1,3 @@
+from dynamo_tpu.control_plane_service import main
+
+main()

@@ -193,6 +193,23 @@ class SchedulerConfig:
     watermark: float = 0.01               # min free-block fraction to admit
     decode_buckets: tuple = (1, 2, 4, 8, 16, 32, 64)
     prefill_buckets: tuple = (16, 32, 64, 128, 256, 512)
+    # Row buckets for PREFILL batches.  Distinct from decode_buckets: a
+    # bounded mixed-step chunk is often a single row, and padding it to
+    # the decode bucket (r5 first cut: 1 real row padded to 16 × 512
+    # tokens = a full 8192-token device call for 512 useful tokens) made
+    # every mixed step pay the whole-batch price the budget was supposed
+    # to avoid.
+    prefill_row_buckets: tuple = (1, 2, 4, 8, 16, 32, 64)
+    # Prefill token cap per step WHILE decode sequences are running — the
+    # decode-ITL interference bound (reference: vLLM-style chunked
+    # prefill, mocker `protocols.rs:97-98`).  An unbounded mixed batch
+    # (r4: up to max_batched_tokens = 8192 tokens ≈ 700 ms on the 1B
+    # flagship) stalls every in-flight stream for the whole batch;
+    # bounding it trades prefill ramp for steady ITL.  The engine
+    # dispatches the bounded chunk CONCURRENTLY with the decode window,
+    # so decode throughput degrades by ~chunk_time/window_time, not by a
+    # full batch stall.
+    mixed_prefill_tokens: int = 512
     # dp-attention locality: slot → allocator shard (engine-installed;
     # None = shard-less allocation).  A request's pages then come from
     # the cache range local to its decode rows' tp shard.
@@ -219,6 +236,12 @@ class SchedulerConfig:
             if n <= b:
                 return b
         return self.prefill_buckets[-1]
+
+    def bucket_for_prefill_rows(self, n: int) -> int:
+        for b in self.prefill_row_buckets:
+            if n <= b:
+                return b
+        return self.prefill_row_buckets[-1]
 
     def bucket_for_pages(self, n: int) -> int:
         """Block-table width bucket: the device step's context gather costs
@@ -397,6 +420,9 @@ class Scheduler:
                     (r.context_len + bs - 1) // bs for r in decoding)),
             )
             budget -= len(decoding)
+            # Interference bound: with streams decoding, prefill gets at
+            # most mixed_prefill_tokens this step (see SchedulerConfig).
+            budget = min(budget, self.config.mixed_prefill_tokens)
 
         items: List[PrefillWork] = []
         for req in self.running:
@@ -415,7 +441,7 @@ class Scheduler:
         if items:
             prefill = PrefillBatch(
                 items=items,
-                rows=self.config.bucket_for_decode(len(items)),
+                rows=self.config.bucket_for_prefill_rows(len(items)),
                 chunk=self.config.bucket_for_prefill(
                     max(w.length for w in items)),
                 pages=self.config.bucket_for_pages(max(

@@ -138,10 +138,17 @@ async def build_model_handle(args) -> tuple:
         params=params)
     engine = InferenceEngine(core)
     await engine.start()
+    # Single-process multimodal: image_url parts encode in-process (the
+    # stub vision tower) — no encode worker needed for in= engine mode.
+    from dynamo_tpu.llm.multimodal import MultimodalAttach, StubVisionEncoder
+
     handle = ModelHandle(name=args.model_name, tokenizer=tokenizer,
                          preprocessor=pre,
                          client=LocalEngineClient(engine),
-                         max_context=cfg.max_context)
+                         max_context=cfg.max_context,
+                         multimodal=MultimodalAttach(
+                             local_encoder=StubVisionEncoder(
+                                 cfg.hidden_size)))
     return handle, engine.stop
 
 

@@ -39,7 +39,7 @@ logger = logging.getLogger(__name__)
 
 def request_to_wire(req: PreprocessedRequest) -> dict:
     s = req.sampling
-    return {
+    d = {
         "request_id": req.request_id,
         "model": req.model,
         "token_ids": list(req.token_ids),
@@ -52,10 +52,27 @@ def request_to_wire(req: PreprocessedRequest) -> dict:
         "stop_sequences": list(req.stop_sequences),
         "annotations": dict(req.annotations),
     }
+    if req.prompt_embeds is not None:
+        # Multimodal embeddings ride the request frame as raw f32 bytes
+        # (msgpack bin) — the frontend→worker leg of the reference's
+        # encode→prefill embedding transfer (multimodal_v1/components).
+        import numpy as np
+
+        emb = np.ascontiguousarray(np.asarray(req.prompt_embeds,
+                                              dtype=np.float32))
+        d["prompt_embeds"] = emb.tobytes()
+        d["prompt_embeds_shape"] = list(emb.shape)
+    return d
 
 
 def request_from_wire(d: dict) -> PreprocessedRequest:
     s = d.get("sampling", {})
+    embeds = None
+    if d.get("prompt_embeds") is not None:
+        import numpy as np
+
+        embeds = np.frombuffer(d["prompt_embeds"], dtype=np.float32) \
+            .reshape(d["prompt_embeds_shape"]).copy()
     return PreprocessedRequest(
         request_id=d["request_id"], model=d.get("model", ""),
         token_ids=list(d["token_ids"]),
@@ -68,6 +85,7 @@ def request_from_wire(d: dict) -> PreprocessedRequest:
             logprobs=bool(s.get("logprobs", False))),
         stop_sequences=list(d.get("stop_sequences", [])),
         annotations=dict(d.get("annotations", {})),
+        prompt_embeds=embeds,
     )
 
 
@@ -238,9 +256,16 @@ async def register_llm(
         "endpoint": endpoint.name,
         "instance_id": instance.instance_id,
     }
-    await endpoint.runtime.cp.put(
-        model_key(card.name, instance.instance_id), entry,
-        lease=instance.instance_id)
+
+    async def _put():
+        # Bound to the endpoint's CURRENT lease so a control-plane
+        # restart replays the model entry too (Endpoint re-registration).
+        await endpoint.runtime.cp.put(
+            model_key(card.name, instance.instance_id), entry,
+            lease=endpoint._lease)
+
+    await _put()
+    endpoint.add_registration_put(_put)
 
 
 # ---------------------------------------------------------------------------
@@ -328,13 +353,23 @@ class ModelWatcher:
             routed = RemoteEngineClient(client)
         engine_client = MigrationClient(
             routed, migration_limit=self.migration_limit)
+        # Multimodal: every dynamic model gets the attach hook pointed at
+        # the namespace's encoder endpoint (`encoder/encode`); requests
+        # without image parts never touch it, and requests with them get
+        # a clear 502 when no encode worker is live.
+        from dynamo_tpu.llm.multimodal import MultimodalAttach
+
+        mm = MultimodalAttach(
+            endpoint=(self.runtime.namespace(entry["namespace"])
+                      .component("encoder").endpoint("encode")))
         self.manager.register(ModelHandle(
             name=name, tokenizer=tokenizer,
             preprocessor=OpenAIPreprocessor(
                 tokenizer, chat_template=card.chat_template,
                 default_max_tokens=card.default_max_tokens),
             client=engine_client,
-            max_context=card.max_context))
+            max_context=card.max_context,
+            multimodal=mm))
         logger.info("model %r registered (instance %d)", name,
                     entry["instance_id"])
 

@@ -150,6 +150,19 @@ class HttpService:
             pre = handle.preprocessor.preprocess_chat(body, rid)
         except ValueError as e:
             return self._error(400, str(e))
+        mm = handle.multimodal
+        if mm is not None and mm.image_refs(body.messages):
+            # image_url parts → encode worker → prompt_embeds
+            # (llm/multimodal.py; reference multimodal_v1 processor).
+            try:
+                pre = await mm.attach(body.messages, pre)
+            except Exception as e:
+                return self._error(
+                    502, f"image encoding failed: {e}", "encode_error")
+        elif mm is None and self._has_image_parts(body.messages):
+            return self._error(
+                400, "this model has no multimodal pipeline configured "
+                     "(image_url parts unsupported)")
         err = self._validate_context(handle, pre)
         if err is not None:
             return err
@@ -158,6 +171,12 @@ class HttpService:
         if body.stream:
             return await self._stream_chat(request, handle, body, pre, rid)
         return await self._unary_chat(handle, body, pre, rid)
+
+    @staticmethod
+    def _has_image_parts(messages) -> bool:
+        from dynamo_tpu.llm.multimodal import MultimodalAttach
+
+        return bool(MultimodalAttach.image_refs(messages))
 
     async def completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -683,14 +702,28 @@ class HttpService:
                         oai.sse_encode(head_chunk(i)).encode())
             remaining = len(clones)
             while remaining:
-                kind, i, out, lps = await queue.get()
-                if kind == "done":
-                    remaining -= 1
-                elif kind == "error":
-                    raise out
-                else:
-                    await response.write(
-                        oai.sse_encode(make_chunk(i, out, lps)).encode())
+                # Coalesce every READY chunk into one socket write: at
+                # high token rates the queue backs up while a write
+                # drains, and one syscall per token-delta was a top-2
+                # cost in frontend_bench (the reason the reference keeps
+                # this loop in Rust, SURVEY §2.4.2).
+                batch = [await queue.get()]
+                while True:
+                    try:
+                        batch.append(queue.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                buf = []
+                for kind, i, out, lps in batch:
+                    if kind == "done":
+                        remaining -= 1
+                    elif kind == "error":
+                        raise out
+                    else:
+                        buf.append(
+                            oai.sse_encode(make_chunk(i, out, lps)).encode())
+                if buf:
+                    await response.write(b"".join(buf))
             if (body.stream_options or {}).get("include_usage"):
                 n_in = len(pre.token_ids)
                 total_out = sum(d.completion_tokens for d in dets)

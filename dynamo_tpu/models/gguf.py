@@ -11,9 +11,11 @@ implements GGUF v2/v3:
                   first), ggml_type u32, offset u64
     padding to `general.alignment` (default 32), then tensor data
 
-Supported tensor types: F32, F16, and Q8_0 (32-element blocks of one
-f16 scale + 32 int8 — dequantised on load; the most common "good
-quality" quant).  Other quants raise with the type name.
+Supported tensor types: F32, F16, Q8_0 (32-element blocks of one f16
+scale + 32 int8), and the K-quant family people actually serve —
+Q4_K / Q5_K / Q6_K (256-element superblocks with 6-bit sub-scales; bit
+layouts follow ggml's `dequantize_row_q{4,5,6}_K`).  All dequantise to
+f32 on load; other quants raise with the type name.
 
 Weight conventions: GGML `ne` lists dims fastest-first, so a linear
 layer y = W @ x is stored [n_in (ne0), n_out (ne1)] row-major by out —
@@ -45,9 +47,20 @@ GGUF_MAGIC = b"GGUF"
 GGML_F32 = 0
 GGML_F16 = 1
 GGML_Q8_0 = 8
+GGML_Q4_K = 12
+GGML_Q5_K = 13
+GGML_Q6_K = 14
 _TYPE_NAMES = {0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0",
                7: "Q5_1", 8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K",
                12: "Q4_K", 13: "Q5_K", 14: "Q6_K", 15: "Q8_K"}
+QK_K = 256  # K-quant superblock length
+# bytes per block: (block_bytes, block_elems)
+_BLOCK_GEOM = {
+    GGML_Q8_0: (34, 32),
+    GGML_Q4_K: (144, QK_K),   # d f16 + dmin f16 + 12 scale bytes + 128 qs
+    GGML_Q5_K: (176, QK_K),   # ... + 32 qh bytes
+    GGML_Q6_K: (210, QK_K),   # 128 ql + 64 qh + 16 scales + d f16
+}
 
 # metadata value types
 _U8, _I8, _U16, _I16, _U32, _I32, _F32, _BOOL, _STR, _ARR, _U64, _I64, \
@@ -83,6 +96,93 @@ def _read_value(f: BinaryIO, vtype: int) -> Any:
     raise ValueError(f"unknown gguf metadata type {vtype}")
 
 
+def _scale_min_k4(scales: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Unpack the 12-byte K-quant scale block into 8 six-bit (scale, min)
+    pairs per superblock (ggml `get_scale_min_k4`): j<4 reads the low 6
+    bits of bytes j / j+4; j>=4 combines the low nibble of byte j+4 with
+    the top 2 bits of byte j-4 (scale) / j (min).
+
+    scales: [n_blocks, 12] u8 → (sc, mn): [n_blocks, 8] f32."""
+    q = scales.astype(np.uint16)
+    sc = np.empty(q.shape[:-1] + (8,), np.float32)
+    mn = np.empty_like(sc)
+    for j in range(4):
+        sc[..., j] = (q[..., j] & 63).astype(np.float32)
+        mn[..., j] = (q[..., j + 4] & 63).astype(np.float32)
+    for j in range(4, 8):
+        sc[..., j] = ((q[..., j + 4] & 0x0F)
+                      | ((q[..., j - 4] >> 6) << 4)).astype(np.float32)
+        mn[..., j] = ((q[..., j + 4] >> 4)
+                      | ((q[..., j] >> 6) << 4)).astype(np.float32)
+    return sc, mn
+
+
+def _dequant_q4_k(raw: bytes, n_blocks: int) -> np.ndarray:
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)),
+         ("qs", "u1", (128,))]), count=n_blocks)
+    d = rec["d"].astype(np.float32)[:, None]          # [B, 1]
+    dmin = rec["dmin"].astype(np.float32)[:, None]
+    sc, mn = _scale_min_k4(rec["scales"])             # [B, 8]
+    # qs: 4 chunks of 32 bytes; each byte holds (low nibble → sub-block
+    # 2c, high nibble → sub-block 2c+1).
+    qs = rec["qs"].reshape(n_blocks, 4, 32)
+    lo = (qs & 0x0F).astype(np.float32)               # [B, 4, 32]
+    hi = (qs >> 4).astype(np.float32)
+    out = np.empty((n_blocks, 8, 32), np.float32)
+    out[:, 0::2] = lo
+    out[:, 1::2] = hi
+    return ((d * sc)[:, :, None] * out
+            - (dmin * mn)[:, :, None]).reshape(-1)
+
+
+def _dequant_q5_k(raw: bytes, n_blocks: int) -> np.ndarray:
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)),
+         ("qh", "u1", (32,)), ("qs", "u1", (128,))]), count=n_blocks)
+    d = rec["d"].astype(np.float32)[:, None]
+    dmin = rec["dmin"].astype(np.float32)[:, None]
+    sc, mn = _scale_min_k4(rec["scales"])
+    qs = rec["qs"].reshape(n_blocks, 4, 32)
+    qh = rec["qh"]                                    # [B, 32]
+    out = np.empty((n_blocks, 8, 32), np.float32)
+    for j in range(4):
+        u1, u2 = 1 << (2 * j), 2 << (2 * j)
+        out[:, 2 * j] = ((qs[:, j] & 0x0F)
+                         + np.where(qh & u1, 16, 0)).astype(np.float32)
+        out[:, 2 * j + 1] = ((qs[:, j] >> 4)
+                             + np.where(qh & u2, 16, 0)).astype(np.float32)
+    return ((d * sc)[:, :, None] * out
+            - (dmin * mn)[:, :, None]).reshape(-1)
+
+
+def _dequant_q6_k(raw: bytes, n_blocks: int) -> np.ndarray:
+    rec = np.frombuffer(raw, dtype=np.dtype(
+        [("ql", "u1", (128,)), ("qh", "u1", (64,)),
+         ("scales", "i1", (16,)), ("d", "<f2")]), count=n_blocks)
+    d = rec["d"].astype(np.float32)                   # [B]
+    sc = rec["scales"].astype(np.float32)             # [B, 16]
+    out = np.empty((n_blocks, 2, 4, 32), np.float32)  # halves x rows x l
+    for h in range(2):                                # two 128-elem halves
+        ql = rec["ql"][:, 64 * h:64 * (h + 1)]        # [B, 64]
+        qh = rec["qh"][:, 32 * h:32 * (h + 1)]        # [B, 32]
+        q1 = ((ql[:, :32] & 0x0F) | ((qh >> 0) & 3) << 4).astype(
+            np.int8)
+        q2 = ((ql[:, 32:] & 0x0F) | ((qh >> 2) & 3) << 4).astype(np.int8)
+        q3 = ((ql[:, :32] >> 4) | ((qh >> 4) & 3) << 4).astype(np.int8)
+        q4 = ((ql[:, 32:] >> 4) | ((qh >> 6) & 3) << 4).astype(np.int8)
+        for r, q in enumerate((q1, q2, q3, q4)):
+            # row r covers elements [128h + 32r, 128h + 32(r+1)); its
+            # 16-elem groups use scales[8h + 2r + l//16].
+            g0 = sc[:, 8 * h + 2 * r][:, None]
+            g1 = sc[:, 8 * h + 2 * r + 1][:, None]
+            scale = np.concatenate(
+                [np.repeat(g0, 16, axis=1), np.repeat(g1, 16, axis=1)],
+                axis=1)                               # [B, 32]
+            out[:, h, r] = (q.astype(np.float32) - 32.0) * scale
+    return (d[:, None, None, None] * out).reshape(-1)
+
+
 def _dequant(raw: bytes, ggml_type: int, n_elems: int) -> np.ndarray:
     if ggml_type == GGML_F32:
         return np.frombuffer(raw, np.float32, count=n_elems).copy()
@@ -97,10 +197,16 @@ def _dequant(raw: bytes, ggml_type: int, n_elems: int) -> np.ndarray:
             count=n_blocks)
         return (rec["d"].astype(np.float32)[:, None]
                 * rec["q"].astype(np.float32)).reshape(n_elems)
+    if ggml_type == GGML_Q4_K:
+        return _dequant_q4_k(raw, n_elems // QK_K)
+    if ggml_type == GGML_Q5_K:
+        return _dequant_q5_k(raw, n_elems // QK_K)
+    if ggml_type == GGML_Q6_K:
+        return _dequant_q6_k(raw, n_elems // QK_K)
     raise ValueError(
         f"unsupported ggml tensor type "
         f"{_TYPE_NAMES.get(ggml_type, ggml_type)}; supported: F32, F16, "
-        "Q8_0")
+        "Q8_0, Q4_K, Q5_K, Q6_K")
 
 
 class GgufFile:
@@ -150,8 +256,9 @@ class GgufFile:
             nbytes = 4 * n
         elif ggml_type == GGML_F16:
             nbytes = 2 * n
-        elif ggml_type == GGML_Q8_0:
-            nbytes = (n // 32) * 34
+        elif ggml_type in _BLOCK_GEOM:
+            block_bytes, block_elems = _BLOCK_GEOM[ggml_type]
+            nbytes = (n // block_elems) * block_bytes
         else:
             raise ValueError(
                 f"unsupported ggml tensor type "

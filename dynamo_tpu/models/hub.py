@@ -1,0 +1,57 @@
+"""Local model-hub resolution (the `lib/llm/src/hub.rs` analog).
+
+The reference resolves `org/repo` model names by downloading from the HF
+hub with a local cache (`hub.rs`, `local_model.rs:144-190`).  This
+environment has no egress, so resolution is CACHE-ONLY: an `org/repo`
+name maps into the standard huggingface_hub cache layout
+
+    $HF_HOME/hub/models--{org}--{repo}/snapshots/{revision}/
+
+picking the revision `refs/main` points at (falling back to the most
+recently modified snapshot).  The resolved directory then loads through
+the normal HF-layout path (models/loader.py).  A cache miss raises with
+the looked-up paths, not a silent fallback — downloading is the
+operator's job in an egress-less deployment.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def hub_cache_dir() -> str:
+    """The huggingface_hub cache root, honoring its env overrides."""
+    if os.environ.get("HF_HUB_CACHE"):
+        return os.environ["HF_HUB_CACHE"]
+    hf_home = os.environ.get("HF_HOME",
+                             os.path.expanduser("~/.cache/huggingface"))
+    return os.path.join(hf_home, "hub")
+
+
+def resolve_cached_repo(repo_id: str,
+                        cache_dir: Optional[str] = None) -> str:
+    """`org/repo` → local snapshot directory, or FileNotFoundError."""
+    cache = cache_dir or hub_cache_dir()
+    folder = os.path.join(cache, "models--" + repo_id.replace("/", "--"))
+    snapshots = os.path.join(folder, "snapshots")
+    if not os.path.isdir(snapshots):
+        raise FileNotFoundError(
+            f"model {repo_id!r} not in the local hub cache "
+            f"(looked in {snapshots}; no-egress environment — "
+            "pre-populate the cache or pass a checkpoint directory)")
+    # refs/main holds the commit hash the default revision points at.
+    ref = os.path.join(folder, "refs", "main")
+    if os.path.isfile(ref):
+        with open(ref) as f:
+            rev = f.read().strip()
+        path = os.path.join(snapshots, rev)
+        if os.path.isdir(path):
+            return path
+    revs = [os.path.join(snapshots, d) for d in os.listdir(snapshots)]
+    revs = [d for d in revs if os.path.isdir(d)]
+    if not revs:
+        raise FileNotFoundError(
+            f"model {repo_id!r}: cache folder exists but holds no "
+            f"snapshots ({snapshots})")
+    return max(revs, key=os.path.getmtime)

@@ -220,6 +220,15 @@ def resolve_model(path_or_preset: str):
                                       ("model", "bos_token_id",
                                        "eos_token_id") if k in tok}
         return cfg, params, spec, None
+    if (not os.path.exists(path_or_preset)
+            and path_or_preset.count("/") == 1
+            and not path_or_preset.startswith(".")):
+        # `org/repo` → local HF hub cache (models/hub.py; the reference's
+        # hub.rs resolution, cache-only in a no-egress environment).
+        # Preset names never contain '/', so this cannot shadow them.
+        from dynamo_tpu.models.hub import resolve_cached_repo
+
+        path_or_preset = resolve_cached_repo(path_or_preset)
     if os.path.isdir(path_or_preset):
         cfg, params = load_params(path_or_preset)
         spec = {"kind": "byte"}

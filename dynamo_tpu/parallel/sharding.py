@@ -348,6 +348,51 @@ def make_sharded_embed_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
                              donate_argnums=(1,)), in_shardings, mesh)
 
 
+def make_sharded_mm_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
+                         dp_attention: bool = False,
+                         dp_local: bool = False):
+    """Jit the multimodal prefill variant under a mesh: positions whose
+    mask is set take the provided [B, T, H] embeddings instead of the
+    token lookup (llm/multimodal.py; lifts VERDICT r4's sharded-engine
+    prompt_embeds rejection, engine.py:380).  Embeddings shard like
+    activations: batch over the batch axes, H replicated (the tp-sharded
+    projections consume them immediately)."""
+    from dynamo_tpu.models.llama import make_forward_step
+
+    validate(cfg, mesh, dp_attention)
+    moe_mode = resolve_moe_mode(cfg, mesh)
+    step = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
+                             with_input_embeds=True, dp_local=dp_local)
+    batch_axes = ("dp", "tp") if dp_attention else "dp"
+    from dynamo_tpu.parallel.multihost import mesh_spans_processes
+
+    mh = mesh_spans_processes(mesh)
+    b = NamedSharding(mesh, P(batch_axes))
+    b2 = NamedSharding(mesh, P(batch_axes, None))
+    b3 = NamedSharding(mesh, P(batch_axes, None, None))
+    in_shardings = (
+        jax.tree.map(lambda s: NamedSharding(mesh, s),
+                     param_pspecs(cfg, moe_mode, dp_attention)),
+        jax.tree.map(lambda s: NamedSharding(mesh, s),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+        b2,                                        # tokens [B, T]
+        b2,                                        # positions [B, T]
+        b,                                         # seq_lens [B]
+        b2,                                        # block_tables [B, P]
+        b,                                         # sample_positions [B]
+        b3,                                        # input_embeds [B, T, H]
+        b2,                                        # embed_mask [B, T]
+    )
+    out_shardings = (
+        NamedSharding(mesh, P(None, None) if mh else P(batch_axes, None)),
+        jax.tree.map(lambda s: NamedSharding(mesh, s),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+    )
+    return _finalize(jax.jit(step, in_shardings=in_shardings,
+                             out_shardings=out_shardings,
+                             donate_argnums=(1,)), in_shardings, mesh)
+
+
 def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
                       moe_mode: str = "auto",
                       with_expert_load: bool = False,

@@ -38,6 +38,9 @@ from typing import AsyncIterator, Callable, Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 DEFAULT_LEASE_TTL = 10.0  # seconds; reference etcd default lease ~10s
+# Reserved backend namespace for durable work-queue items (never visible
+# through the kv surface).
+_QUEUE_NS = "__queue__/"
 
 
 @dataclass
@@ -63,8 +66,10 @@ class ControlPlaneState:
         from dynamo_tpu.runtime.kv_store import MemoryBackend
 
         self._backend = backend or MemoryBackend()
+        raw = self._backend.load()
         self._kv: Dict[str, Tuple[dict, Optional[int]]] = {
-            k: (v, None) for k, v in self._backend.load().items()
+            k: (v, None) for k, v in raw.items()
+            if not k.startswith(_QUEUE_NS)
         }  # key → (val, lease)
         self._leases: Dict[int, float] = {}                   # lease → deadline
         self._lease_ttl: Dict[int, float] = {}
@@ -72,10 +77,28 @@ class ControlPlaneState:
         self._watchers: List[Tuple[str, asyncio.Queue]] = []  # (prefix, q)
         self._subs: Dict[str, List[asyncio.Queue]] = {}       # subject → qs
         self._queues: Dict[str, asyncio.Queue] = {}           # work queues
-        self._queue_msg_seq = itertools.count(1)
         # (queue, msg_id) → (payload, redelivery deadline)
         self._inflight_msgs: Dict[Tuple[str, int], Tuple[dict, float]] = {}
         self._reaper: Optional[asyncio.Task] = None
+        # Restore durable queue items (reference NatsQueue = JetStream,
+        # which survives broker restarts): anything persisted and never
+        # acked — including items popped but unacked at crash time —
+        # re-enters its queue as pending (at-least-once).  Queue names
+        # may contain '/' (e.g. "{namespace}/prefill_queue"), so the msg
+        # id is split from the RIGHT; restore order is numeric msg id
+        # (lexicographic key order would put 10 before 2 — FIFO must
+        # survive the restart).
+        restored = []
+        for k, payload in raw.items():
+            if not k.startswith(_QUEUE_NS):
+                continue
+            name, msg_id = k[len(_QUEUE_NS):].rsplit("/", 1)
+            restored.append((int(msg_id), name, payload))
+        restored.sort()
+        for msg_id, name, payload in restored:
+            self._queue(name).put_nowait((msg_id, payload))
+        self._queue_msg_seq = itertools.count(
+            (restored[-1][0] + 1) if restored else 1)
 
     # -- leases -----------------------------------------------------------
 
@@ -183,6 +206,7 @@ class ControlPlaneState:
 
     def queue_push(self, name: str, payload: dict) -> None:
         msg_id = next(self._queue_msg_seq)
+        self._backend.put(f"{_QUEUE_NS}{name}/{msg_id}", payload)
         self._queue(name).put_nowait((msg_id, payload))
 
     async def queue_pop(self, name: str,
@@ -197,7 +221,10 @@ class ControlPlaneState:
         return msg_id, payload
 
     def queue_ack(self, name: str, msg_id: int) -> bool:
-        return self._inflight_msgs.pop((name, msg_id), None) is not None
+        acked = self._inflight_msgs.pop((name, msg_id), None) is not None
+        if acked:
+            self._backend.delete(f"{_QUEUE_NS}{name}/{msg_id}")
+        return acked
 
     def redeliver_expired(self) -> int:
         now = time.monotonic()

@@ -255,6 +255,29 @@ class ControlPlaneClient:
         self._closed = False
         self._reconnecting = False
         self._conn_gen = 0  # bumps per (re)connect; stale rx loops exit
+        # Session-loss callbacks: fired (as tasks) after a successful
+        # reconnect, and when a keepalive discovers its lease expired
+        # server-side.  Both mean every lease this client held is gone —
+        # registrations must be replayed (the reference's etcd-lease
+        # model: `transports/etcd/lease.rs` recovery is the worker's
+        # job).  Endpoint.serve installs the replay.
+        self._session_callbacks: list = []
+
+    def on_session_loss(self, cb) -> None:
+        """Register an async callback fired when this client's server-side
+        session state (leases + leased keys) is known to be lost."""
+        self._session_callbacks.append(cb)
+
+    def remove_session_callback(self, cb) -> None:
+        if cb in self._session_callbacks:
+            self._session_callbacks.remove(cb)
+
+    def _fire_session_loss(self) -> None:
+        for cb in list(self._session_callbacks):
+            task = asyncio.create_task(cb())
+            task.add_done_callback(
+                lambda t: t.exception() and logger.error(
+                    "session-loss callback failed: %s", t.exception()))
 
     async def start(self) -> None:
         self._closed = False
@@ -379,6 +402,16 @@ class ControlPlaneClient:
                 logger.info("control plane reconnected (%d watches, %d "
                             "subs restored)", len(self._watches),
                             len(self._subs))
+                # Leases did not survive (server restart or TTL expiry
+                # during the outage).  Cancel their keepalive loops FIRST
+                # — a stale loop finding alive=False would fire a second
+                # session-loss, double-registering every endpoint and
+                # leaking the first replacement lease — then let owners
+                # re-register (each grant starts a fresh keepalive).
+                for t in self._keepalive_tasks.values():
+                    t.cancel()
+                self._keepalive_tasks.clear()
+                self._fire_session_loss()
                 return
         finally:
             self._reconnecting = False
@@ -417,12 +450,15 @@ class ControlPlaneClient:
                 except (RuntimeError, ConnectionError):
                     return
                 if not msg.get("alive"):
-                    # Lease expired server-side (stall > TTL or control-plane
-                    # restart): our registrations are gone.  Surface loudly —
-                    # a silently-invisible worker is the worst failure mode.
+                    # Lease expired server-side (stall > TTL; a restart
+                    # drops the connection and goes through reconnect
+                    # instead): registrations are gone.  Fire the
+                    # session-loss path so owners re-register.
                     logger.error(
-                        "lease %d expired server-side; registrations lost "
-                        "(worker must re-register)", lease)
+                        "lease %d expired server-side; replaying "
+                        "registrations", lease)
+                    self._keepalive_tasks.pop(lease, None)
+                    self._fire_session_loss()
                     return
         except asyncio.CancelledError:
             pass

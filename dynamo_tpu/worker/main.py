@@ -42,12 +42,15 @@ def parse_args(argv=None):
     p.add_argument("--component", default="backend")
     p.add_argument("--endpoint", default="generate")
     p.add_argument("--model-name", default="dynamo-tpu")
-    p.add_argument("--role", choices=("both", "prefill", "decode"),
+    p.add_argument("--role", choices=("both", "prefill", "decode", "encode"),
                    default="both",
                    help="disaggregated P/D role: 'prefill' serves the "
                         "prefill queue only (no model registration); "
                         "'decode' registers the model and sends long "
-                        "prompts to the prefill queue; 'both' = aggregated")
+                        "prompts to the prefill queue; 'both' = aggregated; "
+                        "'encode' serves the multimodal vision tower "
+                        "(encoder/encode endpoint, reference "
+                        "multimodal_v1 encode_worker)")
     p.add_argument("--max-local-prefill", type=int, default=None,
                    help="decode role: write the disagg threshold (tokens) "
                         "to the control plane at startup; prompts longer "
@@ -223,6 +226,33 @@ async def build_engine(args, kv_event_sink):
         card_fields, engine
 
 
+async def run_encode(args, cp, runtime) -> None:
+    """The encode-worker role: vision tower behind `encoder/encode` (no
+    LLM engine, no model registration; reference
+    `examples/multimodal_v1/components/encode_worker.py`)."""
+    from dynamo_tpu.llm.multimodal import EncodeWorker, StubVisionEncoder
+    from dynamo_tpu.models import config as mcfg
+
+    try:
+        hidden = mcfg.get_config(args.model or "llama-3-1b").hidden_size
+    except Exception:
+        hidden = 2048  # checkpoint-dir models: pass the preset via --model
+    worker = EncodeWorker(StubVisionEncoder(hidden))
+    endpoint = (runtime.namespace(args.namespace)
+                .component("encoder").endpoint("encode"))
+    instance = await endpoint.serve(worker.make_handler())
+    print(f"encode worker instance {instance.instance_id} at "
+          f"{instance.address} (hidden={hidden})", flush=True)
+    stop_ev = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop_ev.set)
+    await stop_ev.wait()
+    await endpoint.leave()
+    await runtime.shutdown()
+    await cp.close()
+
+
 async def run(args) -> None:
     from dynamo_tpu import native
 
@@ -230,6 +260,9 @@ async def run(args) -> None:
     cp = ControlPlaneClient(*_split(args.control_plane))
     await cp.start()
     runtime = DistributedRuntime(cp)
+    if args.role == "encode":
+        await run_encode(args, cp, runtime)
+        return
     # Prefill workers live under their own component so the frontend's
     # per-model clients (which watch the decode endpoint's instance
     # prefix) never route decode traffic to them — the reference's

@@ -151,3 +151,36 @@ def test_resolve_model_carries_tokenizer_artifact(tmp_path, hf_checkpoint):
         json.loads(json.dumps(card.to_dict())))
     tk = card2.build_tokenizer()
     assert tk is not None
+
+
+def test_hub_cache_resolution(tmp_path, monkeypatch):
+    """`org/repo` names resolve through the local HF hub cache layout
+    (models/hub.py — the hub.rs analog, cache-only in no-egress envs)."""
+    import json
+
+    from dynamo_tpu.models.hub import resolve_cached_repo
+
+    cache = tmp_path / "hub"
+    snap = cache / "models--acme--tiny" / "snapshots" / "abc123"
+    snap.mkdir(parents=True)
+    (cache / "models--acme--tiny" / "refs").mkdir()
+    (cache / "models--acme--tiny" / "refs" / "main").write_text("abc123")
+    (snap / "config.json").write_text(json.dumps({"hidden_size": 64}))
+
+    got = resolve_cached_repo("acme/tiny", cache_dir=str(cache))
+    assert got == str(snap)
+
+    import pytest as _pytest
+
+    with _pytest.raises(FileNotFoundError, match="not in the local"):
+        resolve_cached_repo("acme/absent", cache_dir=str(cache))
+
+    # resolve_model wires it through (monkeypatched cache root).
+    monkeypatch.setenv("HF_HUB_CACHE", str(cache))
+    from dynamo_tpu.models.loader import resolve_model
+
+    with _pytest.raises(Exception):
+        # Snapshot exists but isn't a complete checkpoint — the point is
+        # it resolved INTO the snapshot dir (load_params fails there,
+        # not a preset-name KeyError).
+        resolve_model("acme/tiny")

@@ -153,3 +153,174 @@ def test_pipeline_e2e_over_device_plane():
         return True
 
     assert asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_http_image_parts_reach_engine():
+    """VERDICT r4 next-7 'done': a chat request with an image part over
+    HTTP produces a response that provably depends on the image (greedy:
+    same image → same tokens, different image → different tokens), on
+    the single-process frontend's in-process encoder."""
+    import asyncio
+
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.engine.engine import (
+        EngineConfig, EngineCore, InferenceEngine)
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.multimodal import MultimodalAttach, StubVisionEncoder
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.service import (
+        LocalEngineClient, ModelHandle, ModelManager)
+    from dynamo_tpu.llm.tokenizer import ByteTokenizer
+    from dynamo_tpu.models import config as mcfg
+
+    cfg = mcfg.get_config("tiny-test")
+
+    async def main():
+        core = EngineCore(EngineConfig(
+            model=cfg, num_blocks=160, enable_prefix_cache=False,
+            scheduler=SchedulerConfig(
+                max_seqs=4, block_size=8, max_pages_per_seq=32,
+                max_prefill_chunk=64,
+                decode_buckets=(1, 2, 4), prefill_buckets=(16, 32, 64))))
+        engine = InferenceEngine(core)
+        await engine.start()
+        tokenizer = ByteTokenizer()
+        models = ModelManager()
+        models.register(ModelHandle(
+            name="mm-test", tokenizer=tokenizer,
+            preprocessor=OpenAIPreprocessor(tokenizer),
+            client=LocalEngineClient(engine),
+            max_context=cfg.max_context,
+            multimodal=MultimodalAttach(
+                local_encoder=StubVisionEncoder(cfg.hidden_size))))
+        svc = HttpService(models)
+        port = await svc.start()
+        base = f"http://127.0.0.1:{port}"
+
+        def req(url):
+            return {
+                "model": "mm-test",
+                "messages": [{"role": "user", "content": [
+                    {"type": "image_url", "image_url": {"url": url}},
+                    {"type": "text", "text": "describe"},
+                ]}],
+                "max_tokens": 8, "temperature": 0,
+            }
+
+        async with ClientSession() as s:
+            outs = []
+            for url in ("http://x/cat.png", "http://x/cat.png",
+                        "http://x/dog.png"):
+                async with s.post(f"{base}/v1/chat/completions",
+                                  json=req(url)) as r:
+                    body = await r.json()
+                    assert r.status == 200, body
+                    outs.append(body["choices"][0]["message"]["content"])
+            assert outs[0] == outs[1], "same image must decode identically"
+            assert outs[0] != outs[2], "different image must steer output"
+
+            # Text-only requests on the same model still work.
+            async with s.post(f"{base}/v1/chat/completions", json={
+                    "model": "mm-test",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4}) as r:
+                assert r.status == 200, await r.text()
+        await svc.stop()
+        await engine.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 120))
+
+
+def test_http_image_parts_e2e_with_encode_worker():
+    """Distributed variant: frontend discovers the model via the control
+    plane; image embeddings come from a separate `--role encode` worker
+    process (reference multimodal_v1 topology)."""
+    import asyncio
+    import os
+    import subprocess
+    import sys
+    import time
+
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.llm.discovery import ModelWatcher
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.service import ModelManager
+    from dynamo_tpu.runtime.control_plane_tcp import (
+        ControlPlaneClient, ControlPlaneServer)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    logs = []
+
+    def spawn(name, extra):
+        log = open(f"/tmp/dynamo_mm_{os.getpid()}_{name}.log", "w+")
+        logs.append(log)
+        p = subprocess.Popen(
+            [sys.executable, "-m", "dynamo_tpu.worker"] + extra,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo),
+            cwd=repo, stdout=log, stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        return p
+
+    async def main():
+        cp_server = ControlPlaneServer()
+        cp_port = await cp_server.start()
+        cp = ControlPlaneClient("127.0.0.1", cp_port)
+        await cp.start()
+        runtime = DistributedRuntime(cp)
+        models = ModelManager()
+        watcher = ModelWatcher(runtime, models, migration_limit=0)
+        await watcher.start()
+        svc = HttpService(models)
+        http_port = await svc.start()
+
+        cp_addr = f"127.0.0.1:{cp_port}"
+        spawn("llm", ["--control-plane", cp_addr, "--model", "tiny-test",
+                      "--model-name", "mm-dist", "--block-size", "8"])
+        spawn("enc", ["--control-plane", cp_addr, "--model", "tiny-test",
+                      "--role", "encode"])
+        await watcher.wait_for_model("mm-dist", timeout=120)
+
+        base = f"http://127.0.0.1:{http_port}"
+        payload = {
+            "model": "mm-dist",
+            "messages": [{"role": "user", "content": [
+                {"type": "image_url", "image_url": {"url": "img://a"}},
+                {"type": "text", "text": "what is this"},
+            ]}],
+            "max_tokens": 6, "temperature": 0,
+        }
+        async with ClientSession() as s:
+            deadline = time.monotonic() + 60
+            body = None
+            while time.monotonic() < deadline:
+                async with s.post(f"{base}/v1/chat/completions",
+                                  json=payload) as r:
+                    body = await r.json()
+                    if r.status == 200:
+                        break
+                await asyncio.sleep(1.0)  # encode worker may still be up-coming
+            assert body and body.get("choices"), body
+            assert body["choices"][0]["message"]["content"]
+
+        await watcher.stop()
+        await svc.stop()
+        await runtime.shutdown()
+        await cp.close()
+        await cp_server.stop()
+
+    try:
+        asyncio.run(asyncio.wait_for(main(), timeout=240))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.flush(); log.seek(0)
+            out = log.read()
+            if out and "Traceback" in out:
+                print(f"--- {log.name} ---"); print(out[-2000:])

@@ -1,0 +1,66 @@
+"""Load-generator subprocess for benchmarks/frontend_bench.py: issues
+streamed chat completions against a frontend and prints ONE JSON line
+{"requests": N, "tokens": T, "wall_s": W}.  Run N of these in parallel
+so client-side SSE parsing never shares a core with the frontend loop.
+"""
+
+import argparse
+import asyncio
+import json
+import time
+
+
+async def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--base", required=True)
+    p.add_argument("--model", default="bench-model")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--concurrency", type=int, default=16)
+    p.add_argument("--max-tokens", type=int, default=64)
+    p.add_argument("--prompt-tokens", type=int, default=64)
+    p.add_argument("--unary", action="store_true")
+    args = p.parse_args()
+
+    from aiohttp import ClientSession
+
+    payload = {
+        "model": args.model,
+        "messages": [{"role": "user",
+                      "content": "word " * args.prompt_tokens}],
+        "max_tokens": args.max_tokens,
+        "stream": not args.unary,
+    }
+    tokens = 0
+    sem = asyncio.Semaphore(args.concurrency)
+
+    async with ClientSession() as s:
+
+        async def one() -> int:
+            async with sem:
+                async with s.post(f"{args.base}/v1/chat/completions",
+                                  json=payload) as r:
+                    assert r.status == 200, await r.text()
+                    if args.unary:
+                        body = await r.json()
+                        return body["usage"]["completion_tokens"]
+                    ok = False
+                    async for raw in r.content:
+                        if b'"finish_reason": "length"' in raw or \
+                                b'"finish_reason":"length"' in raw:
+                            ok = True
+                    assert ok, "no length finish"
+                    return args.max_tokens
+
+        # warmup
+        await asyncio.gather(*[one() for _ in range(4)])
+        t0 = time.perf_counter()
+        results = await asyncio.gather(*[one()
+                                         for _ in range(args.requests)])
+        wall = time.perf_counter() - t0
+        tokens = sum(results)
+    print(json.dumps({"requests": args.requests, "tokens": tokens,
+                      "wall_s": wall}), flush=True)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
