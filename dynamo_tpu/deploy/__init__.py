@@ -1,0 +1,297 @@
+"""Kubernetes manifest renderer for serving graphs.
+
+Role of the reference's Go operator (`deploy/cloud/operator`, 14k LoC:
+`DynamoGraphDeployment` CRD → per-component Deployments/Services,
+`internal/dynamo/graph.go:145` GenerateDynamoComponentsDeployments, LWS
+annotations for multinode).  This environment has no cluster to run a
+controller against, so the TPU build ships the operator's GENERATOR
+half as a deterministic renderer: the same graph TOML the local
+launcher runs (`launcher/load_graph`) renders to K8s manifests —
+
+  - one Deployment + Service per graph service (replicas honored);
+  - a control-plane Deployment + Service with a PVC-backed file store
+    (the durable queue/config snapshot, runtime/kv_store.py);
+  - multihost worker groups (`--num-processes N` in the service args)
+    render as a StatefulSet + headless Service, rank 0 exposing the
+    serving port and ranks joining via the stable pod DNS names — the
+    LeaderWorkerSet-shaped topology (`graph.go:145`) without the LWS
+    dependency;
+  - a ConfigMap carrying the graph TOML for reproducibility.
+
+`kubectl apply -f` the output directory; the CRD schemas under
+deploy/k8s/crds/ document the typed API a future in-cluster controller
+would reconcile (the CRD-shape parity point,
+`api/v1alpha1/dynamographdeployment_types.go:31`).
+
+    python -m dynamo_tpu.deploy examples/disagg_graph.toml \
+        --image ghcr.io/example/dynamo-tpu:latest -o /tmp/manifests
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, List, Optional
+
+CP_PORT = 7411
+HTTP_PORT = 8000
+
+
+def _name(graph_ns: str, svc: str) -> str:
+    return f"dynamo-{graph_ns}-{svc}".replace("_", "-").lower()
+
+
+def _labels(graph_ns: str, svc: str) -> Dict[str, str]:
+    return {
+        "app.kubernetes.io/name": "dynamo-tpu",
+        "app.kubernetes.io/instance": graph_ns,
+        "app.kubernetes.io/component": svc,
+    }
+
+
+def _flag_value(args: List[str], flag: str) -> Optional[str]:
+    if flag in args:
+        i = args.index(flag)
+        if i + 1 < len(args):
+            return args[i + 1]
+    for a in args:
+        if a.startswith(flag + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def _container(name: str, image: str, module: str, args: List[str],
+               tpu_resources: Optional[str], ports: List[dict]) -> dict:
+    c = {
+        "name": name,
+        "image": image,
+        "command": ["python", "-m", module],
+        "args": args,
+        "ports": ports,
+        "env": [{"name": "JAX_PLATFORMS", "value": "tpu"}],
+    }
+    if tpu_resources:
+        c["resources"] = {"limits": {"google.com/tpu": tpu_resources}}
+    return c
+
+
+def render_graph(spec, image: str,
+                 tpu_chips_per_worker: Optional[int] = None) -> List[dict]:
+    """GraphSpec → list of K8s manifest dicts (apply order preserved)."""
+    ns = spec.namespace
+    out: List[dict] = []
+    cp_name = _name(ns, "control-plane")
+    cp_addr = f"{cp_name}:{CP_PORT}"
+
+    # Control plane: Deployment (single replica) + Service + PVC store.
+    out.append({
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": f"{cp_name}-store",
+                     "labels": _labels(ns, "control-plane")},
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+    })
+    out.append({
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": cp_name,
+                     "labels": _labels(ns, "control-plane")},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": _labels(ns, "control-plane")},
+            "template": {
+                "metadata": {"labels": _labels(ns, "control-plane")},
+                "spec": {
+                    "containers": [_container(
+                        "control-plane", image,
+                        "dynamo_tpu.control_plane_service",
+                        ["--host", "0.0.0.0", "--port", str(CP_PORT),
+                         "--store", "file:/var/lib/dynamo/cp.json"],
+                        None,
+                        [{"containerPort": CP_PORT}])],
+                    "volumes": [{
+                        "name": "store",
+                        "persistentVolumeClaim":
+                            {"claimName": f"{cp_name}-store"}}],
+                },
+            },
+        },
+    })
+    out[-1]["spec"]["template"]["spec"]["containers"][0]["volumeMounts"] \
+        = [{"name": "store", "mountPath": "/var/lib/dynamo"}]
+    out.append({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": cp_name,
+                     "labels": _labels(ns, "control-plane")},
+        "spec": {"selector": _labels(ns, "control-plane"),
+                 "ports": [{"port": CP_PORT,
+                            "targetPort": CP_PORT}]},
+    })
+
+    for svc in spec.services:
+        name = _name(ns, svc.name)
+        labels = _labels(ns, svc.name)
+        args = list(svc.args)
+        if svc.inject_control_plane and "--control-plane" not in args:
+            args += ["--control-plane", cp_addr]
+        is_frontend = svc.module.endswith("frontend")
+        ports = ([{"containerPort": int(_flag_value(args, "--http-port")
+                                        or HTTP_PORT)}]
+                 if is_frontend else [])
+        n_proc = int(_flag_value(args, "--num-processes") or 1)
+        tpu = (str(tpu_chips_per_worker)
+               if tpu_chips_per_worker and svc.module.endswith("worker")
+               else None)
+
+        if n_proc > 1:
+            # Multihost worker group: StatefulSet + headless Service —
+            # stable DNS gives ranks their coordinator/lockstep targets
+            # (pod-0), the LWS-shaped topology (`graph.go:145`).
+            head = f"{name}-ranks"
+            rank0 = f"{name}-0.{head}"
+            base = [a for a in args]
+            for flag in ("--process-id",):
+                v = _flag_value(base, flag)
+                if v is not None:
+                    i = base.index(flag)
+                    del base[i:i + 2]
+            base += ["--coordinator", f"{rank0}:9876",
+                     "--lockstep", f"{rank0}:9877"]
+            out.append({
+                "apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": head, "labels": labels},
+                "spec": {"clusterIP": "None", "selector": labels,
+                         "ports": [{"port": 9876, "name": "coordinator"},
+                                   {"port": 9877, "name": "lockstep"}]},
+            })
+            out.append({
+                "apiVersion": "apps/v1", "kind": "StatefulSet",
+                "metadata": {"name": name, "labels": labels},
+                "spec": {
+                    "serviceName": head,
+                    "replicas": n_proc,
+                    "podManagementPolicy": "Parallel",
+                    "selector": {"matchLabels": labels},
+                    "template": {
+                        "metadata": {"labels": labels},
+                        "spec": {"containers": [{
+                            **_container(svc.name, image, svc.module,
+                                         base, tpu, []),
+                            # Rank = ordinal; shell-expand the pod name.
+                            "command": ["/bin/sh", "-c"],
+                            "args": [
+                                "exec python -m " + svc.module + " "
+                                + " ".join(base)
+                                + " --process-id ${HOSTNAME##*-}"],
+                        }]},
+                    },
+                },
+            })
+            continue
+
+        out.append({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": name, "labels": labels},
+            "spec": {
+                "replicas": svc.replicas,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {"containers": [_container(
+                        svc.name, image, svc.module, args, tpu, ports)]},
+                },
+            },
+        })
+        if is_frontend:
+            out.append({
+                "apiVersion": "v1", "kind": "Service",
+                "metadata": {"name": name, "labels": labels},
+                "spec": {"selector": labels,
+                         "ports": [{"port": 80,
+                                    "targetPort": ports[0][
+                                        "containerPort"]}]},
+            })
+    return out
+
+
+def _to_yaml(doc: dict, indent: int = 0) -> str:
+    """Minimal YAML emitter (no pyyaml dependency): dicts/lists/scalars
+    only — exactly the shapes render_graph produces."""
+    pad = "  " * indent
+    lines: List[str] = []
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            if isinstance(v, (dict, list)) and v:
+                lines.append(f"{pad}{k}:")
+                lines.append(_to_yaml(v, indent + 1))
+            else:
+                lines.append(f"{pad}{k}: {_scalar(v)}")
+    elif isinstance(doc, list):
+        for item in doc:
+            if isinstance(item, (dict, list)) and item:
+                body = _to_yaml(item, indent + 1)
+                first, _, rest = body.partition("\n")
+                lines.append(f"{pad}- {first.strip()}")
+                if rest:
+                    lines.append(rest)
+            else:
+                lines.append(f"{pad}- {_scalar(item)}")
+    return "\n".join(lines)
+
+
+def _scalar(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None or v == {} or v == []:
+        return "{}" if isinstance(v, dict) else \
+            ("[]" if isinstance(v, list) else "null")
+    if isinstance(v, (int, float)):
+        return str(v)
+    return json.dumps(str(v))  # quoted string, JSON-escaped (YAML-safe)
+
+
+def render_to_dir(spec, image: str, out_dir: str,
+                  tpu_chips_per_worker: Optional[int] = None,
+                  graph_toml: Optional[str] = None) -> List[str]:
+    os.makedirs(out_dir, exist_ok=True)
+    docs = render_graph(spec, image, tpu_chips_per_worker)
+    written = []
+    for i, doc in enumerate(docs):
+        fname = (f"{i:02d}-{doc['kind'].lower()}-"
+                 f"{doc['metadata']['name']}.yaml")
+        path = os.path.join(out_dir, fname)
+        with open(path, "w") as f:
+            f.write(_to_yaml(doc) + "\n")
+        written.append(path)
+    if graph_toml:
+        cm = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": _name(spec.namespace, "graph"),
+                         "labels": _labels(spec.namespace, "graph")},
+            "data": {"graph.toml": open(graph_toml).read()},
+        }
+        path = os.path.join(out_dir, "99-configmap-graph.yaml")
+        with open(path, "w") as f:
+            f.write(_to_yaml(cm) + "\n")
+        written.append(path)
+    return written
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        "dynamo_tpu.deploy",
+        description="Render a serving-graph TOML to K8s manifests "
+                    "(the operator's generator half)")
+    p.add_argument("graph", help="graph TOML (launcher format)")
+    p.add_argument("--image", required=True)
+    p.add_argument("-o", "--out", default="./manifests")
+    p.add_argument("--tpu-chips-per-worker", type=int, default=None)
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.launcher.launcher import load_graph
+
+    spec = load_graph(args.graph)
+    written = render_to_dir(spec, args.image, args.out,
+                            args.tpu_chips_per_worker, args.graph)
+    for w in written:
+        print(w)

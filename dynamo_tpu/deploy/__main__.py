@@ -1,0 +1,3 @@
+from dynamo_tpu.deploy import main
+
+main()

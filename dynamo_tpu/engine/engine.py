@@ -262,15 +262,13 @@ class EngineCore:
         self.sp_prefill_count = 0  # served prefills that ran the ring path
         if self._pp:
             # Pipeline serving: stage-rotated GPipe step over the pp axis.
-            # v1: stacked cache layout — whole-block extract/inject (and
-            # so the tiered prefix cache) aren't wired for it yet.
+            # v2: the stacked layout has its own whole-block extract/
+            # inject (pipeline.make_pp_block_ops), so the tiered prefix
+            # cache runs under pp like everywhere else.
             from dynamo_tpu.parallel.pipeline import (
                 init_pp_cache, make_pp_step, pp_cache_pspecs,
                 pp_param_pspecs, stack_layer_params)
 
-            if config.enable_prefix_cache:
-                logger.warning("pp serving v1 has no tiered prefix cache; "
-                               "running with the plain allocator")
             params = shard_pytree(stack_layer_params(params),
                                   pp_param_pspecs(cfg), self.mesh)
             self._step = make_pp_step(cfg, self.block_size, self.mesh,
@@ -340,16 +338,23 @@ class EngineCore:
         # it must actually be wired, not just exist); plain free list when
         # prefix caching is off.  The managed source owns residency truth,
         # so REMOVED events come from its eviction hook rather than from
-        # request finish.  (pp v1: stacked cache has no block extract —
-        # plain allocator, see above.)
-        self._managed_cache = config.enable_prefix_cache and not self._pp
+        # request finish.
+        self._managed_cache = config.enable_prefix_cache
         if self._managed_cache:
             from dynamo_tpu.llm.block_manager.engine_source import (
                 ManagedBlockSource,
             )
             from dynamo_tpu.llm.block_manager.manager import TieredConfig
 
-            if self._mh:
+            if self._pp:
+                # Stacked layout: its own block ops (same canonical
+                # [2, L, bs, F] block — offload/transfer stay
+                # layout-agnostic).
+                from dynamo_tpu.parallel.pipeline import make_pp_block_ops
+
+                self._extract_jit, self._inject_jit = make_pp_block_ops(
+                    self.block_size, self.mesh)
+            elif self._mh:
                 from dynamo_tpu.parallel.sharding import (
                     cache_pspecs as _cps)
 
@@ -1428,13 +1433,27 @@ class EngineCore:
 
     def export_blocks_device(self, hashes) -> Dict[int, object]:
         """G1-resident blocks as DEVICE arrays (the device-direct transfer
-        plane's extract side; no host staging).  Engine thread only."""
+        plane's extract side; no host staging).  Engine thread only.
+
+        Sharded caches (tp/dp mesh): the extracted block gathers onto
+        device 0 over ICI — the canonical [2, L, bs, F] block format is
+        sharding-independent, so a prefill tp=x → decode tp=y handoff is
+        a gather here + scatter at the peer's inject (the XLA-collective
+        answer to the reference's `block_copy.cu:41` layout transpose;
+        `disagg_serving.md:96-99`)."""
         out: Dict[int, object] = {}
         if not self._managed_cache:
             return out
+        single = None
+        if self.mesh is not None:
+            from jax.sharding import SingleDeviceSharding
+
+            single = SingleDeviceSharding(jax.devices()[0])
         for h in hashes:
             data = self.allocator.manager.export_block_device(h)
             if data is not None:
+                if single is not None:
+                    data = jax.device_put(data, single)
                 out[h] = data
         return out
 
@@ -1466,8 +1485,18 @@ class EngineCore:
         output, so that off-thread read stays collective-free.)"""
         return self._extract_jit(self.cache, np.int32(page))
 
-    def _inject_block(self, page: int, data: np.ndarray) -> None:
-        """Host array → device block (onboard/transfer-in)."""
+    def _inject_block(self, page: int, data) -> None:
+        """Host array OR device array → device block (onboard /
+        transfer-in).  A pulled device array arrives committed to one
+        device; under a mesh it must be re-laid as replicated before the
+        sharded inject scatters it into the cache's sharding (the
+        tp=x→tp=y relayout's scatter half)."""
+        if (self.mesh is not None and isinstance(data, jax.Array)
+                and not self._mh):
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            data = jax.device_put(
+                data, NamedSharding(self.mesh, PartitionSpec()))
         self.cache = self._inject_jit(self.cache, np.int32(page),
                                       self._dev(data))
 

@@ -46,11 +46,13 @@ def parse_args(argv=None):
     p.add_argument("--migration-limit", type=int, default=3)
     p.add_argument("--model-name", default="dynamo-tpu")
     p.add_argument("--out", default="auto",
-                   choices=("auto", "engine", "mocker", "echo"),
-                   help="in-process backend (reference dynamo-run out= "
-                        "matrix): auto = engine when --model names real "
-                        "weights, echo streams the prompt back, mocker "
-                        "simulates a vLLM-style engine")
+                   help="backend (reference dynamo-run out= matrix, "
+                        "`opt.rs:7-32`): auto|engine = in-process JAX "
+                        "engine, echo streams the prompt back, mocker "
+                        "simulates a vLLM-style engine, "
+                        "dyn://ns/component/endpoint attaches a REMOTE "
+                        "endpoint statically (no model discovery; needs "
+                        "--control-plane)")
     p.add_argument("--mocker", action="store_true",
                    help="serve the mock engine (no accelerator)")
     p.add_argument("--model", default=None,
@@ -81,7 +83,15 @@ def parse_args(argv=None):
          "migration_limit": 3, "model_name": "dynamo-tpu",
          "num_blocks": 512, "block_size": 64},
         section="frontend"))
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    # Validate --out here (choices= can't express the dyn:// prefix):
+    # distributed mode never reaches build_model_handle, and a typo'd
+    # backend selection must not be silently ignored.
+    if args.out not in ("auto", "engine", "mocker", "echo") \
+            and not args.out.startswith("dyn://"):
+        p.error(f"--out {args.out!r}: expected auto|engine|mocker|echo|"
+                "dyn://namespace/component/endpoint")
+    return args
 
 
 async def build_model_handle(args) -> tuple:
@@ -115,6 +125,53 @@ async def build_model_handle(args) -> tuple:
         handle = ModelHandle(name=args.model_name, tokenizer=tokenizer,
                              preprocessor=pre, client=EchoEngine())
         return handle, noop
+
+    if out.startswith("dyn://"):
+        # Static remote attachment (reference EngineConfig::StaticRemote,
+        # dynamo-run out=dyn://): route to a known endpoint path without
+        # model discovery — the card (and so tokenizer) stays local.
+        if not args.control_plane:
+            raise SystemExit("--out dyn://... needs --control-plane")
+        parts = out[len("dyn://"):].split("/")
+        if len(parts) != 3 or not all(parts):
+            raise SystemExit(
+                f"--out {out!r}: expected dyn://namespace/component/endpoint")
+        from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneClient
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.runtime.pipeline import (
+            KvRouterOp, MigrationOp, Pipeline, RemoteOp)
+
+        host, _, port = args.control_plane.rpartition(":")
+        cp = ControlPlaneClient(host or "127.0.0.1", int(port))
+        await cp.start()
+        runtime = DistributedRuntime(cp)
+        endpoint = (runtime.namespace(parts[0]).component(parts[1])
+                    .endpoint(parts[2]))
+        client = await endpoint.client(args.router_mode
+                                       if args.router_mode != "kv"
+                                       else "round_robin")
+        # Same operator graph as discovery mode — --router-mode kv gets
+        # real KV-aware routing here too, not a silent downgrade.
+        router_op = (KvRouterOp(runtime, block_size=args.block_size)
+                     if args.router_mode == "kv" else RemoteOp())
+        pipeline = Pipeline([
+            MigrationOp(limit=args.migration_limit), router_op,
+        ])
+        engine_client = await pipeline.attach(client)
+
+        async def shutdown():
+            await pipeline.stop()
+            await client.stop()
+            await runtime.shutdown()
+            await cp.close()
+
+        handle = ModelHandle(name=args.model_name, tokenizer=tokenizer,
+                             preprocessor=pre, client=engine_client)
+        return handle, shutdown
+
+    if out not in ("auto", "engine"):
+        raise SystemExit(f"unknown --out {out!r} (auto|engine|mocker|"
+                         "echo|dyn://ns/component/endpoint)")
 
     from dynamo_tpu.engine.engine import EngineConfig, EngineCore, InferenceEngine
     from dynamo_tpu.engine.scheduler import SchedulerConfig
@@ -296,7 +353,16 @@ async def run(args) -> None:
         args.control_plane = args.control_plane or f"127.0.0.1:{port}"
         print(f"control plane on 127.0.0.1:{port}", flush=True)
 
-    if args.control_plane:
+    if args.out.startswith("dyn://") and not args.mocker:
+        # Static remote attachment bypasses discovery entirely
+        # (build_model_handle dials the endpoint itself; --mocker is a
+        # back-compat alias that overrides --out, so it must not take
+        # this branch under a 'static remote' banner).
+        handle, shutdown = await build_model_handle(args)
+        models.register(handle)
+        shutdowns.append(shutdown)
+        banner = f"static remote {args.out} as {handle.name!r}"
+    elif args.control_plane:
         # Distributed mode: discover models from registered workers.
         from dynamo_tpu.llm.discovery import ModelWatcher
         from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneClient

@@ -337,22 +337,25 @@ class ModelWatcher:
             "round_robin" if self.router_mode == "kv" else self.router_mode)
         self._clients[name] = client
         tokenizer = card.build_tokenizer()
-        # Migration wraps the routed client: worker death mid-stream
-        # re-issues to a survivor (reference Migration operator placement
-        # in the routed pipeline, `entrypoint/input/common.rs:213`).
-        from dynamo_tpu.llm.migration import MigrationClient
+        # Declarative operator pipeline (runtime/pipeline.py; reference
+        # build_routed_pipeline, `entrypoint/input/common.rs:213`):
+        # Migration (retry across worker death) wraps the router
+        # (KV-aware or plain round-robin), which wraps the instance set.
+        from dynamo_tpu.runtime.pipeline import (
+            KvRouterOp, MigrationOp, Pipeline, RemoteOp)
 
+        router_op = (KvRouterOp(self.runtime,
+                                block_size=card.kv_block_size)
+                     if self.router_mode == "kv" else RemoteOp())
+        pipeline = Pipeline([
+            MigrationOp(limit=self.migration_limit),
+            router_op,
+        ])
+        engine_client = await pipeline.attach(client)
         if self.router_mode == "kv":
             from dynamo_tpu.llm.kv_router.client import KvRoutedEngineClient
 
-            routed = KvRoutedEngineClient(
-                client, self.runtime, block_size=card.kv_block_size)
-            await routed.start()
-            self._kv_clients[name] = routed
-        else:
-            routed = RemoteEngineClient(client)
-        engine_client = MigrationClient(
-            routed, migration_limit=self.migration_limit)
+            self._kv_clients[name] = pipeline.stage_of(KvRoutedEngineClient)
         # Multimodal: every dynamic model gets the attach hook pointed at
         # the namespace's encoder endpoint (`encoder/encode`); requests
         # without image parts never touch it, and requests with them get

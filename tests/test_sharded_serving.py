@@ -202,3 +202,70 @@ def test_tp_worker_serves_http():
             await cp_server.stop()
 
     asyncio.run(main())
+
+
+def test_pp_prefix_cache_hits(oracle):
+    """PP v2 (VERDICT r4 next-10): the tiered prefix cache runs under the
+    stacked pp layout — a repeated prompt prefix must HIT (prefill
+    skipped) and greedy output must stay identical to the unsharded
+    oracle."""
+    mesh = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64,
+        mesh=mesh, enable_prefix_cache=True,
+        scheduler=SchedulerConfig(**SCHED)))
+    assert core._managed_cache, "pp engine must run the tiered source"
+
+    def run(rid):
+        core.add_request(rid, [5, 6, 7, 8, 9, 10, 5, 6, 7, 8],
+                         SamplingParams(max_tokens=12))
+        out = []
+        for _ in range(300):
+            for d in core.step():
+                out.extend(d.token_ids)
+            if not core._requests:
+                break
+        assert not core._requests
+        return out
+
+    first = run("p1")
+    assert first == oracle["a"], "pp+prefix first run diverged"
+    # Second identical prompt: the sealed prefix blocks must match.
+    second = run("p2")
+    assert second == first, "prefix hit changed greedy output"
+    # The hit is observable as skipped prefill work: the second request
+    # admitted with prefilled > 0 (allocator.match returned cached
+    # tokens).  Verify via the manager's match bookkeeping.
+    mgr = core.allocator
+    cached, pages = mgr.match([5, 6, 7, 8, 9, 10, 5, 6, 7, 8],
+                              mgr.prompt_hashes([5, 6, 7, 8, 9, 10,
+                                                 5, 6, 7, 8]))
+    assert cached > 0, "sealed prefix blocks not matchable under pp"
+    if pages:
+        mgr.release(pages)
+
+
+def test_pp_block_extract_inject_roundtrip():
+    """The stacked-layout block ops must move the exact bytes the
+    flat-layout ops define (the canonical [2, L, bs, F] block)."""
+    from dynamo_tpu.engine import kv_cache as kvc
+    from dynamo_tpu.parallel.pipeline import (
+        init_pp_cache, make_pp_block_ops, pp_cache_pspecs)
+    from dynamo_tpu.parallel.sharding import shard_pytree
+
+    cfg = mcfg.get_config("tiny-test")
+    mesh = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    cache_cfg = kvc.KvCacheConfig.for_model(cfg, num_blocks=8,
+                                            block_size=8,
+                                            dtype=np.float32)
+    cache = shard_pytree(init_pp_cache(cache_cfg), pp_cache_pspecs(), mesh)
+    ex, inj = make_pp_block_ops(8, mesh)
+    rng = np.random.default_rng(0)
+    blk = rng.standard_normal(
+        (2, cfg.num_layers, 8, cache_cfg.feature_dim)).astype(np.float32)
+    cache = inj(cache, np.int32(3), blk)
+    out = np.asarray(ex(cache, np.int32(3)))
+    np.testing.assert_array_equal(out, blk)
+    # Other pages stay zero.
+    other = np.asarray(ex(cache, np.int32(2)))
+    assert (other == 0).all()
