@@ -32,10 +32,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shlex
 from typing import Dict, List, Optional
 
 CP_PORT = 7411
-HTTP_PORT = 8000
+HTTP_PORT = 8080  # frontend/main.py's default --http-port
 
 
 def _name(graph_ns: str, svc: str) -> str:
@@ -61,6 +62,23 @@ def _flag_value(args: List[str], flag: str) -> Optional[str]:
     return None
 
 
+def _strip_flag(args: List[str], flag: str) -> List[str]:
+    """Remove `--flag value` AND `--flag=value` forms."""
+    out: List[str] = []
+    skip = False
+    for a in args:
+        if skip:
+            skip = False
+            continue
+        if a == flag:
+            skip = True
+            continue
+        if a.startswith(flag + "="):
+            continue
+        out.append(a)
+    return out
+
+
 def _container(name: str, image: str, module: str, args: List[str],
                tpu_resources: Optional[str], ports: List[dict]) -> dict:
     c = {
@@ -69,7 +87,14 @@ def _container(name: str, image: str, module: str, args: List[str],
         "command": ["python", "-m", module],
         "args": args,
         "ports": ports,
-        "env": [{"name": "JAX_PLATFORMS", "value": "tpu"}],
+        # POD_IP via the downward API: kubelet expands $(POD_IP) in
+        # args, giving workers a ROUTABLE advertised RPC address
+        # (their 127.0.0.1 default only works single-host).
+        "env": [
+            {"name": "JAX_PLATFORMS", "value": "tpu"},
+            {"name": "POD_IP",
+             "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}}},
+        ],
     }
     if tpu_resources:
         c["resources"] = {"limits": {"google.com/tpu": tpu_resources}}
@@ -135,6 +160,14 @@ def render_graph(spec, image: str,
         if svc.inject_control_plane and "--control-plane" not in args:
             args += ["--control-plane", cp_addr]
         is_frontend = svc.module.endswith("frontend")
+        is_worker = svc.module.endswith("worker")
+        if is_frontend:
+            # The app's default binds 127.0.0.1 — unreachable through
+            # kube-proxy; bind the pod-wide wildcard.
+            if _flag_value(args, "--http-host") is None:
+                args += ["--http-host", "0.0.0.0"]
+        if is_worker and _flag_value(args, "--rpc-host") is None:
+            args += ["--rpc-host", "$(POD_IP)"]
         ports = ([{"containerPort": int(_flag_value(args, "--http-port")
                                         or HTTP_PORT)}]
                  if is_frontend else [])
@@ -149,12 +182,7 @@ def render_graph(spec, image: str,
             # (pod-0), the LWS-shaped topology (`graph.go:145`).
             head = f"{name}-ranks"
             rank0 = f"{name}-0.{head}"
-            base = [a for a in args]
-            for flag in ("--process-id",):
-                v = _flag_value(base, flag)
-                if v is not None:
-                    i = base.index(flag)
-                    del base[i:i + 2]
+            base = _strip_flag(list(args), "--process-id")
             base += ["--coordinator", f"{rank0}:9876",
                      "--lockstep", f"{rank0}:9877"]
             out.append({
@@ -178,10 +206,14 @@ def render_graph(spec, image: str,
                             **_container(svc.name, image, svc.module,
                                          base, tpu, []),
                             # Rank = ordinal; shell-expand the pod name.
+                            # Args are shell-quoted EXCEPT the two
+                            # expansions the shell must perform.
                             "command": ["/bin/sh", "-c"],
                             "args": [
                                 "exec python -m " + svc.module + " "
-                                + " ".join(base)
+                                + " ".join(
+                                    a if a == "$(POD_IP)"
+                                    else shlex.quote(a) for a in base)
                                 + " --process-id ${HOSTNAME##*-}"],
                         }]},
                     },

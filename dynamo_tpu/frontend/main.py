@@ -62,6 +62,11 @@ def parse_args(argv=None):
                    help="path to a tokenizer.json (default: byte tokenizer)")
     p.add_argument("--num-blocks", type=int, default=512)
     p.add_argument("--block-size", type=int, default=64)
+    p.add_argument("--kv-cache-block-size", type=int, default=None,
+                   help="workers' KV block size for KV-aware routing in "
+                        "dyn:// static mode (discovery mode reads it "
+                        "from the model card; a mismatch silently zeroes "
+                        "prefix-overlap scores)")
     p.add_argument("--max-tokens-default", type=int, default=512)
     p.add_argument("--speedup-ratio", type=float, default=10.0,
                    help="mocker simulated-time compression")
@@ -151,8 +156,18 @@ async def build_model_handle(args) -> tuple:
                                        if args.router_mode != "kv"
                                        else "round_robin")
         # Same operator graph as discovery mode — --router-mode kv gets
-        # real KV-aware routing here too, not a silent downgrade.
-        router_op = (KvRouterOp(runtime, block_size=args.block_size)
+        # real KV-aware routing here too, not a silent downgrade.  The
+        # block size must match the WORKERS' (discovery mode reads the
+        # card; static mode can't, so it is a flag).
+        if args.router_mode == "kv" and args.kv_cache_block_size is None:
+            logger.warning(
+                "dyn:// with --router-mode kv: assuming workers use "
+                "--block-size %d; pass --kv-cache-block-size if not "
+                "(a mismatch zeroes every prefix-overlap score)",
+                args.block_size)
+        router_op = (KvRouterOp(runtime,
+                                block_size=(args.kv_cache_block_size
+                                            or args.block_size))
                      if args.router_mode == "kv" else RemoteOp())
         pipeline = Pipeline([
             MigrationOp(limit=args.migration_limit), router_op,

@@ -99,6 +99,12 @@ def parse_args(argv=None):
     p.add_argument("--health-port", type=int, default=0,
                    help="per-worker status server port (0 = ephemeral; "
                         "-1 disables; reference system_status_server.rs)")
+    p.add_argument("--rpc-host", default="127.0.0.1",
+                   help="bind + ADVERTISED host for this worker's RPC "
+                        "server; cross-host deployments must set a "
+                        "routable address (K8s manifests inject the pod "
+                        "IP) — the 127.0.0.1 default only works "
+                        "single-host")
     apply_to_parser_defaults(p, load_layered_config(
         {"control_plane": None, "namespace": "dynamo",
          "component": "backend", "endpoint": "generate",
@@ -259,7 +265,7 @@ async def run(args) -> None:
     await native.warmup()  # build the C++ hasher off the event loop
     cp = ControlPlaneClient(*_split(args.control_plane))
     await cp.start()
-    runtime = DistributedRuntime(cp)
+    runtime = DistributedRuntime(cp, rpc_host=args.rpc_host)
     if args.role == "encode":
         await run_encode(args, cp, runtime)
         return

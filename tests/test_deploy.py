@@ -52,8 +52,20 @@ def test_render_graph_manifests(tmp_path):
     assert dc["args"][dc["args"].index("--control-plane") + 1] \
         == "dynamo-dynamo-control-plane:7411"
     assert dc["resources"]["limits"]["google.com/tpu"] == "4"
+    # Workers must advertise a routable RPC address (127.0.0.1 default
+    # would make cross-pod routing dial the wrong pod).
+    assert dc["args"][dc["args"].index("--rpc-host") + 1] == "$(POD_IP)"
+    assert any(e["name"] == "POD_IP" for e in dc["env"])
 
     assert ("Service", "dynamo-dynamo-frontend") in by_kn
+    fe = by_kn[("Deployment", "dynamo-dynamo-frontend")]
+    fc = fe["spec"]["template"]["spec"]["containers"][0]
+    # Frontend must bind the wildcard or kube-proxy can't reach it.
+    assert fc["args"][fc["args"].index("--http-host") + 1] == "0.0.0.0"
+    # The graph pins --http-port 8000; container/Service ports match it.
+    assert fc["ports"] == [{"containerPort": 8000}]
+    fs = by_kn[("Service", "dynamo-dynamo-frontend")]
+    assert fs["spec"]["ports"][0]["targetPort"] == 8000
 
 
 def test_render_multihost_statefulset(tmp_path):
@@ -66,7 +78,8 @@ def test_render_multihost_statefulset(tmp_path):
     spec = GraphSpec(namespace="mh", services=[ServiceSpec(
         name="decode", module="dynamo_tpu.worker",
         args=["--model", "llama-3-8b", "--tp", "8",
-              "--num-processes", "2"])])
+              "--num-processes", "2", "--process-id=0",
+              "--model-name", "my model"])])
     docs = render_graph(spec, "img:v1", tpu_chips_per_worker=4)
     sts = [d for d in docs if d["kind"] == "StatefulSet"]
     assert len(sts) == 1
@@ -77,6 +90,10 @@ def test_render_multihost_statefulset(tmp_path):
     assert "--coordinator dynamo-mh-decode-0.dynamo-mh-decode-ranks:9876" \
         in shell_args
     assert "--process-id ${HOSTNAME##*-}" in shell_args
+    # '--process-id=0' (the '=' form) must be stripped, and args with
+    # spaces shell-quoted.
+    assert "--process-id=0" not in shell_args
+    assert "'my model'" in shell_args
     headless = [d for d in docs if d["kind"] == "Service"
                 and d["spec"].get("clusterIP") == "None"]
     assert len(headless) == 1
