@@ -279,6 +279,7 @@ class EngineCore:
             from dynamo_tpu.parallel.sharding import resolve_moe_mode
 
             moe_mode = resolve_moe_mode(cfg, self.mesh)
+            self._moe_mode = moe_mode
             params = shard_pytree(
                 params,
                 param_pspecs(cfg, moe_mode,
@@ -414,6 +415,10 @@ class EngineCore:
         elif self.mesh is not None:
             self._row_mult = self.mesh.shape["dp"] * (
                 self.mesh.shape["tp"] if config.dp_attention else 1)
+            if getattr(self, "_moe_mode", "dense") == "dispatch":
+                # The all-to-all shard_map shards tokens over dp x ep;
+                # batch rows must divide by both.
+                self._row_mult *= self.mesh.shape["ep"]
         else:
             self._row_mult = 1
         self._requests: Dict[str, Request] = {}
@@ -753,15 +758,13 @@ class EngineCore:
         return deltas
 
     def _window_eligible(self, plan) -> bool:
-        # MoE models take the single-step path: the window's fori_loop
-        # doesn't thread the expert-load aux (telemetry would go dark).
         # Speculative decoding (when configured) supersedes windows.
         # (Prefill work / waiting admissions do NOT disqualify windows:
         # bounded prefill chunks dispatch concurrently behind them —
-        # see step().)
+        # see step().  MoE windows thread the expert-load aux through
+        # the loop carry since r5.)
         if not (self.config.decode_window > 1
                 and self.config.speculative_tokens == 0
-                and not self._moe
                 and not self._pp  # windows build their own non-pp step
                 and plan.decode is not None):
             return False
@@ -1037,7 +1040,8 @@ class EngineCore:
                         self.config.model, self.block_size,
                         self.config.decode_window,
                         use_pallas_decode=self._use_pallas,
-                        greedy_only=greedy_only),
+                        greedy_only=greedy_only,
+                        with_expert_load=self._moe),
                     donate_argnums=(1,))
             self._window_fns[greedy_only] = fn
         return fn
@@ -1102,11 +1106,19 @@ class EngineCore:
                            else req.prompt_tokens[-1])
             last_tokens = self._dev_row(toks)
 
-        (self.cache, out, st["pos"], st["seq"], st["off"]) = \
-            self._window_fn(greedy_only)(
-                self.params, self.cache, last_tokens,
-                st["pos"], st["seq"], st["bts"], st["temp"], st["topk"],
-                st["topp"], st["keys"], st["off"])
+        res = self._window_fn(greedy_only)(
+            self.params, self.cache, last_tokens,
+            st["pos"], st["seq"], st["bts"], st["temp"], st["topk"],
+            st["topp"], st["keys"], st["off"])
+        if self._moe:
+            (self.cache, out, st["pos"], st["seq"], st["off"],
+             load) = res
+            # Device-side accumulation; snapshot_expert_load syncs on
+            # the metrics cadence (same discipline as _run_step).
+            self._load_dev = (load if self._load_dev is None
+                              else self._load_dev + load)
+        else:
+            (self.cache, out, st["pos"], st["seq"], st["off"]) = res
         st["pos_host"][rows] += K
         # Start the device→host copy NOW: copy_to_host_async enqueues the
         # transfer without stalling the execution stream (a blocking

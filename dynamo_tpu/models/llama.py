@@ -329,7 +329,9 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
                        use_pallas_decode: bool = False,
                        greedy_only: bool = False,
                        mesh=None,
-                       dp_local: bool = False):
+                       dp_local: bool = False,
+                       moe_mode: str = "dense",
+                       with_expert_load: bool = False):
     """K decode steps in ONE device dispatch, tokens fed back on-device.
 
     The per-token host loop costs a host↔device round-trip per step — the
@@ -359,7 +361,9 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
     from dynamo_tpu.engine.sampling import sample
 
     step = make_forward_step(cfg, block_size, use_pallas_decode,
-                             mesh=mesh, dp_local=dp_local)
+                             mesh=mesh, dp_local=dp_local,
+                             moe_mode=moe_mode,
+                             with_expert_load=with_expert_load)
 
     def run(params, cache, last_tokens, positions0, seq_lens0, block_tables,
             temp, top_k, top_p, base_key_data, key_offsets):
@@ -378,26 +382,37 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
         live = seq_lens0 > 0
 
         def body(i, carry):
-            cache, toks, out = carry
+            cache, toks, out, load = carry
             adv = jnp.where(live, i, 0)
-            logits, cache = step(
+            res = step(
                 params, cache, toks[:, None],
                 (positions0 + adv)[:, None], seq_lens0 + adv,
                 block_tables, zero_pos)
+            if with_expert_load:
+                # MoE telemetry threads THROUGH the loop carry (the
+                # reason windows were dense-only before r5): per-step
+                # assignment counts accumulate on device.
+                logits, cache, step_load = res
+                load = load + step_load
+            else:
+                logits, cache = res
             if greedy_only:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             else:
                 keys = jax.vmap(jax.random.fold_in)(base_keys,
                                                     key_offsets + i)
                 nxt = sample(logits, temp, top_k, top_p, keys)
-            return cache, nxt, out.at[i].set(nxt)
+            return cache, nxt, out.at[i].set(nxt), load
 
         out0 = jnp.zeros((window, B), jnp.int32)
-        cache, _, out = jax.lax.fori_loop(
-            0, window, body, (cache, last_tokens, out0))
+        load0 = jnp.zeros((cfg.num_experts,), jnp.int32) \
+            if with_expert_load else jnp.zeros((), jnp.int32)
+        cache, _, out, load = jax.lax.fori_loop(
+            0, window, body, (cache, last_tokens, out0, load0))
         adv = jnp.where(live, window, 0)
-        return (cache, out, positions0 + adv, seq_lens0 + adv,
+        base = (cache, out, positions0 + adv, seq_lens0 + adv,
                 key_offsets + window)
+        return base + (load,) if with_expert_load else base
 
     return run
 

@@ -260,8 +260,9 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
     """Jit the fused K-token decode window under a mesh — the fast decode
     path for SERVED sharded models (VERDICT r3 weak #3: without this, a
     tp=8 70B decode would fall back to the per-token host loop over a
-    ~160 ms-RTT link).  Same contract as llama.make_decode_window; dense
-    models only (the window's fori_loop doesn't thread the MoE aux).
+    ~160 ms-RTT link).  Same contract as llama.make_decode_window; MoE
+    models return a sixth output (accumulated expert-load counts — the
+    aux threads through the fori_loop carry since r5).
 
     `use_pallas_decode` routes attention through the Pallas kernel inside
     a shard_map over (dp, tp) — requires head-sharded KV (not
@@ -273,22 +274,26 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
 
     validate(cfg, mesh, dp_attention)
     mh = mesh_spans_processes(mesh)
-    if cfg.is_moe:
-        raise ValueError("decode windows don't thread the MoE expert-load "
-                         "aux; serve MoE models without windows")
     if use_pallas_decode and dp_attention:
         raise ValueError("pallas decode needs head-sharded KV; "
                          "dp_attention slot-shards it")
+    # MoE windows (r5): the expert-load telemetry threads through the
+    # fori_loop carry; the window uses the same resolved moe mode as the
+    # engine's single step.
+    moe_mode = resolve_moe_mode(cfg, mesh)
     run = make_decode_window(cfg, block_size, window,
                              use_pallas_decode=use_pallas_decode,
                              greedy_only=greedy_only, mesh=mesh,
-                             dp_local=dp_local)
+                             dp_local=dp_local,
+                             moe_mode=moe_mode,
+                             with_expert_load=cfg.is_moe)
     batch_axes = ("dp", "tp") if dp_attention else "dp"
     b = NamedSharding(mesh, P(batch_axes))
     b2 = NamedSharding(mesh, P(batch_axes, None))
     in_shardings = (
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     param_pspecs(cfg, dp_attention=dp_attention)),
+                     param_pspecs(cfg, moe_mode,
+                                  dp_attention=dp_attention)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
         b,                                         # last_tokens [B]
@@ -301,7 +306,7 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
         b2,                                        # base_key_data [B, 2]
         b,                                         # key_offsets [B]
     )
-    out_shardings = (
+    out_shardings = [
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
         # Tokens are the one host-read output: multihost replicates them
@@ -311,9 +316,11 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
         b,                                         # positions0 + K
         b,                                         # seq_lens0 + K
         b,                                         # key_offsets + K
-    )
+    ]
+    if cfg.is_moe:
+        out_shardings.append(NamedSharding(mesh, P(None)))  # expert load
     return _finalize(jax.jit(run, in_shardings=in_shardings,
-                             out_shardings=out_shardings,
+                             out_shardings=tuple(out_shardings),
                              donate_argnums=(1,)), in_shardings, mesh)
 
 
