@@ -40,6 +40,7 @@ import numpy as np
 V5E_PEAK_BF16 = 197e12
 V5E_HBM_BW = 819e9
 
+from dynamo_tpu.bench import harness
 from dynamo_tpu.engine import kv_cache as kvc
 from dynamo_tpu.engine.engine import EngineConfig, EngineCore
 from dynamo_tpu.engine.sampling import SamplingParams
@@ -63,8 +64,15 @@ def _sync(x) -> None:
     jax.device_get(jax.tree.leaves(x)[0].ravel()[0])
 
 
-def calibrate_peak_flops(n: int = 4096, chain: int = 16) -> float:
-    """Measured bf16 matmul ceiling via a dependent chain (slope method)."""
+def calibrate_peak_flops(n: int = 4096, chain: int = 16,
+                         nominal=None) -> harness.Probe:
+    """Measured bf16 matmul ceiling via a dependent chain (slope method).
+
+    A tenancy pause inside the short run inflates t1 and overstates the
+    peak (r4 saw 501 TFLOP/s and r5 465.6 on a 197-peak chip from
+    exactly that) — the harness's trimmed-median slope plus the
+    calibration guardrail in main() make that a flagged-invalid run
+    instead of a printed number."""
     a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
     b = jnp.eye(n, dtype=jnp.bfloat16)
 
@@ -74,8 +82,7 @@ def calibrate_peak_flops(n: int = 4096, chain: int = 16) -> float:
             a = jax.lax.dot(a, b, preferred_element_type=jnp.bfloat16)
         return a
 
-    c = step(a, b)
-    _sync(c)
+    _, cold_s = harness.timed(lambda: _sync(step(a, b)))
 
     def run(m):
         c = a
@@ -85,19 +92,17 @@ def calibrate_peak_flops(n: int = 4096, chain: int = 16) -> float:
         _sync(c)
         return time.perf_counter() - t0
 
-    # Median of three slope estimates: a tenancy pause inside the short
-    # run inflates t1 and overstates the peak (r4 saw 501 TFLOP/s on a
-    # 197-peak chip from exactly that).
-    n1, n2 = 2, 8
-    per_calls = []
-    for _ in range(3):
-        t1, t2 = run(n1), run(n2)
-        per_calls.append(max((t2 - t1) / (n2 - n1), 1e-9))
-    per_call = sorted(per_calls)[1]
-    return chain * 2 * n**3 / per_call
+    est = harness.measure_slope(run, 2, 8, repeats=3, cold_s=cold_s)
+    flops_per_call = chain * 2 * n**3
+    return harness.Probe(
+        name="peak_flops",
+        measured=flops_per_call / est.per_call_s,
+        nominal=nominal,
+        samples=tuple(flops_per_call / s for s in est.samples),
+        unit=" FLOP/s")
 
 
-def measure_hbm_bw(mb: int = 512) -> float:
+def measure_hbm_bw(mb: int = 512, nominal=None) -> harness.Probe:
     """Measured HBM bandwidth: chained unary op over `mb` MB of bf16
     (reads N + writes N per call), slope-timed.  Cross-check only — the
     MBU denominator is the v5e nominal (see module constants)."""
@@ -108,8 +113,7 @@ def measure_hbm_bw(mb: int = 512) -> float:
     def step(x):
         return x + jnp.bfloat16(1)
 
-    x = step(a)
-    _sync(x)
+    _sync(step(a))
 
     def run(m):
         y = a
@@ -120,11 +124,16 @@ def measure_hbm_bw(mb: int = 512) -> float:
         return time.perf_counter() - t0
 
     # Wide slope points: on the shared chip short runs are noise-bound
-    # and t2<t1 happens (r4 saw a 'measured' 1e9 GB/s from exactly that).
-    n1, n2 = 6, 30
-    t1, t2 = run(n1), run(n2)
-    per_call = max((t2 - t1) / (n2 - n1), 1e-9)
-    return min(2 * n * 2 / per_call, 5e12)  # clamp at 5 TB/s: noise guard
+    # and t2<t1 happens (r4 saw a 'measured' 1e9 GB/s from exactly that);
+    # 3 repeats + trimmed median instead of one shot.
+    est = harness.measure_slope(run, 6, 30, repeats=3)
+    bytes_per_call = 2 * n * 2
+    return harness.Probe(
+        name="hbm_bw",
+        measured=bytes_per_call / est.per_call_s,
+        nominal=nominal,
+        samples=tuple(bytes_per_call / s for s in est.samples),
+        unit=" B/s")
 
 
 def _flops_per_token(cfg, params, ctx: int) -> float:
@@ -171,20 +180,14 @@ def bench_raw_step(cfg, params, use_pallas_decode):
         _sync(st[1])
         return time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    run(1)  # compile
-    compile_s = time.perf_counter() - t0
+    _, compile_s = harness.timed(lambda: run(1))
     # Median of 3 slopes: the shared chip's tenancy jitter produced a
     # single-slope reading of 1.24 ms/step in r5 — below the 4.3 ms HBM
     # roofline, i.e. physically impossible — and one bad slope must not
     # define the round's headline number.
-    n1, n2 = 4, 20
-    slopes = []
-    for _ in range(3):
-        t1, t2 = run(n1), run(n2)
-        slopes.append(max((t2 - t1) / (n2 - n1), 1e-9))
-    step_s = sorted(slopes)[1]
-    return BATCH / step_s, step_s, compile_s
+    est = harness.measure_slope(run, 4, 20, repeats=3, cold_s=compile_s)
+    step_s = est.per_call_s
+    return BATCH / step_s, step_s, est
 
 
 def bench_window(cfg, params, window: int):
@@ -222,13 +225,10 @@ def bench_window(cfg, params, window: int):
         return time.perf_counter() - t0
 
     run(1)  # compile
-    n1, n2 = 2, 6
-    slopes = []
-    for _ in range(3):
-        t1, t2 = run(n1), run(n2)
-        slopes.append(max((t2 - t1) / (n2 - n1), 1e-9))
-    win_s = sorted(slopes)[1]  # median of 3 (shared-chip jitter)
-    return BATCH * window / win_s, win_s / window
+    # Trimmed-median of 3 slopes (shared-chip jitter).
+    est = harness.measure_slope(run, 2, 6, repeats=3)
+    win_s = est.per_call_s
+    return BATCH * window / win_s, win_s / window, est
 
 
 def bench_serving_path(cfg, params, decode_window, n_waves=3):
@@ -379,18 +379,23 @@ def main():
     # matmul, slope-timed with forced completion — reported as a
     # cross-check; the MFU/MBU denominators are the v5e datasheet values
     # (197 TFLOP/s bf16, 819 GB/s) so ratios are stable across tenancy.
-    peak_measured = calibrate_peak_flops()
-    hbm_measured = measure_hbm_bw()
+    # Off-TPU there is no datasheet to check against (nominal=None), so
+    # the probes only contribute spread to tenancy_health.
+    peak_probe = calibrate_peak_flops(
+        nominal=V5E_PEAK_BF16 if on_tpu else None)
+    hbm_probe = measure_hbm_bw(nominal=V5E_HBM_BW if on_tpu else None)
+    peak_measured = peak_probe.measured
+    hbm_measured = hbm_probe.measured
     peak = V5E_PEAK_BF16 if on_tpu else peak_measured
     hbm_bw = V5E_HBM_BW if on_tpu else hbm_measured
 
-    tok_s_single, step_s, compile_s = bench_raw_step(
+    tok_s_single, step_s, step_est = bench_raw_step(
         cfg, params, use_pallas_decode=on_tpu)
+    compile_s = step_est.cold_s
     window = 8
-    tok_s_win, win_step_s = bench_window(cfg, params, window)
+    tok_s_win, win_step_s, win_est = bench_window(cfg, params, window)
     raw = max(tok_s_single, tok_s_win)
     mfu = raw * _flops_per_token(cfg, params, CTX) / peak
-    assert mfu < 1.0, f"impossible MFU {mfu:.3f} (peak {peak/1e12:.0f}e12)"
 
     # MBU: bytes the decode step MUST move (weights once + live KV) over
     # the window step time, against nominal HBM bandwidth — for decode,
@@ -414,7 +419,40 @@ def main():
     prefill_steady = max(prefill_runs[1:])
     serving_mfu = (serving_tok_s * _flops_per_token(cfg, params, CTX) / peak)
 
-    print(json.dumps({
+    # Calibration guardrails (VERDICT r5 weak #2 / next-round #1): a probe
+    # above 1.1x the datasheet, or a decode step implying more HBM
+    # bandwidth than the chip has, marks the whole run invalid and
+    # suppresses vs_baseline — r5 printed a 465.6 TFLOP/s "measured peak"
+    # on a 197 TFLOP/s part and the halved serving number sailed into the
+    # round JSON unflagged.  The derived-throughput probes (raw decode
+    # FLOPs vs peak, window-step bytes vs HBM) replace the old
+    # `assert mfu < 1.0`: an impossible reading now yields a flagged
+    # artifact the regression gate rejects, not a crashed bench.
+    # Off-TPU the "nominals" would be the CPU's own noisy measurements —
+    # a ratio of two jittery samples is not an impossibility test, so
+    # the derived probes contribute spread only (nominal=None), same as
+    # the direct probes above.
+    probes = [
+        peak_probe,
+        hbm_probe,
+        harness.Probe(
+            name="raw_decode_flops",
+            measured=raw * _flops_per_token(cfg, params, CTX),
+            nominal=peak if on_tpu else None,
+            samples=tuple(BATCH / s * _flops_per_token(cfg, params, CTX)
+                          for s in step_est.samples),
+            unit=" FLOP/s"),
+        harness.Probe(
+            name="decode_step_bandwidth",
+            measured=step_bytes / win_step_s,
+            nominal=hbm_bw if on_tpu else None,
+            samples=tuple(step_bytes / (s / window)
+                          for s in win_est.samples),
+            unit=" B/s"),
+    ]
+    verdict = harness.evaluate_calibration(probes)
+
+    print(json.dumps(harness.guard_result({
         "metric": "decode_throughput_llama1b_b64_ctx512_serving_geom",
         "value": round(raw, 2),
         "unit": "tok/s/chip",
@@ -441,10 +479,12 @@ def main():
         "peak_flops_measured": round(peak_measured / 1e12, 1),
         "hbm_bw_nominal_gbs": round(hbm_bw / 1e9, 1),
         "hbm_bw_measured_gbs": round(hbm_measured / 1e9, 1),
+        "peak_flops_spread": round(peak_probe.spread, 2),
+        "hbm_bw_spread": round(hbm_probe.spread, 2),
         "max_pages_per_seq": MAX_PAGES,
         "warmup_s": round(compile_s, 1),
         "device": str(dev),
-    }))
+    }, verdict)))
 
 
 if __name__ == "__main__":

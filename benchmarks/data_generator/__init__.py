@@ -1,0 +1,40 @@
+"""Workload data-generator suite (reference `benchmarks/data_generator/`):
+
+- `synthesizer` — prefix-structured mooncake trace synthesis
+- `hasher` — raw token streams → chained-hash `hash_ids` records
+- `prefix_analyzer` — theoretical cache-hit rate + workload shape
+- `sampler` — fit/resample load distributions at scale
+- `cli` — synthesize → hash → analyze in one command
+"""
+
+from benchmarks.data_generator.hasher import TraceHasher, hash_token_trace
+from benchmarks.data_generator.prefix_analyzer import (
+    TraceReport,
+    analyze_trace,
+)
+from benchmarks.data_generator.sampler import TraceSampler, fit_and_resample
+from benchmarks.data_generator.synthesizer import (
+    TraceRecord,
+    TraceSynthesizer,
+    analyze_prefixes,
+    load_trace,
+    save_trace,
+    synthesize_prefix_heavy,
+    tokens_for_record,
+)
+
+__all__ = [
+    "TraceHasher",
+    "TraceRecord",
+    "TraceReport",
+    "TraceSampler",
+    "TraceSynthesizer",
+    "analyze_prefixes",
+    "analyze_trace",
+    "fit_and_resample",
+    "hash_token_trace",
+    "load_trace",
+    "save_trace",
+    "synthesize_prefix_heavy",
+    "tokens_for_record",
+]

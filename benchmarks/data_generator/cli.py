@@ -1,0 +1,175 @@
+"""Data-generator CLI: synthesize → hash → analyze in one tool.
+
+Role of the reference's `benchmarks/data_generator/cli.py` (the
+`datagen` entry point): one command over the whole workload-analysis
+suite.
+
+    python -m benchmarks.data_generator.cli synthesize --requests 200 \
+        --out trace.jsonl
+    python -m benchmarks.data_generator.cli hash --tokens raw.jsonl \
+        --block-size 64 --out hashed.jsonl
+    python -m benchmarks.data_generator.cli analyze --trace trace.jsonl \
+        --block-size 64 --cache-blocks 224
+    python -m benchmarks.data_generator.cli sample --trace trace.jsonl \
+        --requests 1000 --out big.jsonl
+    python -m benchmarks.data_generator.cli pipeline --requests 200
+
+`pipeline` runs synthesize → analyze and prints the trace's predicted
+hit rate — the number `benchmarks.router_bench` prints next to the
+mocker-measured rate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from benchmarks.data_generator.hasher import (
+    hash_token_trace,
+    load_token_trace,
+)
+from benchmarks.data_generator.prefix_analyzer import analyze_trace
+from benchmarks.data_generator.sampler import TraceSampler
+from benchmarks.data_generator.synthesizer import (
+    TraceRecord,
+    TraceSynthesizer,
+    load_trace,
+    save_trace,
+    synthesize_prefix_heavy,
+)
+
+
+def _emit(records: List[TraceRecord], out: Optional[str]) -> None:
+    if out:
+        save_trace(records, out)
+    else:
+        for r in records:
+            print(r.to_json())
+
+
+def _synthesize(args) -> List[TraceRecord]:
+    if args.trace:
+        syn = TraceSynthesizer(load_trace(args.trace),
+                               block_size=args.block_size)
+        return syn.synthesize(args.requests,
+                              speedup_ratio=args.speedup,
+                              prompt_len_multiplier=args.len_mult,
+                              seed=args.seed)
+    return synthesize_prefix_heavy(
+        args.requests, num_roots=args.roots,
+        context_blocks=args.context_blocks,
+        suffix_tokens=args.suffix, output_tokens=args.osl,
+        interval_ms=args.interval_ms, block_size=args.block_size,
+        seed=args.seed)
+
+
+def cmd_synthesize(args) -> int:
+    _emit(_synthesize(args), args.out)
+    return 0
+
+
+def cmd_hash(args) -> int:
+    records = hash_token_trace(load_token_trace(args.tokens),
+                               block_size=args.block_size)
+    _emit(records, args.out)
+    return 0
+
+
+def cmd_analyze(args) -> int:
+    report = analyze_trace(load_trace(args.trace), args.block_size,
+                           cache_blocks=args.cache_blocks)
+    print(json.dumps(report.to_dict(), indent=2))
+    return 0
+
+
+def cmd_sample(args) -> int:
+    sampler = TraceSampler.fit(load_trace(args.trace), args.block_size)
+    records = sampler.sample(args.requests, speedup_ratio=args.speedup,
+                             prompt_len_multiplier=args.len_mult,
+                             seed=args.seed)
+    _emit(records, args.out)
+    return 0
+
+
+def cmd_pipeline(args) -> int:
+    """synthesize → (optionally save) → analyze, one JSON report."""
+    records = _synthesize(args)
+    if args.out:
+        save_trace(records, args.out)
+    report = analyze_trace(records, args.block_size,
+                           cache_blocks=args.cache_blocks)
+    print(json.dumps({
+        "trace": args.out or "<stdout suppressed>",
+        "analysis": report.to_dict(),
+        "predicted_hit_rate": report.to_dict()["theoretical_hit_rate"],
+    }, indent=2))
+    return 0
+
+
+def _common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--block-size", type=int, default=64,
+                   help="hash_id block granularity (tokens)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None, help="write jsonl here")
+
+
+def _synth_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--trace", default=None,
+                   help="learn structure from this mooncake jsonl")
+    p.add_argument("--requests", type=int, default=200)
+    p.add_argument("--roots", type=int, default=16)
+    p.add_argument("--context-blocks", type=int, default=24)
+    p.add_argument("--suffix", type=int, default=32)
+    p.add_argument("--osl", type=int, default=8)
+    p.add_argument("--interval-ms", type=float, default=400.0)
+    p.add_argument("--speedup", type=float, default=1.0)
+    p.add_argument("--len-mult", type=float, default=1.0)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("benchmarks.data_generator",
+                                description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("synthesize", help="generate a mooncake trace")
+    _common(s); _synth_args(s)
+    s.set_defaults(fn=cmd_synthesize)
+
+    h = sub.add_parser("hash", help="raw token jsonl → mooncake jsonl")
+    _common(h)
+    h.add_argument("--tokens", required=True,
+                   help="jsonl of {'input_ids': [...]} entries")
+    h.set_defaults(fn=cmd_hash)
+
+    a = sub.add_parser("analyze", help="trace → prefix/length report")
+    _common(a)
+    a.add_argument("--trace", required=True)
+    a.add_argument("--cache-blocks", type=int, default=None,
+                   help="also simulate a bounded LRU pool of this size")
+    a.set_defaults(fn=cmd_analyze)
+
+    sm = sub.add_parser("sample", help="fit load shape, resample at scale")
+    _common(sm)
+    sm.add_argument("--trace", required=True)
+    sm.add_argument("--requests", type=int, default=1000)
+    sm.add_argument("--speedup", type=float, default=1.0)
+    sm.add_argument("--len-mult", type=float, default=1.0)
+    sm.set_defaults(fn=cmd_sample)
+
+    pl = sub.add_parser("pipeline",
+                        help="synthesize → analyze in one command")
+    _common(pl); _synth_args(pl)
+    pl.add_argument("--cache-blocks", type=int, default=None)
+    pl.set_defaults(fn=cmd_pipeline)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,98 @@
+"""Token-trace hasher: raw token streams → mooncake `hash_ids` records.
+
+Role of the reference's `benchmarks/data_generator/hasher.py`: real
+traces arrive as token id lists (or text), not as pre-blocked hash ids.
+This module turns them into the mooncake format the synthesizer,
+analyzer and router benchmarks speak, using the SAME chained block-hash
+semantics as the serving stack (`dynamo_tpu/tokens.py`) — each block's
+hash commits to the full prefix, so two requests share a `hash_id` iff
+they share the entire prefix up to and including that block.  That
+parity is what makes analyzer predictions transfer to the real engines:
+the ids in a hashed trace partition token streams exactly the way the
+block manager, KV router and mocker partition them.
+
+Global 64-bit chain hashes are remapped to compact local ids (0, 1, ...)
+in first-seen order, matching the reference's texture: trace files stay
+small and diffable, and equal local ids still mean "byte-identical
+prefix" because the remap is injective.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from benchmarks.data_generator.synthesizer import TraceRecord
+from dynamo_tpu.tokens import compute_block_hashes
+
+DEFAULT_BLOCK_SIZE = 512
+
+
+@dataclass
+class TraceHasher:
+    """Stateful hasher: a shared global-hash → local-id map across all
+    requests of a trace, so ids are comparable trace-wide."""
+
+    block_size: int = DEFAULT_BLOCK_SIZE
+    _local_ids: Dict[int, int] = field(default_factory=dict)
+
+    @property
+    def num_unique_blocks(self) -> int:
+        return len(self._local_ids)
+
+    def hash_tokens(self, tokens: Sequence[int]) -> List[int]:
+        """Chained block hashes of `tokens`, remapped to local ids.
+
+        Only complete blocks are hashed (the serving stack's rule: the
+        trailing partial block is never reusable).
+        """
+        out = []
+        for h in compute_block_hashes(tokens, self.block_size):
+            local = self._local_ids.get(h)
+            if local is None:
+                local = len(self._local_ids)
+                self._local_ids[h] = local
+            out.append(local)
+        return out
+
+    def hash_record(self, timestamp: float, tokens: Sequence[int],
+                    output_length: int) -> TraceRecord:
+        return TraceRecord(
+            timestamp=timestamp,
+            input_length=len(tokens),
+            output_length=output_length,
+            hash_ids=self.hash_tokens(tokens))
+
+
+def hash_token_trace(
+    entries: Iterable[dict], *,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    hasher: Optional[TraceHasher] = None,
+) -> List[TraceRecord]:
+    """Hash an iterable of raw-token entries into mooncake records.
+
+    Each entry is a dict with `input_ids` (token id list), optional
+    `timestamp` (ms; defaults to arrival order) and optional
+    `output_length` (defaults to 1).
+    """
+    th = hasher or TraceHasher(block_size=block_size)
+    out: List[TraceRecord] = []
+    for i, e in enumerate(entries):
+        toks = e["input_ids"]
+        out.append(th.hash_record(
+            timestamp=float(e.get("timestamp", i)),
+            tokens=toks,
+            output_length=int(e.get("output_length", 1))))
+    return out
+
+
+def load_token_trace(path: str) -> List[dict]:
+    """Raw-token jsonl: one `{"input_ids": [...], ...}` object per line."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,175 @@
+"""Prefix analyzer: what cache-hit rate SHOULD a trace produce?
+
+Role of the reference's `benchmarks/data_generator/prefix_analyzer.py`:
+walk a mooncake trace in timestamp order and compute the *theoretical*
+prefix-cache hit rate — the number the KV-router benchmarks must be
+judged against.  Without it the router bench is half-blind: the mocker
+reports what it measured, but only the analyzer says what a perfect
+(or capacity-bounded) cache could have achieved, so a routing/eviction
+regression is distinguishable from a workload change.
+
+Two cache models:
+
+- infinite cache: every block seen once is a hit forever — the upper
+  bound any fleet can approach (`theoretical_hit_rate`).
+- bounded LRU: a single pool of `cache_blocks` with the same
+  reuse-then-evict semantics as `MockKvManager` (freed blocks stay
+  resident until LRU-evicted), predicting what ONE engine of that
+  capacity measures (`bounded_hit_rate`).
+
+Plus the workload-shape statistics the reference reports: ISL/OSL
+distributions (mean + percentiles) and shared-prefix structure (roots,
+branch depth, requests per root).
+"""
+
+from __future__ import annotations
+
+from collections import Counter, OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from benchmarks.data_generator.synthesizer import (
+    DEFAULT_BLOCK_SIZE,
+    TraceRecord,
+)
+
+
+def _percentiles(values: Sequence[float],
+                 pts=(0.5, 0.9, 0.99)) -> Dict[str, float]:
+    if not values:
+        return {f"p{int(p * 100)}": 0.0 for p in pts}
+    vs = sorted(values)
+    n = len(vs)
+    return {f"p{int(p * 100)}": float(vs[min(n - 1, int(p * n))])
+            for p in pts}
+
+
+def _dist_summary(values: Sequence[float]) -> Dict[str, float]:
+    out = {"mean": round(sum(values) / len(values), 2) if values else 0.0,
+           "min": float(min(values)) if values else 0.0,
+           "max": float(max(values)) if values else 0.0}
+    out.update(_percentiles(values))
+    return out
+
+
+class _LruCache:
+    """Bounded block cache with MockKvManager reuse semantics: blocks stay
+    resident after release and are evicted LRU when capacity is needed."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self._blocks: "OrderedDict[int, None]" = OrderedDict()
+        self.evictions = 0
+
+    def touch(self, block: int) -> bool:
+        """Access `block`; returns True on a hit (it was resident)."""
+        hit = block in self._blocks
+        if hit:
+            self._blocks.move_to_end(block)
+        else:
+            if len(self._blocks) >= self.capacity:
+                self._blocks.popitem(last=False)
+                self.evictions += 1
+            self._blocks[block] = None
+        return hit
+
+
+@dataclass
+class TraceReport:
+    """Full analyzer report (superset of the synthesizer's PrefixStats)."""
+
+    num_requests: int = 0
+    total_input_tokens: int = 0
+    total_output_tokens: int = 0
+    total_hashed_tokens: int = 0
+    reused_tokens_infinite: int = 0
+    reused_tokens_bounded: Optional[int] = None
+    cache_blocks: Optional[int] = None
+    bounded_evictions: int = 0
+    unique_blocks: int = 0
+    isl: List[int] = field(default_factory=list)
+    osl: List[int] = field(default_factory=list)
+    prefix_depths: List[int] = field(default_factory=list)
+    root_counts: Counter = field(default_factory=Counter)
+    per_request_hit_rate: List[float] = field(default_factory=list)
+
+    # -- headline numbers --------------------------------------------------
+
+    @property
+    def theoretical_hit_rate(self) -> float:
+        """Infinite-cache token reuse rate over ALL input tokens — the
+        apples-to-apples comparand of the mocker's
+        `cache_hit_tokens / input_tokens`."""
+        return (self.reused_tokens_infinite / self.total_input_tokens
+                if self.total_input_tokens else 0.0)
+
+    @property
+    def bounded_hit_rate(self) -> Optional[float]:
+        if self.reused_tokens_bounded is None:
+            return None
+        return (self.reused_tokens_bounded / self.total_input_tokens
+                if self.total_input_tokens else 0.0)
+
+    def to_dict(self) -> dict:
+        n = self.num_requests
+        out = {
+            "num_requests": n,
+            "total_input_tokens": self.total_input_tokens,
+            "total_output_tokens": self.total_output_tokens,
+            "unique_blocks": self.unique_blocks,
+            "theoretical_hit_rate": round(self.theoretical_hit_rate, 4),
+            "mean_request_hit_rate": round(
+                sum(self.per_request_hit_rate) / n, 4) if n else 0.0,
+            "isl": _dist_summary(self.isl),
+            "osl": _dist_summary(self.osl),
+            "shared_prefix": {
+                "num_roots": len(self.root_counts),
+                "max_requests_per_root": (max(self.root_counts.values())
+                                          if self.root_counts else 0),
+                "depth": _dist_summary(self.prefix_depths),
+            },
+        }
+        if self.reused_tokens_bounded is not None:
+            out["bounded_cache"] = {
+                "cache_blocks": self.cache_blocks,
+                "hit_rate": round(self.bounded_hit_rate, 4),
+                "evictions": self.bounded_evictions,
+            }
+        return out
+
+
+def analyze_trace(records: List[TraceRecord],
+                  block_size: int = DEFAULT_BLOCK_SIZE,
+                  cache_blocks: Optional[int] = None) -> TraceReport:
+    """Walk `records` in timestamp order and build the full report.
+
+    `cache_blocks`: also simulate a single bounded LRU pool of that many
+    blocks (None → infinite-cache numbers only).
+    """
+    rep = TraceReport(cache_blocks=cache_blocks)
+    seen: set = set()
+    lru = _LruCache(cache_blocks) if cache_blocks else None
+    if lru is not None:
+        rep.reused_tokens_bounded = 0
+    for r in sorted(records, key=lambda r: r.timestamp):
+        rep.num_requests += 1
+        rep.total_input_tokens += r.input_length
+        rep.total_output_tokens += r.output_length
+        rep.total_hashed_tokens += len(r.hash_ids) * block_size
+        rep.isl.append(r.input_length)
+        rep.osl.append(r.output_length)
+        rep.prefix_depths.append(len(r.hash_ids))
+        if r.hash_ids:
+            rep.root_counts[r.hash_ids[0]] += 1
+        reused = sum(1 for h in r.hash_ids if h in seen)
+        rep.reused_tokens_infinite += reused * block_size
+        rep.per_request_hit_rate.append(
+            reused * block_size / r.input_length if r.input_length else 0.0)
+        seen.update(r.hash_ids)
+        if lru is not None:
+            hits = sum(1 for h in r.hash_ids if lru.touch(h))
+            rep.reused_tokens_bounded += hits * block_size
+    rep.unique_blocks = len(seen)
+    if lru is not None:
+        rep.bounded_evictions = lru.evictions
+    return rep

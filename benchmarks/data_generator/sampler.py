@@ -1,0 +1,131 @@
+"""Distribution sampler: fit a trace's load shape, resample at scale.
+
+Role of the reference's `benchmarks/data_generator/sampler.py`: the
+synthesizer reproduces a trace's PREFIX structure; this module
+reproduces its LOAD shape — input/output length and inter-arrival
+distributions — so a 1k-request source trace can drive a 100k-request
+benchmark with the same statistics.  Empirical quantile fitting (no
+scipy): sampling inverts the source CDF with linear interpolation
+between order statistics, so fit → resample → refit is a fixed point
+(the round-trip parity a tier-1 test holds).
+
+Knobs mirror the reference CLI: `speedup_ratio` compresses arrivals,
+`prompt_len_multiplier` scales ISL.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from benchmarks.data_generator.synthesizer import (
+    DEFAULT_BLOCK_SIZE,
+    TraceRecord,
+)
+
+
+@dataclass(frozen=True)
+class EmpiricalDist:
+    """Empirical distribution sampled by inverse-CDF interpolation."""
+
+    values: tuple  # sorted
+
+    @staticmethod
+    def fit(values: Sequence[float]) -> "EmpiricalDist":
+        return EmpiricalDist(tuple(sorted(float(v) for v in values))
+                             or (0.0,))
+
+    @property
+    def mean(self) -> float:
+        return sum(self.values) / len(self.values)
+
+    def quantile(self, q: float) -> float:
+        vs = self.values
+        if len(vs) == 1:
+            return vs[0]
+        pos = q * (len(vs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(vs) - 1)
+        frac = pos - lo
+        return vs[lo] * (1.0 - frac) + vs[hi] * frac
+
+    def sample(self, rng: random.Random) -> float:
+        return self.quantile(rng.random())
+
+
+@dataclass
+class TraceSampler:
+    """Fitted (ISL, OSL, inter-arrival) distributions of a trace."""
+
+    isl: EmpiricalDist
+    osl: EmpiricalDist
+    interval_ms: EmpiricalDist
+    block_size: int = DEFAULT_BLOCK_SIZE
+
+    @staticmethod
+    def fit(records: List[TraceRecord],
+            block_size: int = DEFAULT_BLOCK_SIZE) -> "TraceSampler":
+        if not records:
+            raise ValueError("empty source trace")
+        ordered = sorted(records, key=lambda r: r.timestamp)
+        intervals = [max(0.0, b.timestamp - a.timestamp)
+                     for a, b in zip(ordered, ordered[1:])]
+        return TraceSampler(
+            isl=EmpiricalDist.fit([r.input_length for r in ordered]),
+            osl=EmpiricalDist.fit([r.output_length for r in ordered]),
+            interval_ms=EmpiricalDist.fit(intervals or [0.0]),
+            block_size=block_size)
+
+    def sample(self, num_requests: int, *,
+               speedup_ratio: float = 1.0,
+               prompt_len_multiplier: float = 1.0,
+               seed: int = 0,
+               hash_unique: bool = False) -> List[TraceRecord]:
+        """Draw `num_requests` fresh records with the fitted load shape.
+
+        Sampled records carry no shared prefix structure by default
+        (`hash_ids=[]` — load-only resampling; compose with the
+        synthesizer for structure).  `hash_unique` instead assigns each
+        request its own full-block ids, modelling a zero-reuse workload
+        at the same lengths.
+        """
+        rng = random.Random(seed)
+        out: List[TraceRecord] = []
+        ts = 0.0
+        next_id = 0
+        for _ in range(num_requests):
+            isl = max(1, int(round(self.isl.sample(rng)
+                                   * prompt_len_multiplier)))
+            osl = max(1, int(round(self.osl.sample(rng))))
+            hash_ids: List[int] = []
+            if hash_unique:
+                n_blocks = isl // self.block_size
+                hash_ids = list(range(next_id, next_id + n_blocks))
+                next_id += n_blocks
+            out.append(TraceRecord(
+                timestamp=ts, input_length=isl, output_length=osl,
+                hash_ids=hash_ids))
+            ts += self.interval_ms.sample(rng) / max(speedup_ratio, 1e-9)
+        return out
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        def one(d: EmpiricalDist) -> Dict[str, float]:
+            return {"mean": round(d.mean, 2),
+                    "p50": round(d.quantile(0.5), 2),
+                    "p90": round(d.quantile(0.9), 2)}
+
+        return {"isl": one(self.isl), "osl": one(self.osl),
+                "interval_ms": one(self.interval_ms)}
+
+
+def fit_and_resample(records: List[TraceRecord], num_requests: int, *,
+                     speedup_ratio: float = 1.0,
+                     prompt_len_multiplier: float = 1.0,
+                     seed: int = 0,
+                     block_size: int = DEFAULT_BLOCK_SIZE,
+                     ) -> List[TraceRecord]:
+    """One-shot fit → sample (the CLI's `sample` subcommand)."""
+    return TraceSampler.fit(records, block_size).sample(
+        num_requests, speedup_ratio=speedup_ratio,
+        prompt_len_multiplier=prompt_len_multiplier, seed=seed)

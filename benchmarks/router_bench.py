@@ -23,9 +23,9 @@ import json
 import time
 from typing import Dict, List
 
+from benchmarks.data_generator.prefix_analyzer import analyze_trace
 from benchmarks.data_generator.synthesizer import (
     TraceRecord,
-    analyze_prefixes,
     load_trace,
     synthesize_prefix_heavy,
     tokens_for_record,
@@ -135,14 +135,31 @@ async def run(args) -> Dict:
             suffix_tokens=args.suffix, output_tokens=args.osl,
             interval_ms=args.interval_ms, block_size=args.trace_block)
         trace_block = args.trace_block
-    structure = analyze_prefixes(records, trace_block).to_dict()
+    # Analyzer prediction (prefix_analyzer): the theoretical hit rate is
+    # the infinite-cache ceiling any routing policy can approach; the
+    # bounded rate simulates ONE engine's LRU pool — round-robin across N
+    # workers lands below it (each cache sees 1/N of each context's
+    # traffic), KV-affinity routing should land between bounded and
+    # theoretical.  Printing predicted next to measured is what makes a
+    # hit-rate regression attributable: workload change moves predicted,
+    # router/eviction change moves only measured.
+    report = analyze_trace(records, trace_block,
+                           cache_blocks=args.engine_blocks)
+    predicted = round(report.theoretical_hit_rate, 4)
+    predicted_bounded = (round(report.bounded_hit_rate, 4)
+                         if report.bounded_hit_rate is not None else None)
     rr = await replay(records, "rr", args.workers, args.speedup,
                       trace_block, args.engine_blocks)
     kv = await replay(records, "kv", args.workers, args.speedup,
                       trace_block, args.engine_blocks)
+    for mode in (rr, kv):
+        mode["hit_rate_vs_predicted"] = round(
+            mode["cache_hit_rate"] - predicted, 4)
     return {
         "metric": "router_ttft_kv_vs_rr",
-        "trace": structure,
+        "trace": report.to_dict(),
+        "predicted_hit_rate": predicted,
+        "predicted_hit_rate_bounded": predicted_bounded,
         "rr": rr,
         "kv": kv,
         "ttft_speedup_p50": round(

@@ -1,0 +1,155 @@
+"""Regression gate: new BENCH JSON vs baseline, machine-readable verdict.
+
+The cross-round failure the gate closes (VERDICT r5 #1): the canonical
+serving number halved between rounds and the only detector was a human
+reading two JSON files.  `compare` takes the new run and a baseline —
+`BASELINE.json`, the previous round's `BENCH_rNN.json` (both the bare
+bench output and the driver's `{"parsed": ...}` wrapper are accepted) —
+and fails when any gated metric regresses beyond the threshold, or when
+the new run carries `calibration_ok: false` / `run_valid: false` (an
+invalid run is an automatic gate failure: it must be re-run, not
+compared).
+
+An INVALID BASELINE is different: its numbers are garbage, so
+comparison is skipped with a warning instead of failing the new run for
+the old run's sins.
+
+CLI entry point: `tools/bench_gate.py` (exits nonzero on failure).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_THRESHOLD = 0.2  # fractional regression that fails the gate
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """One gated metric.  `higher_is_better=False` flips the direction
+    (latencies regress upward)."""
+
+    key: str
+    higher_is_better: bool = True
+
+
+# The round-over-round health of the serving stack, in the order a human
+# would triage them: raw decode ceiling, the full serving path, prefill,
+# per-token latency, decode-under-prefill interference.
+DEFAULT_METRICS: Tuple[MetricSpec, ...] = (
+    MetricSpec("value"),
+    MetricSpec("serving_tok_s"),
+    MetricSpec("prefill_tok_s"),
+    MetricSpec("itl_ms", higher_is_better=False),
+)
+
+
+def load_bench_json(path: str) -> Dict:
+    """Load a bench artifact, unwrapping the driver's BENCH_rNN wrapper
+    (`{"n": ..., "parsed": {...}}`) down to the bare metric dict."""
+    with open(path) as f:
+        doc = json.load(f)
+    return unwrap(doc)
+
+
+def unwrap(doc: Dict) -> Dict:
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc
+
+
+def _is_invalid(doc: Dict) -> bool:
+    return (doc.get("calibration_ok") is False
+            or doc.get("run_valid") is False)
+
+
+@dataclass
+class GateResult:
+    ok: bool
+    regressions: List[Dict] = field(default_factory=list)
+    improvements: List[Dict] = field(default_factory=list)
+    skipped: List[str] = field(default_factory=list)
+    warnings: List[str] = field(default_factory=list)
+    new_invalid: bool = False
+    baseline_invalid: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "gate": "pass" if self.ok else "fail",
+            "new_invalid": self.new_invalid,
+            "baseline_invalid": self.baseline_invalid,
+            "regressions": self.regressions,
+            "improvements": self.improvements,
+            "skipped": self.skipped,
+            "warnings": self.warnings,
+        }
+
+
+def compare(new: Dict, baseline: Dict,
+            threshold: float = DEFAULT_THRESHOLD,
+            metrics: Sequence[MetricSpec] = DEFAULT_METRICS) -> GateResult:
+    """Gate `new` against `baseline`.  Fails (ok=False) when the new run
+    is invalid or any gated metric regresses more than `threshold`
+    (fractional: 0.2 = a 20% drop in a higher-is-better metric)."""
+    new = unwrap(new)
+    baseline = unwrap(baseline)
+    res = GateResult(ok=True)
+
+    if _is_invalid(new):
+        res.new_invalid = True
+        res.ok = False
+        res.warnings.append(
+            "new run is invalid (calibration guardrails tripped: "
+            f"tenancy_health={new.get('tenancy_health')!r}) — re-run it; "
+            "an invalid run is never comparable")
+        return res
+    if _is_invalid(baseline):
+        res.baseline_invalid = True
+        res.warnings.append(
+            "baseline run is invalid — comparison skipped (pick an "
+            "earlier valid round as baseline)")
+        return res
+
+    for spec in metrics:
+        old_v = baseline.get(spec.key)
+        new_v = new.get(spec.key)
+        if not isinstance(old_v, (int, float)) or not isinstance(
+                new_v, (int, float)):
+            res.skipped.append(spec.key)
+            continue
+        if old_v == 0:
+            res.skipped.append(spec.key)
+            continue
+        if spec.higher_is_better:
+            change = (new_v - old_v) / old_v       # negative = regression
+            regressed = change < -threshold
+        else:
+            change = (new_v - old_v) / old_v       # positive = regression
+            regressed = change > threshold
+        entry = {
+            "metric": spec.key,
+            "baseline": old_v,
+            "new": new_v,
+            "change": round(change, 4),
+            "higher_is_better": spec.higher_is_better,
+        }
+        if regressed:
+            res.regressions.append(entry)
+        elif (spec.higher_is_better and change > threshold) or (
+                not spec.higher_is_better and change < -threshold):
+            res.improvements.append(entry)
+    if res.regressions:
+        res.ok = False
+    if new.get("tenancy_health") == "noisy":
+        res.warnings.append(
+            "new run is tenancy-noisy: regressions may be measurement "
+            "spread; re-run before acting on them")
+    return res
+
+
+def gate_files(new_path: str, baseline_path: str,
+               threshold: float = DEFAULT_THRESHOLD) -> GateResult:
+    return compare(load_bench_json(new_path),
+                   load_bench_json(baseline_path), threshold)

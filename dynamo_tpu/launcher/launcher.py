@@ -8,7 +8,10 @@ import os
 import signal
 import sys
 import time
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is API-identical
+    import tomli as tomllib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 

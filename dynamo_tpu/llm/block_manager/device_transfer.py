@@ -76,6 +76,18 @@ _process_server = None
 _uuid_counter = itertools.count(1)
 
 
+def transfer_available() -> bool:
+    """Whether this jax build ships the PJRT transfer service.  The
+    device-direct plane is an optimisation over the host-staged msgpack
+    path, which stays fully functional without it — callers use this to
+    fall back instead of crashing the worker on import."""
+    try:
+        from jax.experimental import transfer  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
 def _get_transfer_server():
     """ONE TransferServer per process: PJRT's local bulk transport
     CHECK-fails when two servers share a process, and one listener serves

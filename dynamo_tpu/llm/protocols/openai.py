@@ -140,6 +140,10 @@ class ChatCompletionRequest(SamplingFields):
 class ChatChoiceDelta(BaseModel):
     role: Optional[str] = None
     content: Optional[str] = None
+    # Streaming tool-call fragments (OpenAI spec): the first delta of a
+    # call carries index/id/type/function.name, later ones append to
+    # function.arguments.
+    tool_calls: Optional[List[Dict[str, Any]]] = None
 
 
 class ChatStreamChoice(BaseModel):

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from dynamo_tpu.engine import kv_cache as kvc
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.jax_compat import axis_size, shard_map
 from dynamo_tpu.ops.attention import paged_attention
 
 Params = Dict
@@ -175,7 +176,7 @@ def _attention_block(
         def body(qs, ks, vs, kc, vc, bts, pos_s, sls):
             b_loc, t_loc = qs.shape[0], qs.shape[1]
             s_local = kc.shape[0]
-            tp_sz = jax.lax.axis_size("tp")
+            tp_sz = axis_size("tp")
             flat = jax.lax.axis_index("dp") * tp_sz + jax.lax.axis_index("tp")
             offset = flat * s_local
             wslots = kvc.slots_for_positions(bts, pos_s, block_size)
@@ -196,7 +197,7 @@ def _attention_block(
             return o, kc, vc
 
         row = P(("dp", "tp"))
-        out, k_layer, v_layer = jax.shard_map(
+        out, k_layer, v_layer = shard_map(
             body,
             mesh=dp_local_mesh,
             in_specs=(P(("dp", "tp"), None, None, None),
@@ -234,7 +235,7 @@ def _attention_block(
         # all-gather the column-parallel q/k/v projections and every tp
         # shard would redo all heads' attention.
         spec4 = P("dp", "sp", "tp", None)
-        out = jax.shard_map(
+        out = shard_map(
             lambda qs, ks, vs, ps: ring_causal_attention(
                 qs, ks, vs, ps, axis_name="sp",
                 scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap),
@@ -256,7 +257,7 @@ def _attention_block(
             # GQA geometry), batch over dp.
             from jax.sharding import PartitionSpec as P
 
-            out = jax.shard_map(
+            out = shard_map(
                 lambda qs, ks, vs, bts, sls: paged_decode_attention(
                     qs, ks, vs, bts, sls, block_size=block_size,
                     scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap,
@@ -306,7 +307,7 @@ def _moe_block(cfg: ModelConfig, p: Params, x: jax.Array,
 
     from jax.sharding import PartitionSpec as P
 
-    wrapped = jax.shard_map(
+    wrapped = shard_map(
         lambda xs, ps: moe_ops.moe_dispatch(
             cfg, ps, xs, ep_axis="ep", load_psum_axes=("dp", "ep")),
         mesh=mesh,

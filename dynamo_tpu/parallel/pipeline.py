@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.engine import kv_cache as kvc
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.jax_compat import shard_map
 
 
 def stack_layer_params(params: Dict) -> Dict:
@@ -262,7 +263,7 @@ def make_pp_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         logits = jax.lax.psum(out, "pp").reshape(M * mb, cfg.vocab_size)
         return logits, {"k": k_cache, "v": v_cache}
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         body,
         mesh=mesh,
         in_specs=(pp_param_pspecs(cfg), pp_cache_pspecs(),

@@ -26,6 +26,7 @@ from dynamo_tpu.planner.core import LoadPlanner, PlannerConfig
 from dynamo_tpu.planner.connector import LocalConnector
 from dynamo_tpu.planner.predictor import (
     ConstantPredictor,
+    ARPredictor,
     MovingAveragePredictor,
     TrendPredictor,
     make_predictor,
@@ -42,6 +43,7 @@ __all__ = [
     "PlannerConfig",
     "LocalConnector",
     "ConstantPredictor",
+    "ARPredictor",
     "MovingAveragePredictor",
     "TrendPredictor",
     "make_predictor",

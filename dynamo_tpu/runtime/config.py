@@ -21,7 +21,10 @@ from __future__ import annotations
 
 import logging
 import os
-import tomllib
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: tomli is API-identical
+    import tomli as tomllib
 from typing import Any, Dict, Optional
 
 logger = logging.getLogger(__name__)

@@ -1,0 +1,26 @@
+"""Version shims for jax APIs used at their modern names.
+
+The codebase targets current jax (`jax.shard_map`, `check_vma=`); CI
+images sometimes pin an older release where the same machinery lives at
+`jax.experimental.shard_map.shard_map` with the `check_rep=` spelling.
+Import `shard_map` from here instead of `jax` so both work.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax < 0.6: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kw)
+
+try:
+    axis_size = jax.lax.axis_size
+except AttributeError:  # jax < 0.5: psum of a literal is the axis size
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)

@@ -137,10 +137,11 @@ class Histogram:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += self._counts[k][i]
-                out.append(
-                    f"{self.name}_bucket{_fmt_labels(k, f'le=\"{b}\"')} {cum}")
+                le = _fmt_labels(k, 'le="%s"' % b)
+                out.append(f"{self.name}_bucket{le} {cum}")
             cum += self._counts[k][-1]
-            out.append(f"{self.name}_bucket{_fmt_labels(k, 'le=\"+Inf\"')} {cum}")
+            le_inf = _fmt_labels(k, 'le="+Inf"')
+            out.append(f"{self.name}_bucket{le_inf} {cum}")
             out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]}")
             out.append(f"{self.name}_count{_fmt_labels(k)} {cum}")
         return out

@@ -323,15 +323,22 @@ async def run(args) -> None:
             # r4 next-5).  Multihost meshes stay host-staged (the plane
             # would need per-rank transfer servers).
             from dynamo_tpu.llm.block_manager.device_transfer import (
-                KV_OFFER_ENDPOINT, KV_PULLED_ENDPOINT, KvTransferPlane)
+                KV_OFFER_ENDPOINT, KV_PULLED_ENDPOINT, KvTransferPlane,
+                transfer_available)
 
-            transfer_plane = KvTransferPlane(transfer_engine)
-            taddr = transfer_plane.start()
-            runtime.rpc.register(KV_OFFER_ENDPOINT,
-                                 transfer_plane.make_offer_handler())
-            runtime.rpc.register(KV_PULLED_ENDPOINT,
-                                 transfer_plane.make_pulled_handler())
-            logger.info("device transfer plane on %s", taddr)
+            if transfer_available():
+                transfer_plane = KvTransferPlane(transfer_engine)
+                taddr = transfer_plane.start()
+                runtime.rpc.register(KV_OFFER_ENDPOINT,
+                                     transfer_plane.make_offer_handler())
+                runtime.rpc.register(KV_PULLED_ENDPOINT,
+                                     transfer_plane.make_pulled_handler())
+                logger.info("device transfer plane on %s", taddr)
+            else:
+                logger.warning(
+                    "jax.experimental.transfer not in this jax build; "
+                    "device-direct KV plane disabled (host-staged "
+                    "fallback stays active)")
 
     disagg_client = None
     prefill_task = None

@@ -1,0 +1,291 @@
+"""Workload-analysis suite: hasher ↔ tokens.py parity, prefix analyzer
+predictions vs the mocker's measured hit rate (the e2e the router bench
+rests on), sampler fit→resample→refit round-trip, and the CLI."""
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.data_generator.hasher import (  # noqa: E402
+    TraceHasher,
+    hash_token_trace,
+)
+from benchmarks.data_generator.prefix_analyzer import (  # noqa: E402
+    analyze_trace,
+)
+from benchmarks.data_generator.sampler import (  # noqa: E402
+    TraceSampler,
+    fit_and_resample,
+)
+from benchmarks.data_generator.synthesizer import (  # noqa: E402
+    TraceRecord,
+    load_trace,
+    synthesize_prefix_heavy,
+    tokens_for_record,
+)
+
+
+# ---------------------------------------------------------------------------
+# hasher
+
+
+def test_hasher_chain_parity_with_tokens_py():
+    """The hasher's block partition must be the serving stack's: same
+    chained hashes as TokenBlockSequence/compute_block_hashes, remapped
+    injectively to local ids."""
+    from dynamo_tpu.tokens import TokenBlockSequence, compute_block_hashes
+
+    block = 16
+    toks_a = list(range(1, 1 + 3 * block + 5))      # 3 full blocks + tail
+    toks_b = toks_a[: 2 * block] + [999] * block    # shares 2-block prefix
+
+    th = TraceHasher(block_size=block)
+    ids_a = th.hash_tokens(toks_a)
+    ids_b = th.hash_tokens(toks_b)
+
+    # Partition parity: one id per FULL block, the partial tail unhashed.
+    chain_a = compute_block_hashes(toks_a, block)
+    assert len(ids_a) == len(chain_a) == 3
+    seq = TokenBlockSequence(toks_a, block_size=block)
+    assert len(ids_a) == len(seq.block_hashes)
+
+    # Chain semantics: shared prefix → same ids; divergence → new ids,
+    # and ids are assigned first-seen dense (0, 1, 2, ...).
+    assert ids_a[:2] == ids_b[:2]
+    assert ids_a[2] != ids_b[2]
+    assert ids_a == [0, 1, 2] and ids_b == [0, 1, 3]
+
+    # Injectivity: same local id ⇔ same global chain hash.
+    chain_b = compute_block_hashes(toks_b, block)
+    assert chain_a[:2] == chain_b[:2] and chain_a[2] != chain_b[2]
+
+    # Divergence EARLIER in the stream changes every downstream id even
+    # when later blocks' tokens are identical (chained, not content hash).
+    toks_c = [7] + toks_a[1:]
+    ids_c = th.hash_tokens(toks_c)
+    assert ids_c[0] != ids_a[0] and ids_c[1] != ids_a[1]
+
+
+def test_hash_token_trace_records():
+    block = 8
+    shared = list(range(1, 1 + 2 * block))
+    entries = [
+        {"input_ids": shared + [41] * block, "output_length": 3},
+        {"input_ids": shared + [42] * block, "timestamp": 5.0},
+    ]
+    recs = hash_token_trace(entries, block_size=block)
+    assert recs[0].input_length == 3 * block
+    assert recs[0].output_length == 3 and recs[1].output_length == 1
+    assert recs[0].hash_ids[:2] == recs[1].hash_ids[:2]
+    assert recs[0].hash_ids[2] != recs[1].hash_ids[2]
+    assert recs[1].timestamp == 5.0
+
+
+# ---------------------------------------------------------------------------
+# prefix analyzer
+
+
+def test_analyzer_theoretical_and_bounded_rates():
+    block = 16
+    recs = synthesize_prefix_heavy(12, num_roots=2, context_blocks=4,
+                                   suffix_tokens=0, output_tokens=2,
+                                   block_size=block)
+    rep = analyze_trace(recs, block)
+    # 2 roots x 4 blocks unique; first visit of each root misses, the
+    # rest fully hit.
+    assert rep.unique_blocks == 8
+    assert rep.reused_tokens_infinite == (12 - 2) * 4 * block
+    assert rep.theoretical_hit_rate == pytest.approx(10 / 12)
+    d = rep.to_dict()
+    assert d["isl"]["mean"] == 4 * block
+    assert d["shared_prefix"]["num_roots"] == 2
+    assert d["shared_prefix"]["depth"]["p50"] == 4
+
+    # A bounded cache big enough for everything matches infinite...
+    full = analyze_trace(recs, block, cache_blocks=8)
+    assert full.bounded_hit_rate == pytest.approx(rep.theoretical_hit_rate)
+    assert full.bounded_evictions == 0
+    # ...and one that fits a single root thrashes when roots interleave.
+    tight = analyze_trace(recs, block, cache_blocks=4)
+    assert tight.bounded_hit_rate < rep.theoretical_hit_rate
+    assert tight.bounded_evictions > 0
+
+
+def test_analyzer_prediction_matches_mocker_measurement():
+    """The tentpole e2e: on a synthesized trace the analyzer's predicted
+    prefix-cache hit rate matches the mocker engine's MEASURED rate
+    within ±5 points (ISSUE 1 acceptance).  One engine, pool large
+    enough not to evict → the infinite-cache prediction is the right
+    comparand."""
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.llm.mocker.engine import MockEngine, MockEngineArgs
+    from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+
+    block = 32
+    records = synthesize_prefix_heavy(
+        30, num_roots=3, context_blocks=6, suffix_tokens=24,
+        output_tokens=4, interval_ms=1.0, block_size=block)
+    predicted = analyze_trace(records, block).theoretical_hit_rate
+    assert predicted > 0.5  # prefix-heavy by construction
+
+    async def replay() -> float:
+        eng = MockEngine(MockEngineArgs(
+            block_size=block, num_blocks=4096, speedup_ratio=1000.0))
+        input_tokens = 0
+        try:
+            for i, rec in enumerate(records):
+                toks = tokens_for_record(rec, block, unique_seed=i)
+                input_tokens += len(toks)
+                async for d in eng.generate(PreprocessedRequest(
+                        request_id=f"r{i}", model="m", token_ids=toks,
+                        sampling=SamplingParams(
+                            max_tokens=rec.output_length))):
+                    if d.finished:
+                        break
+            return eng.kv.hit_blocks * block / input_tokens
+        finally:
+            await eng.stop()
+
+    measured = asyncio.run(asyncio.wait_for(replay(), 120))
+    assert abs(measured - predicted) <= 0.05, (measured, predicted)
+
+
+# ---------------------------------------------------------------------------
+# sampler
+
+
+def test_sampler_roundtrip_fit_resample_refit():
+    """fit → resample → refit is (near) a fixed point: the resampled
+    trace's distributions match the source's."""
+    import random
+
+    rng = random.Random(3)
+    src = []
+    ts = 0.0
+    for i in range(300):
+        ts += rng.expovariate(1 / 50.0)             # ~50ms inter-arrival
+        src.append(TraceRecord(
+            timestamp=ts,
+            input_length=rng.choice([128, 256, 256, 512, 1024]),
+            output_length=rng.randint(1, 64),
+            hash_ids=[]))
+    fit1 = TraceSampler.fit(src)
+    out = fit1.sample(3000, seed=1)
+    fit2 = TraceSampler.fit(out)
+
+    for attr in ("isl", "osl", "interval_ms"):
+        d1, d2 = getattr(fit1, attr), getattr(fit2, attr)
+        assert d2.mean == pytest.approx(d1.mean, rel=0.1), attr
+        for q in (0.5, 0.9):
+            assert d2.quantile(q) == pytest.approx(
+                d1.quantile(q), rel=0.15, abs=2.0), (attr, q)
+
+    # Knobs: speedup compresses arrivals, multiplier scales prompts.
+    fast = fit1.sample(500, speedup_ratio=2.0, seed=2)
+    assert TraceSampler.fit(fast).interval_ms.mean == pytest.approx(
+        fit1.interval_ms.mean / 2.0, rel=0.2)
+    big = fit1.sample(500, prompt_len_multiplier=2.0, seed=2)
+    assert TraceSampler.fit(big).isl.mean == pytest.approx(
+        2.0 * fit1.isl.mean, rel=0.2)
+
+    # hash_unique mode: zero-reuse workload at the same lengths.
+    uniq = fit1.sample(50, seed=4, hash_unique=True)
+    rep = analyze_trace(uniq, fit1.block_size)
+    assert rep.theoretical_hit_rate == 0.0
+    assert fit_and_resample(src, 10)  # one-shot wrapper works
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_cli_synthesize_analyze_pipeline(tmp_path, capsys):
+    from benchmarks.data_generator.cli import main
+
+    trace = tmp_path / "t.jsonl"
+    rc = main(["synthesize", "--requests", "20", "--roots", "2",
+               "--context-blocks", "3", "--block-size", "16",
+               "--out", str(trace)])
+    assert rc == 0
+    recs = load_trace(str(trace))
+    assert len(recs) == 20
+
+    rc = main(["analyze", "--trace", str(trace), "--block-size", "16",
+               "--cache-blocks", "6"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["num_requests"] == 20
+    assert 0.0 < report["theoretical_hit_rate"] <= 1.0
+    assert report["bounded_cache"]["cache_blocks"] == 6
+
+    big = tmp_path / "big.jsonl"
+    rc = main(["sample", "--trace", str(trace), "--requests", "100",
+               "--block-size", "16", "--out", str(big)])
+    assert rc == 0
+    assert len(load_trace(str(big))) == 100
+
+    rc = main(["pipeline", "--requests", "20", "--roots", "2",
+               "--context-blocks", "3", "--block-size", "16"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["predicted_hit_rate"] == \
+        out["analysis"]["theoretical_hit_rate"]
+
+
+def test_cli_hash_roundtrip(tmp_path, capsys):
+    from benchmarks.data_generator.cli import main
+
+    block = 8
+    shared = list(range(1, 1 + 2 * block))
+    raw = tmp_path / "raw.jsonl"
+    with open(raw, "w") as f:
+        for tail in (41, 42):
+            f.write(json.dumps(
+                {"input_ids": shared + [tail] * block}) + "\n")
+    hashed = tmp_path / "hashed.jsonl"
+    rc = main(["hash", "--tokens", str(raw), "--block-size", str(block),
+               "--out", str(hashed)])
+    assert rc == 0
+    recs = load_trace(str(hashed))
+    assert recs[0].hash_ids[:2] == recs[1].hash_ids[:2]
+    assert recs[0].hash_ids[2] != recs[1].hash_ids[2]
+
+
+# ---------------------------------------------------------------------------
+# router bench wiring
+
+
+def test_router_bench_reports_predicted_hit_rate():
+    """router_bench output must carry the analyzer prediction next to
+    each mode's measured rate (measured - predicted per mode)."""
+    from benchmarks.router_bench import run
+
+    class Args:
+        trace = None
+        requests = 40
+        workers = 2
+        roots = 4
+        context_blocks = 6
+        suffix = 16
+        osl = 4
+        interval_ms = 1.0
+        trace_block = 32
+        speedup = 1000.0
+        engine_blocks = 768
+
+    result = asyncio.run(asyncio.wait_for(run(Args()), 300))
+    assert 0.0 < result["predicted_hit_rate"] <= 1.0
+    assert result["predicted_hit_rate_bounded"] is not None
+    for mode in ("rr", "kv"):
+        assert result[mode]["hit_rate_vs_predicted"] == pytest.approx(
+            result[mode]["cache_hit_rate"]
+            - result["predicted_hit_rate"], abs=1e-6)
+    # With pools big enough to hold everything, KV routing should land
+    # within a few points of the theoretical ceiling.
+    assert result["kv"]["cache_hit_rate"] >= \
+        result["predicted_hit_rate"] - 0.1

@@ -24,7 +24,12 @@ from dynamo_tpu.llm.block_manager.device_transfer import (
     KV_OFFER_ENDPOINT,
     KvTransferPlane,
     pull_prefix_device,
+    transfer_available,
 )
+
+pytestmark = pytest.mark.skipif(
+    not transfer_available(),
+    reason="jax.experimental.transfer not in this jax build")
 from dynamo_tpu.models import config as mcfg
 from dynamo_tpu.runtime.rpc import RpcClient, RpcServer
 from dynamo_tpu.tokens import compute_block_hashes

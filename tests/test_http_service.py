@@ -453,6 +453,88 @@ def test_unknown_tool_parser_rejected_before_generation():
     _run(main())
 
 
+def test_streaming_tool_choice_forced():
+    """Streamed tool calls (VERDICT r5 #8): with a pinned tool_choice the
+    SSE stream must carry OpenAI-spec `delta.tool_calls` fragments — a
+    header delta with index/id/type/function.name, then argument
+    fragments — and finish with finish_reason "tool_calls"."""
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/chat/completions", json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 4, "temperature": 0.0,
+                        "stream": True,
+                        "tools": [{"type": "function",
+                                   "function": {"name": "emit"}}],
+                        "tool_choice": {"type": "function",
+                                        "function": {"name": "emit"}}}) as r:
+                    assert r.status == 200
+                    chunks, done_seen = [], False
+                    async for raw in r.content:
+                        line = raw.decode().strip()
+                        if not line:
+                            continue
+                        if line == "data: [DONE]":
+                            done_seen = True
+                            break
+                        chunks.append(json.loads(line[5:]))
+                assert done_seen
+                tc = [c["choices"][0]["delta"]["tool_calls"][0]
+                      for c in chunks
+                      if c["choices"][0]["delta"].get("tool_calls")]
+                assert tc, "no tool_calls deltas in stream"
+                head = tc[0]
+                assert head["index"] == 0
+                assert head["id"].startswith("call_")
+                assert head["type"] == "function"
+                assert head["function"]["name"] == "emit"
+                assert head["function"]["arguments"] == ""
+                # Later fragments append arguments only (no name/id).
+                frags = [t for t in tc[1:] if "arguments"
+                         in t.get("function", {})]
+                assert frags, "no argument fragments streamed"
+                args = "".join(t["function"]["arguments"] for t in frags)
+                assert len(args) > 0
+                finish = [c["choices"][0]["finish_reason"]
+                          for c in chunks
+                          if c["choices"][0].get("finish_reason")]
+                assert finish[-1] == "tool_calls"
+                # No content deltas leak the arguments text.
+                content = "".join(
+                    c["choices"][0]["delta"].get("content") or ""
+                    for c in chunks)
+                assert content == ""
+
+                # Unary with the same pinned tool_choice: whole
+                # completion becomes that call's arguments.
+                async with s.post(f"{base}/v1/chat/completions", json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 4, "temperature": 0.0,
+                        "tools": [{"type": "function",
+                                   "function": {"name": "emit"}}],
+                        "tool_choice": {"type": "function",
+                                        "function": {"name": "emit"}}}) as r:
+                    assert r.status == 200
+                    data = await r.json()
+                choice = data["choices"][0]
+                assert choice["finish_reason"] == "tool_calls"
+                calls = choice["message"]["tool_calls"]
+                assert calls[0]["function"]["name"] == "emit"
+                assert calls[0]["function"]["arguments"] == args
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())
+
+
 def test_responses_route():
     import aiohttp
 

@@ -94,7 +94,11 @@ def test_validation():
 def test_pipeline_e2e_over_device_plane():
     """The full flow: processor parses image parts → encode worker stages
     embeddings on the device transfer plane → LLM engine generates."""
-    from dynamo_tpu.llm.block_manager.device_transfer import KvTransferPlane
+    from dynamo_tpu.llm.block_manager.device_transfer import (
+        KvTransferPlane, transfer_available)
+
+    if not transfer_available():
+        pytest.skip("jax.experimental.transfer not in this jax build")
     from dynamo_tpu.llm.service import LocalEngineClient
     from dynamo_tpu.llm.tokenizer import ByteTokenizer
     from dynamo_tpu.runtime.rpc import RpcClient, RpcServer

@@ -192,3 +192,46 @@ def test_planner_e2e_scales_mocker_fleet():
             await srv.stop()
 
     _run(main())
+
+
+def test_ar_predictor_beats_moving_average_on_diurnal_load():
+    """VERDICT r5 #9: the AR(p) rung must lead a periodic (diurnal) load
+    curve better than the moving average — MA predicts the recent mean
+    and is always half a swing late; AR extrapolates the oscillation."""
+    import math
+    import random
+
+    from dynamo_tpu.planner.predictor import (
+        ARPredictor,
+        MovingAveragePredictor,
+        make_predictor,
+    )
+
+    rng = random.Random(0)
+    period = 48
+    trace = [100 + 80 * math.sin(2 * math.pi * t / period)
+             + rng.gauss(0, 2) for t in range(400)]
+    ar = ARPredictor(order=8, window=128)
+    ma = MovingAveragePredictor(window=5)
+    se_ar = se_ma = 0.0
+    n = 0
+    for t, v in enumerate(trace):
+        if t >= 2 * period:          # both fully warmed up
+            se_ar += (ar.predict_next() - v) ** 2
+            se_ma += (ma.predict_next() - v) ** 2
+            n += 1
+        ar.add_data_point(v)
+        ma.add_data_point(v)
+    rmse_ar = math.sqrt(se_ar / n)
+    rmse_ma = math.sqrt(se_ma / n)
+    # Decisively better, not marginally (observed ~2.6 vs ~22).
+    assert rmse_ar < 0.5 * rmse_ma, (rmse_ar, rmse_ma)
+
+    # Cold-start fallback rungs: usable from the first observation.
+    cold = make_predictor("ar")
+    assert cold.predict_next() == 0.0
+    cold.add_data_point(7.0)
+    assert cold.predict_next() == 7.0
+    for v in (8.0, 9.0, 10.0):
+        cold.add_data_point(v)
+    assert cold.predict_next() >= 10.0  # trend rung sees the ramp

@@ -55,3 +55,90 @@ def test_explicit_format_and_unknown():
     assert len(calls) == 1
     with pytest.raises(ValueError, match="unknown tool-call format"):
         parse_tool_calls("x", fmt="nope")
+
+
+def test_streaming_parser_hermes_incremental():
+    """Calls emit the moment </tool_call> closes, mid-stream, with the
+    OpenAI delta shape: header (index/id/type/name) then arguments."""
+    from dynamo_tpu.llm.postprocessor import StreamingToolCallParser
+
+    p = StreamingToolCallParser("auto")
+    seen = []
+    content = ""
+    for chunk in ['thinking...\n<tool', '_call>{"name": "f",',
+                  ' "arguments": {"x": 1}}</tool_call>tail']:
+        c, deltas = p.push(chunk)
+        content += c
+        seen.extend(deltas)
+    assert seen, "deltas must emit before finish()"
+    assert seen[0]["index"] == 0
+    assert seen[0]["id"].startswith("call_")
+    assert seen[0]["function"] == {"name": "f", "arguments": ""}
+    assert json.loads(seen[1]["function"]["arguments"]) == {"x": 1}
+    c, deltas, has_calls = p.finish()
+    assert has_calls and not deltas
+    assert (content + c).startswith("thinking...")
+
+
+def test_streaming_parser_json_buffers_to_end():
+    from dynamo_tpu.llm.postprocessor import StreamingToolCallParser
+
+    p = StreamingToolCallParser("auto")
+    c1, d1 = p.push('{"name": "g", "argum')
+    c2, d2 = p.push('ents": {"y": 2}}')
+    assert (c1, d1, c2, d2) == ("", [], "", [])  # undecidable: buffered
+    content, deltas, has_calls = p.finish()
+    assert has_calls and content == ""
+    assert deltas[0]["function"]["name"] == "g"
+
+
+def test_streaming_parser_prose_passthrough_and_jail():
+    from dynamo_tpu.llm.postprocessor import StreamingToolCallParser
+
+    p = StreamingToolCallParser("auto")
+    assert p.push("hello ")[0] == "hello "
+    # A possible marker prefix is jailed until it diverges...
+    c1, _ = p.push("a <tool")
+    c2, _ = p.push("box>")
+    assert c1 + c2 == "a <toolbox>"
+    content, deltas, has_calls = p.finish()
+    assert not has_calls and not deltas and content == ""
+
+
+def test_streaming_parser_malformed_hermes_kept_as_content():
+    """Unary-parity on bad JSON: a closed <tool_call> block that fails to
+    parse must stream through as content, not vanish."""
+    from dynamo_tpu.llm.postprocessor import StreamingToolCallParser
+
+    p = StreamingToolCallParser("auto")
+    c1, d1 = p.push("before <tool_call>{bad json</tool_call> after")
+    c2, d2, has_calls = p.finish()
+    assert not d1 and not d2 and not has_calls
+    assert c1 + c2 == "before <tool_call>{bad json</tool_call> after"
+
+
+def test_streaming_parser_forced_tool_choice():
+    from dynamo_tpu.llm.postprocessor import StreamingToolCallParser
+
+    p = StreamingToolCallParser("auto", forced_name="get_weather")
+    _, d1 = p.push("Os")
+    _, d2 = p.push("lo")
+    assert d1[0]["function"]["name"] == "get_weather"
+    assert d1[1]["function"]["arguments"] == "Os"
+    assert d2[0]["function"]["arguments"] == "lo"
+    _, deltas, has_calls = p.finish()
+    assert has_calls and not deltas
+
+
+def test_forced_tool_name_rules():
+    from dynamo_tpu.llm.postprocessor import forced_tool_name
+
+    pinned = {"type": "function", "function": {"name": "f"}}
+    assert forced_tool_name(pinned, None) == "f"
+    assert forced_tool_name("required",
+                            [{"function": {"name": "only"}}]) == "only"
+    # Several tools + "required": the model still chooses.
+    assert forced_tool_name("required", [{"function": {"name": "a"}},
+                                         {"function": {"name": "b"}}]) is None
+    assert forced_tool_name("auto", [{"function": {"name": "x"}}]) is None
+    assert forced_tool_name(None, None) is None

@@ -15,6 +15,7 @@ from dynamo_tpu.models import config as mcfg
 from dynamo_tpu.models.llama import init_params, make_forward_step
 from dynamo_tpu.ops.attention import causal_attention
 from dynamo_tpu.ops.ring_attention import ring_causal_attention
+from dynamo_tpu.runtime.jax_compat import shard_map
 from dynamo_tpu.parallel import (
     MeshConfig,
     cache_pspecs,
@@ -55,7 +56,7 @@ def test_ring_sharded_matches_causal():
 
     mesh = make_mesh(MeshConfig(sp=8), jax.devices())
     spec4 = P(None, "sp", None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda qs, ks, vs, ps: ring_causal_attention(qs, ks, vs, ps,
                                                      axis_name="sp"),
         mesh=mesh,

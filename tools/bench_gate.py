@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Regression-gate entry point: BENCH JSON vs baseline, exit nonzero on
+regression.
+
+    # gate a fresh bench run against the previous round
+    python tools/bench_gate.py BENCH_new.json --baseline BENCH_r05.json
+
+    # default baseline: newest BENCH_r*.json in the repo root
+    python tools/bench_gate.py BENCH_new.json
+
+    # CPU-only smoke (tier-1): synthesize → analyze → mocker replay →
+    # gate, asserting the whole loop end to end
+    python tools/bench_gate.py --smoke
+
+Exit codes: 0 gate passed, 1 regression or invalid run, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.bench import gate  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_baseline(exclude: str = "") -> str:
+    """Newest BENCH_r*.json in the repo root (the previous round)."""
+    rounds = []
+    for p in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        if os.path.abspath(p) == os.path.abspath(exclude):
+            continue
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m:
+            rounds.append((int(m.group(1)), p))
+    if not rounds:
+        raise FileNotFoundError(
+            "no BENCH_r*.json baseline found; pass --baseline")
+    return max(rounds)[1]
+
+
+def run_gate(args) -> int:
+    baseline = args.baseline or default_baseline(exclude=args.new)
+    result = gate.gate_files(args.new, baseline, threshold=args.threshold)
+    out = result.to_dict()
+    out["baseline_path"] = baseline
+    print(json.dumps(out, indent=2))
+    return 0 if result.ok else 1
+
+
+def run_smoke(args) -> int:
+    """Mocker-backed smoke of the whole measurement loop — CPU-only, no
+    JAX device work, fast enough for tier-1.
+
+    1. synthesize a prefix-heavy trace;
+    2. analyze it (predicted hit rate);
+    3. replay against one MockEngine, compare measured vs predicted;
+    4. gate a fabricated regressed run and a fabricated invalid run —
+       both must FAIL the gate; an honest run must pass.
+    """
+    import asyncio
+
+    from benchmarks.data_generator.prefix_analyzer import analyze_trace
+    from benchmarks.data_generator.synthesizer import (
+        synthesize_prefix_heavy,
+        tokens_for_record,
+    )
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.llm.mocker.engine import MockEngine, MockEngineArgs
+    from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+
+    block = 32
+    records = synthesize_prefix_heavy(
+        40, num_roots=4, context_blocks=6, suffix_tokens=16,
+        output_tokens=4, interval_ms=1.0, block_size=block)
+    report = analyze_trace(records, block)
+    predicted = report.theoretical_hit_rate
+
+    async def replay() -> float:
+        eng = MockEngine(MockEngineArgs(
+            block_size=block, num_blocks=4096, speedup_ratio=1000.0))
+        hit_tokens = input_tokens = 0
+        try:
+            for i, rec in enumerate(records):
+                toks = tokens_for_record(rec, block, unique_seed=i)
+                input_tokens += len(toks)
+                async for d in eng.generate(PreprocessedRequest(
+                        request_id=f"s{i}", model="smoke", token_ids=toks,
+                        sampling=SamplingParams(
+                            max_tokens=rec.output_length))):
+                    if d.finished:
+                        break
+            hit_tokens = eng.kv.hit_blocks * block
+        finally:
+            await eng.stop()
+        return hit_tokens / input_tokens if input_tokens else 0.0
+
+    measured = asyncio.run(asyncio.wait_for(replay(), 120))
+    hit_delta = abs(measured - predicted)
+
+    good = {"value": 100.0, "serving_tok_s": 50.0, "prefill_tok_s": 200.0,
+            "itl_ms": 6.0, "calibration_ok": True, "tenancy_health": "ok"}
+    regressed = dict(good, serving_tok_s=50.0 * 0.7)       # 30% drop
+    invalid = dict(good, calibration_ok=False,
+                   tenancy_health="invalid", vs_baseline=None)
+
+    checks = {
+        "predicted_hit_rate": round(predicted, 4),
+        "measured_hit_rate": round(measured, 4),
+        "hit_rate_delta": round(hit_delta, 4),
+        "hit_rate_within_5pts": hit_delta <= 0.05,
+        "honest_run_passes": gate.compare(good, good).ok,
+        "regression_fails": not gate.compare(regressed, good).ok,
+        "invalid_run_fails": not gate.compare(invalid, good).ok,
+    }
+    ok = all(v is not False for v in checks.values())
+    print(json.dumps({"smoke": "pass" if ok else "fail", **checks},
+                     indent=2))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tools/bench_gate.py",
+                                description=__doc__.splitlines()[0])
+    p.add_argument("new", nargs="?", default=None,
+                   help="fresh bench JSON (bare output or BENCH_rNN form)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON (default: newest BENCH_r*.json)")
+    p.add_argument("--threshold", type=float,
+                   default=gate.DEFAULT_THRESHOLD,
+                   help="fractional regression that fails (default 0.2)")
+    p.add_argument("--smoke", action="store_true",
+                   help="CPU-only synthesize→analyze→mocker→gate smoke")
+    args = p.parse_args(argv)
+    if args.smoke:
+        return run_smoke(args)
+    if not args.new:
+        p.error("pass a bench JSON or --smoke")
+    return run_gate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -125,9 +125,9 @@ def main():
     kv_bytes = (BATCH * CTX * cfg.num_layers * cfg.num_kv_heads
                 * cfg.head_dim * 2 * 2)
 
-    bw = measure_hbm_bw()
+    bw = measure_hbm_bw().measured
     print(f"hbm_bw             {bw/1e9:8.1f} GB/s")
-    pk = calibrate_peak_flops()
+    pk = calibrate_peak_flops().measured
     print(f"peak_bf16          {pk/1e12:8.1f} TFLOP/s")
     print(f"weights            {w_bytes/1e9:8.2f} GB  -> floor "
           f"{w_bytes/bw*1e3:6.2f} ms")
