@@ -23,6 +23,7 @@ The TPU analog of the reference's decode profiling row
 ITL 4.83 ms, Llama-70B TP=4 on H100-class).
 """
 
+import functools
 import json
 import os
 import time
@@ -152,19 +153,33 @@ def _geometry(num_blocks):
 
 def bench_raw_step(cfg, params, use_pallas_decode):
     """Per-step device time of the single-step decode program, with
-    on-device greedy feedback (the program the engine's non-window path
-    dispatches), slope-measured."""
+    on-device greedy feedback, slope-measured.
+
+    The whole feedback iteration (forward + argmax + position advance)
+    is ONE jitted program with a donated cache — the engine's fused
+    greedy single step (`EngineCore._greedy_step_fn`).  r5 measured this
+    loop with the argmax/reshape/advance as separate eager dispatches
+    and read 11.2 ms/step against the window's 6.2: the 5 ms delta was
+    per-op dispatch overhead on the tunneled chip, not device work, and
+    it charged the single-step path for a program shape the engine no
+    longer issues."""
     num_blocks = 1 + BATCH * WIDTH
-    step = jax.jit(
-        make_forward_step(cfg, BLOCK, use_pallas_decode=use_pallas_decode),
-        donate_argnums=(1,))
+    fwd = make_forward_step(cfg, BLOCK, use_pallas_decode=use_pallas_decode)
     bt = _geometry(num_blocks)
     sp = jnp.zeros((BATCH,), jnp.int32)
 
+    # params rides as an ARGUMENT (not a closure constant): jit-captured
+    # weights become program constants XLA can specialize/duplicate,
+    # which would measure a differently-built executable than the
+    # engine's params-as-argument program.
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def one_fused(p, cache, toks, t):
+        logits, cache = fwd(p, cache, toks, t[:, None], t + 1, bt, sp)
+        return cache, jnp.argmax(logits, -1).astype(jnp.int32)[:, None], t + 1
+
     def one(state):
         cache, toks, t = state
-        logits, cache = step(params, cache, toks, t[:, None], t + 1, bt, sp)
-        return cache, jnp.argmax(logits, -1).astype(jnp.int32)[:, None], t + 1
+        return one_fused(params, cache, toks, t)
 
     def fresh():
         return (kvc.init_cache(kvc.KvCacheConfig.for_model(

@@ -46,6 +46,40 @@ DEFAULT_METRICS: Tuple[MetricSpec, ...] = (
 )
 
 
+@dataclass(frozen=True)
+class FloorSpec:
+    """Absolute minimum for a metric (dot-path into the BENCH doc).
+    Unlike the relative regression checks, floors hold even when the
+    baseline itself already regressed — the r5 failure mode was exactly
+    a bad number becoming next round's baseline."""
+
+    key: str
+    minimum: float
+
+
+# Enforced only on TPU runs (CPU bench output has neither a roofline nor
+# real interference numbers).  ISSUE 2 targets: MBU back above 0.75 and
+# the decode fleet keeping >= 80% of its throughput while prefills share
+# the chip.
+TPU_FLOORS: Tuple[FloorSpec, ...] = (
+    FloorSpec("mbu", 0.75),
+    FloorSpec("mixed_prefill_decode.interference_ratio", 0.80),
+)
+
+
+def _lookup(doc: Dict, dotted: str):
+    cur = doc
+    for part in dotted.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def is_tpu_run(doc: Dict) -> bool:
+    return "tpu" in str(doc.get("device", "")).lower()
+
+
 def load_bench_json(path: str) -> Dict:
     """Load a bench artifact, unwrapping the driver's BENCH_rNN wrapper
     (`{"n": ..., "parsed": {...}}`) down to the bare metric dict."""
@@ -70,6 +104,7 @@ class GateResult:
     ok: bool
     regressions: List[Dict] = field(default_factory=list)
     improvements: List[Dict] = field(default_factory=list)
+    floor_failures: List[Dict] = field(default_factory=list)
     skipped: List[str] = field(default_factory=list)
     warnings: List[str] = field(default_factory=list)
     new_invalid: bool = False
@@ -82,17 +117,37 @@ class GateResult:
             "baseline_invalid": self.baseline_invalid,
             "regressions": self.regressions,
             "improvements": self.improvements,
+            "floor_failures": self.floor_failures,
             "skipped": self.skipped,
             "warnings": self.warnings,
         }
 
 
+def _check_floors(new: Dict, res: GateResult,
+                  floors: Sequence[FloorSpec]) -> None:
+    """Absolute floors on the new run (TPU runs only): a metric below
+    its floor fails the gate regardless of what the baseline says."""
+    if not is_tpu_run(new):
+        return
+    for spec in floors:
+        v = _lookup(new, spec.key)
+        if not isinstance(v, (int, float)):
+            res.skipped.append(f"floor:{spec.key}")
+            continue
+        if v < spec.minimum:
+            res.floor_failures.append({
+                "metric": spec.key, "floor": spec.minimum, "new": v})
+            res.ok = False
+
+
 def compare(new: Dict, baseline: Dict,
             threshold: float = DEFAULT_THRESHOLD,
-            metrics: Sequence[MetricSpec] = DEFAULT_METRICS) -> GateResult:
+            metrics: Sequence[MetricSpec] = DEFAULT_METRICS,
+            floors: Sequence[FloorSpec] = TPU_FLOORS) -> GateResult:
     """Gate `new` against `baseline`.  Fails (ok=False) when the new run
-    is invalid or any gated metric regresses more than `threshold`
-    (fractional: 0.2 = a 20% drop in a higher-is-better metric)."""
+    is invalid, any gated metric regresses more than `threshold`
+    (fractional: 0.2 = a 20% drop in a higher-is-better metric), or a
+    TPU run sits below an absolute floor (MBU, interference_ratio)."""
     new = unwrap(new)
     baseline = unwrap(baseline)
     res = GateResult(ok=True)
@@ -105,6 +160,7 @@ def compare(new: Dict, baseline: Dict,
             f"tenancy_health={new.get('tenancy_health')!r}) — re-run it; "
             "an invalid run is never comparable")
         return res
+    _check_floors(new, res, floors)
     if _is_invalid(baseline):
         res.baseline_invalid = True
         res.warnings.append(

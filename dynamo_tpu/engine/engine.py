@@ -62,6 +62,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
 )
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import Params, init_params, make_forward_step
+from dynamo_tpu.runtime.metrics import EngineStepCounters
 from dynamo_tpu.tokens import TokenBlockSequence
 from dynamo_tpu.parallel.sharding import (
     cache_pspecs,
@@ -145,6 +146,14 @@ class EngineConfig:
     # Pipeline parallelism (mesh with pp > 1): GPipe microbatch count for
     # the stage-rotated step (parallel/pipeline.py).
     pp_microbatches: int = 2
+    # Mixed-mode prefill duty cycle: a bounded prefill chunk dispatches
+    # behind every Nth decode window (1 = every window).  Together with
+    # the scheduler's per-row chunk sizing this bounds decode-throughput
+    # loss under concurrent prefill to ~chunk_time / (N x window_time) —
+    # the interference_ratio knob (r5: 0.778 at duty 1 + 512-token
+    # chunks).  The cost is prefill ramp / TTFT under load, which is the
+    # Sarathi-style trade: ITL of in-flight streams is the SLA.
+    mixed_prefill_duty: int = 2
 
 
 class EngineCore:
@@ -254,6 +263,10 @@ class EngineCore:
                     f"must divide by dp*tp={self._n_local_shards}")
         self._pp = (self.mesh is not None
                     and self.mesh.shape.get("pp", 1) > 1)
+        # Raw (pre-jit) forward for the fused greedy single step
+        # (_greedy_step_fn); stays None on sharded/pp engines, whose
+        # steps come back already jitted.
+        self._fwd_raw: Optional[Callable] = None
         if self._mh and self._pp:
             raise ValueError("pipeline parallelism under a multi-process "
                              "mesh is not wired yet (multihost v1 covers "
@@ -306,11 +319,11 @@ class EngineCore:
                 self._sp_step = make_sp_prefill_step(
                     cfg, self.block_size, self.mesh)
         else:
-            self._step = jax.jit(
-                make_forward_step(cfg, self.block_size,
-                                  use_pallas_decode=pallas,
-                                  with_expert_load=self._moe),
-                donate_argnums=(1,))
+            fwd = make_forward_step(cfg, self.block_size,
+                                    use_pallas_decode=pallas,
+                                    with_expert_load=self._moe)
+            self._step = jax.jit(fwd, donate_argnums=(1,))
+            self._fwd_raw = fwd
             cache = kvc.init_cache(self.cache_cfg)
         # Cumulative per-expert assignment counts (MoE telemetry the
         # worker publishes; reference `base_handlers.py:40-62`).
@@ -319,6 +332,15 @@ class EngineCore:
         self._load_dev = None  # device-side accumulator (lazy sync)
         self._embed_step = None  # lazily compiled (embeddings route)
         self._mm_step = None     # lazily compiled (multimodal prefill)
+        # Fused greedy single step (forward + on-device argmax in ONE
+        # compiled program, donated cache) — the non-window decode path's
+        # steady shape.  Unsharded engines only (self._fwd_raw); lazily
+        # jitted on first all-greedy single-step decode.
+        self._greedy_fused: Optional[Callable] = None
+        # Constant per-bucket device arrays the decode path re-used to
+        # upload EVERY step (sample_positions is always zeros for T=1 —
+        # on a tunneled chip each small upload is a blocking RPC).
+        self._zeros_dev: Dict[int, object] = {}
         self._window_fns: Dict[bool, Callable] = {}
         self._window_state: Optional[Dict] = None  # device-resident rows
         self._inflight: List = []  # dispatched-unsynced decode windows
@@ -428,6 +450,13 @@ class EngineCore:
         self._event_id = 0
         self._rng = jax.random.key(config.seed + 1)
         self.step_count = 0
+        # Serving-loop overhead counters (runtime/metrics.py): host syncs
+        # and compiled-shape cache misses, with dispatch denominators —
+        # the observability the r5 single-step cliff lacked.
+        self.counters = EngineStepCounters()
+        # Mixed-mode duty state: windows dispatched since the last
+        # concurrent prefill chunk (see EngineConfig.mixed_prefill_duty).
+        self._windows_since_prefill = 0
         self.metrics = ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_total_slots=config.scheduler.max_seqs),
@@ -548,10 +577,17 @@ class EngineCore:
                 work = None
             else:
                 deltas.extend(d)
-                if plan.prefill:
+                self._windows_since_prefill += 1
+                if (plan.prefill and self._windows_since_prefill
+                        >= self.config.mixed_prefill_duty):
                     # Concurrent bounded prefill behind the window; first
                     # tokens fetch asynchronously (a blocking sample here
                     # would serialize every window behind a device sync).
+                    # Chunks ride only every `mixed_prefill_duty`-th
+                    # window — skipped chunks just replan next iteration
+                    # (requests stay PREFILL), bounding the decode-ITL
+                    # hit to chunk_time / (duty x window_time).
+                    self._windows_since_prefill = 0
                     deltas.extend(self._run_prefill_batch(
                         plan.prefill, async_first=not self._mh))
         if work is None and not plan.empty:
@@ -631,9 +667,11 @@ class EngineCore:
             return
         remaining = []
         for fut, reqs in self._pending_batches:
-            if not block and not fut.done():
-                remaining.append((fut, reqs))
-                continue
+            if not fut.done():
+                if not block:
+                    remaining.append((fut, reqs))
+                    continue
+                self.counters.host_syncs += 1  # engine thread stalls here
             toks, lps = fut.result()
             for j, req in enumerate(reqs):
                 self._pending_first.discard(req.request_id)
@@ -731,9 +769,11 @@ class EngineCore:
             bts[i, :n] = req.pages[:n]
 
         # sample_positions=None → logits at EVERY chunk position [B,T,V].
+        self.counters.note_dispatch("spec", bucket, T, width)
         logits, self.cache = self._run_step(
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(seq_lens), jnp.asarray(bts), None)
+        self.counters.host_syncs += 1
         argmax = np.asarray(jax.device_get(
             jnp.argmax(logits, axis=-1))).astype(np.int32)  # [bucket, T]
 
@@ -831,6 +871,7 @@ class EngineCore:
         if not self._moe:
             return None
         if self._load_dev is not None:
+            self.counters.host_syncs += 1
             self.expert_load += np.asarray(self._fetch_host(self._load_dev),
                                            dtype=np.int64)
             self._load_dev = None
@@ -860,6 +901,7 @@ class EngineCore:
         sync).  Until settled, the request sits in _pending_first and is
         excluded from decode work."""
         R, T, P = self._pad_rows(batch.rows), batch.chunk, batch.pages
+        self.counters.prefill_dispatches += 1
         tokens = np.zeros((R, T), np.int32)
         positions = np.full((R, T), self._pad_position, np.int32)
         seq_lens = np.zeros((R,), np.int32)
@@ -879,6 +921,10 @@ class EngineCore:
 
         mm_items = [w for w in batch.items
                     if w.request.prompt_embeds is not None]
+        # The sp / multimodal / plain branches are distinct compiled
+        # programs — the shape signature must not collide across them.
+        self.counters.note_dispatch(
+            "prefill", R, T, P, bool(mm_items), self._sp_eligible(batch))
         if self._sp_eligible(batch):
             # Served long-context path: whole-prompt prefill over the ICI
             # ring, T sharded over sp (VERDICT r3 next-4 — the ring was
@@ -999,13 +1045,40 @@ class EngineCore:
         if not live:
             return []
 
-        logits, self.cache = self._run_step(
-            self._dev(tokens), self._dev(positions),
-            self._dev(seq_lens), self._dev(bts),
-            self._dev(np.zeros((bucket,), np.int32)))
-
-        sampled, lps = self._sample_rows(self._select_rows(logits, rows),
-                                         live)
+        self.counters.single_step_dispatches += 1
+        zeros = self._zeros_dev.get(bucket)
+        if zeros is None:
+            zeros = self._zeros_dev[bucket] = self._dev(
+                np.zeros((bucket,), np.int32))
+        if (self._fwd_raw is not None and not self._mh
+                and all(r.sampling.temperature <= 0 for r in live)
+                and not any(r.sampling.logprobs for r in live)):
+            # Fused greedy single step: forward + argmax in ONE compiled
+            # program (donated cache), ONE host sync for [bucket] tokens.
+            # The unfused path is 3 dispatches (step, row gather, argmax)
+            # plus a [B, V] f32 logits output allocation per step — the
+            # r5 single-step cliff's engine-side half.
+            self.counters.note_dispatch("decode1g", bucket, work.pages)
+            res = self._greedy_step_fn()(
+                self.params, self.cache, self._dev(tokens),
+                self._dev(positions), self._dev(seq_lens), self._dev(bts),
+                zeros)
+            if self._moe:
+                toks_dev, self.cache, load = res
+                self._load_dev = (load if self._load_dev is None
+                                  else self._load_dev + load)
+            else:
+                toks_dev, self.cache = res
+            self.counters.host_syncs += 1
+            sampled = np.asarray(jax.device_get(toks_dev))[np.asarray(rows)]
+            lps = None
+        else:
+            self.counters.note_dispatch("decode1", bucket, work.pages)
+            logits, self.cache = self._run_step(
+                self._dev(tokens), self._dev(positions),
+                self._dev(seq_lens), self._dev(bts), zeros)
+            sampled, lps = self._sample_rows(
+                self._select_rows(logits, rows), live)
         deltas = []
         for i, req in enumerate(live):
             # Publish blocks sealed by *previous* tokens before appending:
@@ -1016,6 +1089,29 @@ class EngineCore:
                 req, int(sampled[i]),
                 float(lps[i]) if lps is not None else None))
         return deltas
+
+    def _greedy_step_fn(self):
+        """Lazily-jitted fused greedy single step (unsharded engines):
+        the forward and the argmax compile into one program, so the
+        non-window decode path costs one dispatch and returns [B] tokens
+        instead of [B, V] logits."""
+        if self._greedy_fused is None:
+            fwd = self._fwd_raw
+            moe = self._moe
+
+            def fused(params, cache, tokens, positions, seq_lens, bts,
+                      sample_pos):
+                out = fwd(params, cache, tokens, positions, seq_lens,
+                          bts, sample_pos)
+                if moe:
+                    logits, cache, load = out
+                    return (jnp.argmax(logits, -1).astype(jnp.int32),
+                            cache, load)
+                logits, cache = out
+                return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+            self._greedy_fused = jax.jit(fused, donate_argnums=(1,))
+        return self._greedy_fused
 
     # -- pipelined decode windows ------------------------------------------
 
@@ -1087,6 +1183,7 @@ class EngineCore:
             st = self._build_window_state(reqs, rows, bucket, width,
                                           shadows, lag, K, greedy_only,
                                           sig)
+            self.counters.h2d_uploads += 1
         pages_sig = tuple(len(r.pages) for r in reqs)
         if st["pages_sig"] != pages_sig:
             bts = np.zeros((bucket, width), np.int32)
@@ -1095,7 +1192,10 @@ class EngineCore:
                 bts[i, :n] = req.pages[:n]
             st["bts"] = self._dev_row2(bts)
             st["pages_sig"] = pages_sig
+            self.counters.h2d_uploads += 1
         self._window_state = st
+        self.counters.window_dispatches += 1
+        self.counters.note_dispatch("window", greedy_only, bucket, width)
 
         if lag:
             last_tokens = self._inflight[-1]["out"][K - 1]  # device, no sync
@@ -1197,6 +1297,8 @@ class EngineCore:
 
     def _sync_one_window(self) -> List[TokenDelta]:
         entry = self._inflight.pop(0)
+        self.counters.host_syncs += 1
+        self.counters.window_syncs += 1
         tokens = entry["fetch"].result()                   # [K, bucket]
         deltas: List[TokenDelta] = []
         for i in range(tokens.shape[0]):
@@ -1268,6 +1370,9 @@ class EngineCore:
         n = logits.shape[0]
         reqs = reqs[:n]
         want_lp = any(r.sampling.logprobs for r in reqs)
+        self.counters.note_dispatch(
+            "sample", n, all(r.sampling.temperature <= 0 for r in reqs),
+            want_lp)
 
         if all(r.sampling.temperature <= 0 for r in reqs):
             # Greedy fast path: no keys, no sort — a plain argmax (the
@@ -1306,6 +1411,7 @@ class EngineCore:
 
         if async_fetch:
             return self._fetch_pool.submit(fetch)
+        self.counters.host_syncs += 1
         return fetch()
 
     def _append_token(self, req: Request, token: int,

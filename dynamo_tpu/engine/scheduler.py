@@ -208,8 +208,18 @@ class SchedulerConfig:
     # bounding it trades prefill ramp for steady ITL.  The engine
     # dispatches the bounded chunk CONCURRENTLY with the decode window,
     # so decode throughput degrades by ~chunk_time/window_time, not by a
-    # full batch stall.
-    mixed_prefill_tokens: int = 512
+    # full batch stall.  (r5 measured interference_ratio 0.778 at 512;
+    # halving the cap plus the per-row slack sizing below and the
+    # engine's prefill duty cycle targets >= 0.85.)
+    mixed_prefill_tokens: int = 256
+    # Slack sizing: the mixed chunk additionally caps at
+    # `mixed_prefill_per_row x n_decoding` tokens (floored at
+    # `mixed_prefill_floor`), so chunk compute tracks the decode
+    # window's own cost — a window over few rows is fast, and a
+    # fixed-size chunk behind it would dominate the device's time
+    # exactly when the decode fleet is most latency-sensitive.
+    mixed_prefill_per_row: int = 4
+    mixed_prefill_floor: int = 64
     # dp-attention locality: slot → allocator shard (engine-installed;
     # None = shard-less allocation).  A request's pages then come from
     # the cache range local to its decode rows' tp shard.
@@ -421,8 +431,12 @@ class Scheduler:
             )
             budget -= len(decoding)
             # Interference bound: with streams decoding, prefill gets at
-            # most mixed_prefill_tokens this step (see SchedulerConfig).
-            budget = min(budget, self.config.mixed_prefill_tokens)
+            # most mixed_prefill_tokens this step, shrunk further to
+            # track the decode fleet's own step cost (see SchedulerConfig
+            # mixed_prefill_per_row).
+            slack = max(self.config.mixed_prefill_floor,
+                        self.config.mixed_prefill_per_row * len(decoding))
+            budget = min(budget, self.config.mixed_prefill_tokens, slack)
 
         items: List[PrefillWork] = []
         for req in self.running:

@@ -314,14 +314,27 @@ class ModelWatcher:
         await asyncio.wait_for(poll(), timeout)
 
     async def _loop(self) -> None:
-        async for ev in self._watch:
+        while True:
             try:
-                if ev.kind == "put" and ev.value:
-                    await self._on_put(ev.key, ev.value)
-                elif ev.kind == "delete":
-                    await self._on_delete(ev.key)
-            except Exception:
-                logger.exception("model watcher event failed: %s", ev.key)
+                async for ev in self._watch:
+                    try:
+                        if ev.kind == "put" and ev.value:
+                            await self._on_put(ev.key, ev.value)
+                        elif ev.kind == "delete":
+                            await self._on_delete(ev.key)
+                    except Exception:
+                        logger.exception("model watcher event failed: %s",
+                                         ev.key)
+                return
+            except ConnectionError:
+                # One poison per control-plane outage; the client's
+                # reconnect re-registers the watch and replays state
+                # into the same queue — resume consuming (stop()
+                # cancels this task at shutdown).  Unhandled, this was
+                # "Task exception was never retrieved" teardown noise.
+                logger.debug("model watcher: control plane connection "
+                             "lost; resuming on replay")
+                continue
 
     async def _on_put(self, key: str, entry: dict) -> None:
         card = ModelDeploymentCard.from_dict(entry["card"])

@@ -605,9 +605,14 @@ class HttpService:
                     for t, lp in lp_sink])
             choices.append(oai.ChatChoice(
                 index=i,
-                message=oai.ChatMessage(role="assistant",
-                                        content=text or None,
-                                        tool_calls=tool_calls),
+                # OpenAI wire shape: `content` is present (possibly "")
+                # unless the message is a tool call — `text or None`
+                # under exclude_none silently DROPPED the key whenever
+                # the detokenizer produced no text.
+                message=oai.ChatMessage(
+                    role="assistant",
+                    content=(text or None) if tool_calls else text,
+                    tool_calls=tool_calls),
                 finish_reason=reason,
                 logprobs=logprobs))
         resp = oai.ChatCompletionResponse(

@@ -43,9 +43,18 @@ class StubVisionEncoder:
     Stands in for a CLIP/SigLIP tower: embeddings are a seeded-normal
     function of the image reference, so distinct images produce distinct
     (reproducible) embeddings and tests can assert the embeddings
-    actually steer generation."""
+    actually steer generation.
 
-    def __init__(self, hidden_size: int, n_tokens: int = 16) -> None:
+    `n_tokens` defaults to 64 — real towers emit hundreds of tokens per
+    image (LLaVA's CLIP-L: 576), and the steering contract depends on
+    the image span carrying real attention mass: at 16 tokens ahead of
+    a ~107-token chat template, the tiny random test model's greedy
+    argmax was provably insensitive to the image (the embeddings reached
+    the engine and shifted logits by ~1, but never flipped the top
+    token), which is exactly how the multimodal HTTP e2e tests failed
+    from the seed onward."""
+
+    def __init__(self, hidden_size: int, n_tokens: int = 64) -> None:
         self.hidden_size = hidden_size
         self.n_tokens = n_tokens
 

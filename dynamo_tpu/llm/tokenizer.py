@@ -46,25 +46,54 @@ class DecodeStream:
         self._tok = tokenizer
         self._pending: List[int] = []
         self._emitted = ""  # text already flushed for the pending window
+        self._held = 0      # consecutive pushes held on a broken tail
+
+    def _past_prefix(self, text: str) -> str:
+        """Text beyond the already-emitted prefix.  When the tokenizer
+        re-merged the window so the flushed prefix changed, we cannot
+        retract flushed text; emit only the part past the longest
+        common prefix (minimises duplication)."""
+        if text.startswith(self._emitted):
+            return text[len(self._emitted):]
+        common = 0
+        for a, b in zip(self._emitted, text):
+            if a != b:
+                break
+            common += 1
+        return text[common:]
 
     def push(self, token_id: int) -> str:
         """Feed one token; returns newly-stable text (possibly "")."""
         self._pending.append(token_id)
         text = self._tok.decode(self._pending)
         if text.endswith(self.REPLACEMENT):
-            # Tail is an incomplete multi-byte sequence — hold everything
-            # after the already-emitted prefix.
-            return ""
+            # Tail may be an incomplete multi-byte sequence — hold
+            # everything after the already-emitted prefix.  But only
+            # while it could still complete: a UTF-8 char spans at most
+            # 4 bytes (4 byte-level tokens), so a tail still broken
+            # after 4 consecutive held pushes is invalid bytes, not an
+            # unfinished char.  An unconditional hold turned any
+            # gibberish burst into a stalled stream and an EMPTY final
+            # text (flush drops the held tail), which is how the
+            # multimodal e2e test got a contentless 200.
+            self._held += 1
+            if self._held < 4:
+                return ""
+            # Emit everything before the NEWEST token as U+FFFD; the
+            # newest token stays pending — it may be the first byte of
+            # a legitimate char that follows the garbage run (emitting
+            # it too would corrupt that char).
+            last = self._pending[-1]
+            out = self._past_prefix(self._tok.decode(self._pending[:-1]))
+            self._pending = [last]
+            self._emitted = ""
+            self._held = 1
+            return out
+        self._held = 0
         if not text.startswith(self._emitted):
-            # Tokenizer re-merged the window so the already-flushed prefix
-            # changed.  We cannot retract flushed text; emit only the part
-            # past the longest common prefix (minimises duplication).
-            common = 0
-            for a, b in zip(self._emitted, text):
-                if a != b:
-                    break
-                common += 1
-            out = text[common:]
+            # Tokenizer re-merged the window so the already-flushed
+            # prefix changed (see _past_prefix).
+            out = self._past_prefix(text)
             self._pending = []
             self._emitted = ""
             return out
@@ -78,11 +107,14 @@ class DecodeStream:
         return out
 
     def flush(self) -> str:
-        """Emit whatever is still held back (end of stream)."""
+        """Emit whatever is still held back (end of stream).  A held
+        INCOMPLETE tail (at most 3 tokens — longer broken tails already
+        burst out of push()) is dropped: the char never finished."""
         text = self._tok.decode(self._pending)
         out = text[len(self._emitted):] if text.startswith(self._emitted) else text
         self._pending = []
         self._emitted = ""
+        self._held = 0
         return out.replace(self.REPLACEMENT, "")
 
 

@@ -29,11 +29,19 @@ The padded matmuls do Hkv x the minimal attention FLOPs, but decode
 attention is HBM-bandwidth-bound, and bytes moved is what the kernel
 minimises; the MXU eats the extra zeros nearly for free at these sizes.
 
-Perf structure (v3):
+Perf structure (v4):
 - bf16 x bf16 MXU passes with f32 accumulation (f32 operands cost ~4x
   the passes for accuracy the f32 accumulator already provides);
-- `pair` pages per tile: one MXU pass over a 128-token tile costs barely
-  more than over a 64-token page (the F-contraction dominates);
+- `pair` pages per tile, AUTO-SIZED per (feature width, block_size): one
+  MXU pass over a 256-token tile costs barely more than over a 64-token
+  page (the F-contraction dominates), and fewer, larger DMA bursts sit
+  closer to the HBM streaming rate than many page-sized ones — so the
+  tile grows toward `_TARGET_TILE` tokens until the 3-slot double-buffer
+  scratch would crowd VMEM (`_SCRATCH_BUDGET`), then halves.  r5 ran a
+  fixed pair=2 (128-token tiles): at serving geometry (block 64,
+  ctx 512) that is 4 loop iterations per sequence where 2 suffice, and
+  per-iteration fixed costs (semaphore waits, control flow) were a
+  visible slice of the 0.70-MBU gap;
 - double-buffered tile DMA pipeline within a sequence, PLUS cross-program
   prefetch: a sequence's last-tile compute overlaps the first-tile fetch
   of the NEXT sequence (slot 2), so the 64 grid-program boundaries don't
@@ -49,6 +57,24 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# Auto `pair` sizing targets: tiles of ~256 tokens keep the MXU's
+# F-contraction efficiency while cutting per-tile fixed costs, bounded so
+# the K+V scratch (2 buffers x 3 slots x tile x F) leaves most of the
+# ~16 MB VMEM for the compiler's own staging.
+_TARGET_TILE = 256
+_SCRATCH_BUDGET = 4 * 1024 * 1024
+
+
+def auto_pair(block_size: int, feat: int, itemsize: int = 2) -> int:
+    """Pages per DMA tile for a (block_size, feature-width) geometry:
+    grow toward `_TARGET_TILE` tokens, halve while the two 3-slot
+    double-buffer scratch arrays would exceed `_SCRATCH_BUDGET`."""
+    pair = max(1, _TARGET_TILE // block_size)
+    while pair > 1 and (2 * 3 * pair * block_size * feat * itemsize
+                        > _SCRATCH_BUDGET):
+        pair //= 2
+    return pair
 
 
 def _decode_kernel(block_size: int, pair: int, n_kv: int,
@@ -191,7 +217,7 @@ def paged_decode_attention(
     scale: Optional[float] = None,
     soft_cap: Optional[float] = None,
     interpret: bool = False,
-    pair: int = 2,
+    pair: Optional[int] = None,
 ) -> jax.Array:
     """Decode-step attention over the paged cache; returns [B, Hq, D].
 
@@ -217,6 +243,12 @@ def paged_decode_attention(
             f"== 0; got F={Fc}, block_size={block_size} (use the XLA "
             "gather path for this geometry)")
     F = Hkv * D
+    if pair is None:
+        # Clamp to the table width: a tile wider than the whole table
+        # would only re-fetch the clamped last page.
+        pair = min(auto_pair(block_size, F,
+                             jnp.dtype(k_cache.dtype).itemsize),
+                   block_tables.shape[1])
     if scale is None:
         scale = D ** -0.5
 

@@ -38,6 +38,7 @@ class ControlPlaneServer:
         self.state = state or ControlPlaneState()
         self._server: Optional[asyncio.AbstractServer] = None
         self._connections: set = set()
+        self._handlers: set = set()   # live per-connection handler tasks
         self.port: Optional[int] = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -64,10 +65,21 @@ class ControlPlaneServer:
             for w in list(self._connections):
                 w.close()
             await self._server.wait_closed()
+        # Await the per-connection handler tasks: a handler still parked
+        # in readline() at loop close is a "Task was destroyed but it is
+        # pending!" warning in every test teardown that stops a server.
+        for t in list(self._handlers):
+            t.cancel()
+        if self._handlers:
+            await asyncio.gather(*self._handlers, return_exceptions=True)
+        self._handlers.clear()
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         self._connections.add(writer)
+        task = asyncio.current_task()
+        if task is not None:
+            self._handlers.add(task)
         watches: Dict[int, asyncio.Queue] = {}
         subs: Dict[int, tuple] = {}     # sid → (subject, queue)
         pumps: list = []
@@ -170,12 +182,23 @@ class ControlPlaneServer:
         finally:
             for t in pumps:
                 t.cancel()
+            # Await the cancellations: a cancelled-but-never-awaited pump
+            # is destroyed pending at loop close (the asyncio teardown
+            # warnings the HTTP-service tests leaked).
+            if pumps:
+                await asyncio.gather(*pumps, return_exceptions=True)
             for q in watches.values():
                 self.state.unwatch(q)
             for subj, q in subs.values():
                 self.state.unsubscribe(subj, q)
             self._connections.discard(writer)
+            if task is not None:
+                self._handlers.discard(task)
             writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass  # peer already gone / loop tearing down
 
 
 _POISON = object()  # sentinel pushed into stream queues on connection death

@@ -246,6 +246,23 @@ class Client:
                 pass
 
     async def _watch_loop(self) -> None:
+        while True:
+            try:
+                await self._watch_events()
+                return
+            except ConnectionError:
+                # One poison per control-plane outage.  The client's
+                # reconnect path re-registers the watch and replays
+                # current state as synthetic puts into this SAME queue,
+                # so the consumer must RESUME iterating, not exit —
+                # exiting froze discovery for the process lifetime.
+                # (At shutdown stop() cancels this task, which breaks
+                # the loop via CancelledError.)  Unhandled, the error
+                # also surfaced as "Task exception was never retrieved"
+                # noise at loop close in every distributed test.
+                continue
+
+    async def _watch_events(self) -> None:
         async for ev in self._watch:
             if ev.kind == "put" and ev.value:
                 inst = Instance.from_dict(ev.value)

@@ -147,6 +147,71 @@ class Histogram:
         return out
 
 
+class EngineStepCounters:
+    """Serving-loop overhead counters the engine increments in-line.
+
+    The r5→r6 diagnosis needed exactly these and had none: the serving
+    number halved and nothing could say whether the loss was host syncs,
+    recompiles, or scheduler churn.  Counted on the engine thread only
+    (no locking), cheap enough for the per-step hot path:
+
+    - `host_syncs` — blocking device→host reads the step loop performed
+      (window token fetches, single-step sample fetches, blocking
+      first-token settles).  Steady-state window decode must pay at most
+      ONE per window; anything above that is a pipeline bug.
+    - `xla_cache_misses` — first-seen (program, shape-signature) pairs
+      via `note_dispatch`.  jax's jit cache keys on exactly these, so a
+      nonzero delta after warmup means the engine is churning shapes
+      (bucket flapping) and recompiling.  It is a proxy: it counts what
+      WOULD miss jax's in-process cache, including hits served by the
+      persistent compilation cache on disk.
+    - dispatch tallies (`window_dispatches`, `single_step_dispatches`,
+      `prefill_dispatches`, `h2d_uploads`) — denominators for the two
+      above (syncs *per window*, uploads *per dispatch*).
+    """
+
+    def __init__(self) -> None:
+        self.host_syncs = 0
+        self.xla_cache_misses = 0
+        self.window_dispatches = 0
+        self.window_syncs = 0
+        self.single_step_dispatches = 0
+        self.prefill_dispatches = 0
+        self.h2d_uploads = 0
+        self._seen_shapes: set = set()
+
+    def note_dispatch(self, tag: str, *sig) -> None:
+        """Record a jitted-program dispatch; a first-seen (tag, sig)
+        counts as an XLA cache miss (a new shape compiles)."""
+        key = (tag,) + sig
+        if key not in self._seen_shapes:
+            self._seen_shapes.add(key)
+            self.xla_cache_misses += 1
+
+    def to_dict(self) -> Dict[str, int]:
+        return {
+            "host_syncs": self.host_syncs,
+            "xla_cache_misses": self.xla_cache_misses,
+            "window_dispatches": self.window_dispatches,
+            "window_syncs": self.window_syncs,
+            "single_step_dispatches": self.single_step_dispatches,
+            "prefill_dispatches": self.prefill_dispatches,
+            "h2d_uploads": self.h2d_uploads,
+        }
+
+    def snapshot(self) -> "EngineStepCounters":
+        """Point-in-time copy (delta assertions across a step range)."""
+        c = EngineStepCounters()
+        c.__dict__.update({k: v for k, v in self.__dict__.items()
+                           if k != "_seen_shapes"})
+        c._seen_shapes = set()
+        return c
+
+    def delta(self, since: "EngineStepCounters") -> Dict[str, int]:
+        now, then = self.to_dict(), since.to_dict()
+        return {k: now[k] - then[k] for k in now}
+
+
 class MetricsRegistry:
     """Named registry with hierarchical prefixes (reference
     `MetricsRegistry`, `lib/runtime/src/metrics.rs`)."""

@@ -80,6 +80,7 @@ class RpcServer:
         self._handlers: Dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._connections: set = set()
+        self._conn_tasks: set = set()  # live per-connection handler tasks
         self.host: Optional[str] = None
         self.port: Optional[int] = None
         self.active_streams = 0
@@ -112,12 +113,22 @@ class RpcServer:
             for w in list(self._connections):
                 w.close()
             await self._server.wait_closed()
+        # Await per-connection handler tasks so none is destroyed pending
+        # at loop close (asyncio teardown warnings in test fixtures).
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._conn_tasks:
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+        self._conn_tasks.clear()
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         tasks: Dict[int, asyncio.Task] = {}
         lock = asyncio.Lock()
         self._connections.add(writer)
+        me = asyncio.current_task()
+        if me is not None:
+            self._conn_tasks.add(me)
 
         async def run_stream(sid: int, ep: str, payload: dict) -> None:
             self.active_streams += 1
@@ -166,8 +177,17 @@ class RpcServer:
         finally:
             for task in tasks.values():
                 task.cancel()
+            if tasks:
+                await asyncio.gather(*tasks.values(),
+                                     return_exceptions=True)
             self._connections.discard(writer)
+            if me is not None:
+                self._conn_tasks.discard(me)
             writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass  # peer already gone / loop tearing down
 
 
 class RpcClient:

@@ -399,6 +399,14 @@ async def run(args) -> None:
                 for e, n in enumerate(m.expert_load):
                     lines.append(
                         f'dynamo_worker_expert_load{{expert="{e}"}} {n}')
+            # Serving-loop overhead counters (EngineStepCounters) —
+            # host syncs / compiled-shape cache misses per dispatch
+            # class; mocker-backed workers have no core and skip this.
+            core = getattr(getattr(engine, "_engine", None), "core", None)
+            counters = getattr(core, "counters", None)
+            if counters is not None:
+                for k, v in counters.to_dict().items():
+                    lines.append(f"dynamo_worker_engine_{k} {v}")
             return "\n".join(lines) + "\n"
 
         status = StatusServer(extra_text_fn=worker_metrics_text)

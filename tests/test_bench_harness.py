@@ -172,9 +172,11 @@ def test_gate_unwraps_bench_round_files():
     assert r05["metric"].startswith("decode_throughput")
 
 
+@pytest.mark.slow
 def test_bench_gate_smoke_cli():
-    """tier-1 entry point: CPU-only synthesize → analyze → mocker replay
-    → gate, in a subprocess exactly as CI invokes it."""
+    """CPU-only synthesize → analyze → mocker replay → gate, in a
+    subprocess exactly as CI invokes it (slow: spawns a process and
+    replays a 40-request trace)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "bench_gate.py"),
@@ -186,6 +188,29 @@ def test_bench_gate_smoke_cli():
     assert out["hit_rate_within_5pts"] is True
     assert out["regression_fails"] is True
     assert out["invalid_run_fails"] is True
+    assert out["low_mbu_fails"] is True
+    assert out["interference_fails"] is True
+
+
+def test_gate_tpu_floors():
+    """Absolute floors (MBU, interference) fail a TPU run even when its
+    baseline already regressed there — and never apply off-TPU."""
+    tpu = dict(GOOD, device="TPU v5 lite0", mbu=0.82,
+               mixed_prefill_decode={"interference_ratio": 0.88})
+    assert gate.compare(tpu, tpu).ok
+
+    low = dict(tpu, mbu=0.6)
+    res = gate.compare(low, low)  # baseline equally low: floors still fail
+    assert not res.ok
+    assert res.floor_failures and res.floor_failures[0]["metric"] == "mbu"
+
+    interfered = dict(tpu, mixed_prefill_decode={"interference_ratio": 0.7})
+    res = gate.compare(interfered, tpu)
+    assert not res.ok and res.floor_failures
+
+    # CPU artifacts carry no roofline: floors are skipped, not failed.
+    cpu = dict(GOOD, device="TFRT_CPU_0", mbu=0.01)
+    assert gate.compare(cpu, cpu).ok
 
 
 def test_bench_gate_cli_compares_files(tmp_path):

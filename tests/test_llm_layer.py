@@ -50,6 +50,26 @@ def test_decode_stream_flush():
     assert stream.flush() == ""
 
 
+def test_decode_stream_bounded_hold_on_invalid_bytes():
+    """Invalid (non-UTF-8) bytes must burst out as U+FFFD after the
+    4-byte hold window instead of stalling the stream to an empty flush
+    (a pure-gibberish generation used to decode to NO text at all)."""
+    stream = DecodeStream(TOK)
+    outs = [stream.push(0xFF) for _ in range(6)]
+    assert "�" in "".join(outs)
+    assert stream.flush() == ""  # trailing incomplete tail still dropped
+
+
+def test_decode_stream_valid_char_after_garbage_survives():
+    """The burst keeps the newest token pending: a legitimate multi-byte
+    char that starts right after a garbage run must decode intact."""
+    stream = DecodeStream(TOK)
+    data = [0xFF, 0xFF, 0xFF] + list("中".encode("utf-8"))
+    text = "".join(stream.push(b) for b in data) + stream.flush()
+    assert text.endswith("中")
+    assert "�" in text  # the garbage run is represented, not dropped
+
+
 # -- preprocessor ------------------------------------------------------------
 
 

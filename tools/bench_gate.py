@@ -110,6 +110,13 @@ def run_smoke(args) -> int:
     regressed = dict(good, serving_tok_s=50.0 * 0.7)       # 30% drop
     invalid = dict(good, calibration_ok=False,
                    tenancy_health="invalid", vs_baseline=None)
+    # Absolute TPU floors: a run below the MBU / interference floor fails
+    # even against a baseline that already regressed there.
+    tpu_good = dict(good, device="TPU v5 lite0", mbu=0.82,
+                    mixed_prefill_decode={"interference_ratio": 0.88})
+    tpu_low_mbu = dict(tpu_good, mbu=0.60)
+    tpu_interfered = dict(
+        tpu_good, mixed_prefill_decode={"interference_ratio": 0.70})
 
     checks = {
         "predicted_hit_rate": round(predicted, 4),
@@ -119,6 +126,10 @@ def run_smoke(args) -> int:
         "honest_run_passes": gate.compare(good, good).ok,
         "regression_fails": not gate.compare(regressed, good).ok,
         "invalid_run_fails": not gate.compare(invalid, good).ok,
+        "tpu_floors_pass": gate.compare(tpu_good, tpu_good).ok,
+        "low_mbu_fails": not gate.compare(tpu_low_mbu, tpu_low_mbu).ok,
+        "interference_fails": not gate.compare(tpu_interfered,
+                                               tpu_interfered).ok,
     }
     ok = all(v is not False for v in checks.values())
     print(json.dumps({"smoke": "pass" if ok else "fail", **checks},

@@ -1,25 +1,47 @@
-"""Decode-step component profiler (round-4 perf work, VERDICT item 1).
+"""Decode-step component profiler with a per-phase breakdown.
 
-Isolates where the window step's time goes, all slope-timed with forced
-completion (the axon backend returns from block_until_ready early):
+Round-4 built the first version (isolated window/kernel slopes); round 6
+extends it into the serving-path diagnosis tool the r5 regression lacked:
+one JSON artifact that splits a decode step into
 
-  - hbm_bw: achievable HBM read bandwidth (big-array reduction)
-  - peak_flops: dependent-chain bf16 matmul ceiling
-  - weights_only: model forward with ctx=1 (attention reads ~nothing;
-    cost = weight streaming + elementwise + lm_head)
-  - attn_kernel: the Pallas paged-decode kernel alone x num_layers
-  - attn_xla: the gather-path attention alone x num_layers
-  - window_pallas / window_xla: full fused window per-token
-  - sampling: argmax over [B, V] logits alone
+  - kernel        — the Pallas paged-decode kernel alone x num_layers
+  - weights       — window at ctx=1 (attention reads ~nothing; cost =
+                    weight streaming + elementwise + lm_head)
+  - non_attention — window minus kernel (RoPE/norm/MLP/lm_head/sampling
+                    inside the fused program, plus loop fixed costs)
+  - sampling      — argmax over [B, V] logits alone
+  - host_sync     — blocking device→host fetch of one window's [K, B]
+                    token block (what _sync_one_window pays per window)
+  - scheduler     — host-side Scheduler.plan() cost per step at this
+                    batch (pure CPU; the engine pays it every iteration)
+
+All device timings are slope-timed with forced completion (the axon
+backend returns from block_until_ready early).  Runs on CPU with a tiny
+model for tests (`--model tiny-test --no-probes --json`); on TPU the
+default geometry matches bench.py's serving shape (b64/ctx512).
 """
 
+import argparse
+import json
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.engine import kv_cache as kvc
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import (
+    BlockAllocator,
+    Request,
+    RequestState,
+    Scheduler,
+    SchedulerConfig,
+)
 from dynamo_tpu.models import config as mcfg
 from dynamo_tpu.models.llama import init_params, make_decode_window
 from dynamo_tpu.ops.pallas import paged_decode_attention
@@ -28,6 +50,7 @@ BATCH = 64
 CTX = 512
 BLOCK = 64
 WIDTH = 16
+WINDOW = 8
 
 
 def _sync(x):
@@ -42,39 +65,41 @@ def slope(fn, n1=3, n2=9):
     return max((t2 - t1) / (n2 - n1), 1e-9)
 
 
-# Peak/bandwidth probes live in bench.py (ONE methodology — VERDICT r3
-# weak #2); import rather than fork them.
-from bench import calibrate_peak_flops, measure_hbm_bw  # noqa: E402
+def _block_tables(batch, width):
+    bt = np.zeros((batch, width), np.int32)
+    for i in range(batch):
+        bt[i] = np.arange(1 + i * width, 1 + (i + 1) * width)
+    return jnp.asarray(bt)
 
 
-def _window_time(cfg, params, use_pallas, window=8, ctx=CTX):
-    num_blocks = 1 + BATCH * WIDTH
+def window_time(cfg, params, use_pallas, *, batch=BATCH, ctx=CTX,
+                block=BLOCK, width=WIDTH, window=WINDOW):
+    """Per-token device time inside the fused K-step decode window."""
+    num_blocks = 1 + batch * width
     win = jax.jit(
-        make_decode_window(cfg, BLOCK, window, use_pallas_decode=use_pallas,
+        make_decode_window(cfg, block, window, use_pallas_decode=use_pallas,
                            greedy_only=True),
         donate_argnums=(1,))
-    bt = np.zeros((BATCH, WIDTH), np.int32)
-    for i in range(BATCH):
-        bt[i] = np.arange(1 + i * WIDTH, 1 + (i + 1) * WIDTH)
-    bt = jnp.asarray(bt)
-    z = jnp.zeros((BATCH,), jnp.float32)
-    zi = jnp.zeros((BATCH,), jnp.int32)
-    ones = jnp.ones((BATCH,), jnp.float32)
-    keys = jax.random.split(jax.random.key(0), BATCH)
+    bt = _block_tables(batch, width)
+    z = jnp.zeros((batch,), jnp.float32)
+    zi = jnp.zeros((batch,), jnp.int32)
+    ones = jnp.ones((batch,), jnp.float32)
+    keys = jnp.zeros((batch, 2), jnp.uint32)
 
     def fresh():
         return (kvc.init_cache(kvc.KvCacheConfig.for_model(
-                    cfg, num_blocks=num_blocks, block_size=BLOCK)),
-                jnp.ones((BATCH,), jnp.int32))
+                    cfg, num_blocks=num_blocks, block_size=block)),
+                jnp.ones((batch,), jnp.int32))
 
     def run(n):
         cache, last = fresh()
         t0 = time.perf_counter()
         for _ in range(n):
-            cache, out, _, _, _ = win(params, cache, last,
-                                      jnp.full((BATCH,), ctx, jnp.int32),
-                                      jnp.full((BATCH,), ctx + 1, jnp.int32),
-                                      bt, z, zi, ones, keys, zi)
+            cache, out, _, _, _ = win(
+                params, cache, last,
+                jnp.full((batch,), ctx, jnp.int32),
+                jnp.full((batch,), ctx + 1, jnp.int32),
+                bt, z, zi, ones, keys, zi)
             last = out[window - 1]
         _sync(last)
         return time.perf_counter() - t0
@@ -83,26 +108,28 @@ def _window_time(cfg, params, use_pallas, window=8, ctx=CTX):
     return per / window
 
 
-def bench_attn_kernel(cfg, ctx=CTX, layers=None):
+def kernel_time(cfg, *, batch=BATCH, ctx=CTX, block=BLOCK, width=WIDTH,
+                layers=None, interpret=None):
     """Pallas paged-decode kernel alone, chained x num_layers per 'step'."""
     L = layers or cfg.num_layers
-    S = (1 + BATCH * WIDTH) * BLOCK
-    k_cache = jnp.ones((S, cfg.num_kv_heads * cfg.head_dim), jnp.bfloat16)
-    v_cache = jnp.ones((S, cfg.num_kv_heads * cfg.head_dim), jnp.bfloat16)
-    bt = np.zeros((BATCH, WIDTH), np.int32)
-    for i in range(BATCH):
-        bt[i] = np.arange(1 + i * WIDTH, 1 + (i + 1) * WIDTH)
-    bt = jnp.asarray(bt)
-    sl = jnp.full((BATCH,), ctx, jnp.int32)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    S = (1 + batch * width) * block
+    F = cfg.num_kv_heads * cfg.head_dim
+    k_cache = jnp.ones((S, F), jnp.bfloat16)
+    v_cache = jnp.ones((S, F), jnp.bfloat16)
+    bt = _block_tables(batch, width)
+    sl = jnp.full((batch,), ctx, jnp.int32)
 
     @jax.jit
     def step(q):
         for _ in range(L):
             q = paged_decode_attention(q, k_cache, v_cache, bt, sl,
-                                       block_size=BLOCK)
+                                       block_size=block,
+                                       interpret=interpret)
         return q
 
-    q0 = jnp.ones((BATCH, cfg.num_heads, cfg.head_dim), jnp.bfloat16)
+    q0 = jnp.ones((batch, cfg.num_heads, cfg.head_dim), jnp.bfloat16)
 
     def run(n):
         q = q0
@@ -115,38 +142,169 @@ def bench_attn_kernel(cfg, ctx=CTX, layers=None):
     return slope(run)
 
 
-def main():
+def sampling_time(cfg, *, batch=BATCH):
+    """Greedy sampling alone: argmax over [B, V] f32 logits."""
+    logits = jnp.ones((batch, cfg.vocab_size), jnp.float32)
+
+    @jax.jit
+    def step(x, i):
+        return jnp.argmax(x + i[None, :].astype(jnp.float32), -1)
+
+    def run(n):
+        i = jnp.zeros((cfg.vocab_size,), jnp.int32)
+        out = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = step(logits, i)
+            i = i.at[0].set(out[0].astype(jnp.int32))  # dependency chain
+        _sync(out)
+        return time.perf_counter() - t0
+
+    return slope(run)
+
+
+def host_sync_time(*, batch=BATCH, window=WINDOW, reps=5):
+    """Blocking device→host fetch of one window's [K, B] token block —
+    the cost _sync_one_window pays when the pipeline can't hide it.
+    Fixed cost (median of reps), NOT slope-timed: the round-trip itself
+    is the number."""
+    x = jnp.ones((window, batch), jnp.int32)
+    _sync(x)
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(x))
+        samples.append(time.perf_counter() - t0)
+    return sorted(samples)[len(samples) // 2]
+
+
+def scheduler_time(*, batch=BATCH, ctx=CTX, block=BLOCK, iters=200):
+    """Host-side Scheduler.plan() per step with `batch` sequences in
+    steady decode — pure CPU, the engine pays it every iteration."""
+    pages_per = (ctx + block - 1) // block + 1
+    alloc = BlockAllocator(1 + batch * pages_per)
+    sched = Scheduler(SchedulerConfig(
+        max_seqs=max(batch, 64), block_size=block,
+        max_pages_per_seq=pages_per + 1), alloc)
+    for i in range(batch):
+        req = Request(request_id=f"r{i}", prompt_tokens=list(range(ctx)),
+                      sampling=SamplingParams(max_tokens=64))
+        sched.add_request(req)
+    sched.plan()  # admit
+    for req in sched.running:
+        req.prefilled = len(req.prompt_tokens)
+        req.state = RequestState.DECODE
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sched.plan()
+    return (time.perf_counter() - t0) / iters
+
+
+def phase_breakdown(cfg, params, *, batch=BATCH, ctx=CTX, block=BLOCK,
+                    width=WIDTH, window=WINDOW, use_pallas=None,
+                    with_kernel=True):
+    """The per-phase decode-step split, all values in ms.
+
+    `non_attention` is derived (window - kernel) and only meaningful
+    when both run on the real device; on CPU the kernel runs in
+    interpret mode and the subtraction is reported as None."""
+    on_tpu = jax.default_backend() == "tpu"
+    if use_pallas is None:
+        use_pallas = on_tpu
+    win_ms = window_time(cfg, params, use_pallas, batch=batch, ctx=ctx,
+                         block=block, width=width, window=window) * 1e3
+    weights_ms = window_time(cfg, params, use_pallas, batch=batch, ctx=1,
+                             block=block, width=width,
+                             window=window) * 1e3
+    # 6 decimals: tiny-model CPU smokes can slope-clamp to 1e-6 ms under
+    # machine load, and 4-decimal rounding flattened that to a 0.0 that
+    # reads as "not measured".
+    phases = {
+        "window_ms_per_tok": round(win_ms, 6),
+        "weights_ms": round(weights_ms, 6),
+        "sampling_ms": round(sampling_time(cfg, batch=batch) * 1e3, 6),
+        "host_sync_ms": round(
+            host_sync_time(batch=batch, window=window) * 1e3, 6),
+        "scheduler_ms": round(
+            scheduler_time(batch=batch, ctx=ctx, block=block) * 1e3, 6),
+        "kernel_ms": None,
+        "non_attention_ms": None,
+    }
+    if with_kernel:
+        k_ms = kernel_time(cfg, batch=batch, ctx=ctx, block=block,
+                           width=width) * 1e3
+        phases["kernel_ms"] = round(k_ms, 6)
+        # Interpret-mode kernel times are not comparable to compiled
+        # window times — the subtraction only means something on TPU.
+        if on_tpu:
+            phases["non_attention_ms"] = round(win_ms - k_ms, 4)
+    return phases
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("tools/profile_decode.py")
+    p.add_argument("--model", default="llama-3-1b")
+    p.add_argument("--batch", type=int, default=BATCH)
+    p.add_argument("--ctx", type=int, default=CTX)
+    p.add_argument("--block", type=int, default=BLOCK)
+    p.add_argument("--width", type=int, default=WIDTH)
+    p.add_argument("--window", type=int, default=WINDOW)
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object instead of the text report")
+    p.add_argument("--no-probes", action="store_true",
+                   help="skip the HBM-bandwidth / peak-FLOPs probes "
+                        "(slow; pointless off-TPU)")
+    p.add_argument("--no-kernel", action="store_true",
+                   help="skip the Pallas kernel phase (interpret mode "
+                        "is slow on CPU at real geometries)")
+    args = p.parse_args(argv)
+
     jax.config.update("jax_compilation_cache_dir", "/tmp/dynamo_tpu_xla_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    cfg = mcfg.get_config("llama-3-1b")
+    cfg = mcfg.get_config(args.model)
     params = init_params(cfg, jax.random.key(0))
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
     w_bytes = n_params * 2
-    kv_bytes = (BATCH * CTX * cfg.num_layers * cfg.num_kv_heads
+    kv_bytes = (args.batch * args.ctx * cfg.num_layers * cfg.num_kv_heads
                 * cfg.head_dim * 2 * 2)
 
-    bw = measure_hbm_bw().measured
-    print(f"hbm_bw             {bw/1e9:8.1f} GB/s")
-    pk = calibrate_peak_flops().measured
-    print(f"peak_bf16          {pk/1e12:8.1f} TFLOP/s")
-    print(f"weights            {w_bytes/1e9:8.2f} GB  -> floor "
-          f"{w_bytes/bw*1e3:6.2f} ms")
-    print(f"kv traffic         {kv_bytes/1e9:8.2f} GB  -> floor "
-          f"{kv_bytes/bw*1e3:6.2f} ms")
+    out = {
+        "model": args.model,
+        "batch": args.batch,
+        "ctx": args.ctx,
+        "window": args.window,
+        "device": str(jax.devices()[0]),
+        "weight_bytes": w_bytes,
+        "kv_bytes_per_step": kv_bytes,
+    }
+    if not args.no_probes:
+        # Peak/bandwidth probes live in bench.py (ONE methodology —
+        # VERDICT r3 weak #2); import rather than fork them.
+        from bench import calibrate_peak_flops, measure_hbm_bw
 
-    t = bench_attn_kernel(cfg)
-    print(f"attn_kernel x{cfg.num_layers}    {t*1e3:8.2f} ms/step "
-          f"(floor {kv_bytes/bw*1e3:.2f})")
+        bw = measure_hbm_bw().measured
+        pk = calibrate_peak_flops().measured
+        out["hbm_bw_gbs"] = round(bw / 1e9, 1)
+        out["peak_bf16_tflops"] = round(pk / 1e12, 1)
+        out["weights_floor_ms"] = round(w_bytes / bw * 1e3, 4)
+        out["kv_floor_ms"] = round(kv_bytes / bw * 1e3, 4)
+        out["roofline_ms"] = round((w_bytes + kv_bytes) / bw * 1e3, 4)
+    out["phases"] = phase_breakdown(
+        cfg, params, batch=args.batch, ctx=args.ctx, block=args.block,
+        width=args.width, window=args.window,
+        with_kernel=not args.no_kernel)
 
-    t = _window_time(cfg, params, use_pallas=True, ctx=1)
-    print(f"window ctx=1 pallas{t*1e3:8.2f} ms/tok (weights floor "
-          f"{w_bytes/bw*1e3:.2f})")
-
-    t = _window_time(cfg, params, use_pallas=True)
-    print(f"window ctx=512 pal {t*1e3:8.2f} ms/tok")
-
-    t = _window_time(cfg, params, use_pallas=False)
-    print(f"window ctx=512 xla {t*1e3:8.2f} ms/tok")
+    if args.json:
+        print(json.dumps(out))
+        return out
+    for k, v in out.items():
+        if k == "phases":
+            print("phases (ms):")
+            for pk_, pv in v.items():
+                print(f"  {pk_:22s} {pv}")
+        else:
+            print(f"{k:24s} {v}")
+    return out
 
 
 if __name__ == "__main__":
