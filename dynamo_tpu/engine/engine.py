@@ -1418,6 +1418,7 @@ class EngineCore:
                       logprob: Optional[float] = None) -> TokenDelta:
         if req.first_token_ts is None:
             req.first_token_ts = time.monotonic()
+            self._trace_first_token(req)
         req.output_tokens.append(token)
         lp = ([logprob] if (logprob is not None and req.sampling.logprobs)
               else None)
@@ -1431,6 +1432,34 @@ class EngineCore:
             self._drop(req)
             return delta
         return TokenDelta(req.request_id, [token], logprobs=lp)
+
+    def _trace_first_token(self, req: Request) -> None:
+        """Admission→first-token lifecycle spans, recorded ON the engine
+        thread at the moment the sequence's first token lands.  Pure
+        host-side bookkeeping from timestamps the scheduler already
+        stamps: no device work, no host syncs, and nothing at all unless
+        tracing is enabled AND the serving layer bound a context for this
+        request id (LocalEngineClient / engine_wire_handler)."""
+        from dynamo_tpu.runtime import tracing
+
+        tracer = tracing.get_tracer()
+        if not tracer.enabled:
+            return
+        ctx = tracer.ctx_for(req.request_id)
+        if ctx is None:
+            return
+        first = req.first_token_ts
+        pf_start = req.prefill_start_ts or req.arrival_ts
+        pf_end = req.prefill_end_ts or first
+        tracer.record_span("engine.queue_wait", ctx,
+                           req.arrival_ts, pf_start,
+                           attrs={"request_id": req.request_id})
+        tracer.record_span(
+            "engine.prefill", ctx, pf_start, pf_end,
+            attrs={"request_id": req.request_id,
+                   "prompt_tokens": len(req.prompt_tokens)})
+        tracer.record_span("engine.ttft", ctx, req.arrival_ts, first,
+                           attrs={"request_id": req.request_id})
 
     def _finish(self, req: Request, reason: FinishReason) -> None:
         # With the managed source, sealed blocks stay resident (inactive,

@@ -60,6 +60,11 @@ class Request:
     finish_reason: Optional[FinishReason] = None
     arrival_ts: float = field(default_factory=time.monotonic)
     first_token_ts: Optional[float] = None
+    # Lifecycle tracing (runtime/tracing.py): when this sequence's first
+    # prefill chunk was planned and when prefill completed — the engine
+    # derives queue-wait / prefill / TTFT spans from these at first token.
+    prefill_start_ts: Optional[float] = None
+    prefill_end_ts: Optional[float] = None
     # Tokens emitted before a preemption folded them into the prompt —
     # keeps max_tokens budgeting and seeded-RNG indices monotonic.
     prior_output: int = 0
@@ -448,6 +453,8 @@ class Scheduler:
             chunk = min(remaining, self.config.max_prefill_chunk, budget)
             if chunk <= 0:
                 continue
+            if req.prefill_start_ts is None:
+                req.prefill_start_ts = time.monotonic()
             items.append(PrefillWork(
                 request=req, start=req.prefilled, length=chunk))
             budget -= chunk
@@ -494,6 +501,7 @@ class Scheduler:
         req.prefilled += work.length
         if req.prefilled >= len(req.prompt_tokens):
             req.state = RequestState.DECODE
+            req.prefill_end_ts = time.monotonic()
 
     def finish(self, req: Request, reason: FinishReason) -> None:
         req.state = RequestState.FINISHED

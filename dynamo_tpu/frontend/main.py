@@ -81,7 +81,9 @@ def parse_args(argv=None):
                    help="batch mode: JSONL output (default: input + .out)")
     from dynamo_tpu.runtime.config import (
         apply_to_parser_defaults, load_layered_config)
+    from dynamo_tpu.runtime.tracing import add_trace_args
 
+    add_trace_args(p)
     apply_to_parser_defaults(p, load_layered_config(
         {"http_host": "127.0.0.1", "http_port": 8080,
          "control_plane": None, "router_mode": "round_robin",
@@ -351,7 +353,9 @@ async def run_batch(models: ModelManager, batch_file: str,
 
 async def run(args) -> None:
     from dynamo_tpu import native
+    from dynamo_tpu.runtime.tracing import configure_from_args
 
+    configure_from_args(args, service="frontend")
     await native.warmup()  # build the C++ hasher off the event loop
     models = ModelManager()
     shutdowns = []

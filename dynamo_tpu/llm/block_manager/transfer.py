@@ -117,14 +117,25 @@ async def pull_prefix(engine, rpc_client, prompt_tokens: List[int],
     `covered_tokens`: block-aligned prefix already resident locally
     (e.g. from a partial device-direct pull) — those hashes are not
     re-fetched over the wire."""
+    from dynamo_tpu.runtime import tracing
+
     hashes = sealed_hashes(prompt_tokens, block_size)
     skip = covered_tokens // block_size
     want = hashes[skip:]
     if not want:
         return covered_tokens
-    blocks = await fetch_blocks(rpc_client, want)
-    contiguous = contiguous_prefix(want, blocks)
-    if not contiguous:
-        return covered_tokens
-    await engine.import_blocks(contiguous)
+    # `with` makes the span task-current: the rpc.client spans
+    # fetch_blocks opens nest UNDER the pull, not beside it.
+    with tracing.get_tracer().start_span(
+            "kv.pull_prefix",
+            attrs={"blocks_wanted": len(want),
+                   "block_size": block_size}) as span:
+        blocks = await fetch_blocks(rpc_client, want)
+        contiguous = contiguous_prefix(want, blocks)
+        span.set_attr(
+            blocks_fetched=len(blocks), blocks_injected=len(contiguous),
+            bytes=sum(a.nbytes for a in contiguous.values()))
+        if not contiguous:
+            return covered_tokens
+        await engine.import_blocks(contiguous)
     return covered_tokens + len(contiguous) * block_size

@@ -43,6 +43,7 @@ from dynamo_tpu.engine.engine import TokenDelta
 from dynamo_tpu.engine.sampling import SamplingParams
 from dynamo_tpu.llm.block_manager.transfer import pull_prefix
 from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.rpc import RpcClient, RpcError
 
 logger = logging.getLogger(__name__)
@@ -164,12 +165,13 @@ class DisaggDecodeClient:
     def __init__(self, inner, engine, cp, namespace: str,
                  block_size: int, *,
                  prefill_timeout: float = 120.0,
-                 transfer_plane=None) -> None:
+                 transfer_plane=None, request_metrics=None) -> None:
         """`inner`: the local EngineClient; `engine`: the InferenceEngine
         (import_blocks side of the data plane); `transfer_plane`: the
         device-direct KvTransferPlane when this worker runs one — blocks
         then cross device-to-device, the host-staged pull remaining the
-        fallback."""
+        fallback.  `request_metrics`: a runtime.metrics.RequestMetrics —
+        KV-transfer time lands in its kv_transfer_seconds histogram."""
         self.inner = inner
         self.engine = engine
         self.cp = cp
@@ -177,6 +179,7 @@ class DisaggDecodeClient:
         self.block_size = block_size
         self.prefill_timeout = prefill_timeout
         self.transfer_plane = transfer_plane
+        self.request_metrics = request_metrics
         self.device_pulls = 0
         self._waiters: Dict[str, asyncio.Future] = {}
         self._rpc_clients: Dict[str, RpcClient] = {}
@@ -223,12 +226,28 @@ class DisaggDecodeClient:
         fut = asyncio.get_running_loop().create_future()
         self._waiters[rid] = fut
         try:
+            # `with` makes the span current for the whole admission:
+            # kv.pull_prefix / device-pull spans and their RPC children
+            # nest under this one.
+            with tracing.get_tracer().start_span(
+                    "disagg.remote_prefill",
+                    attrs={"request_id": rid,
+                           "prompt_tokens": len(request.token_ids)}) as span:
+                await self._remote_prefill_traced(request, rid, fut, span)
+        finally:
+            self._waiters.pop(rid, None)
+
+    async def _remote_prefill_traced(self, request, rid, fut, span) -> None:
+        try:
             await self.cp.queue_push(prefill_queue_name(self.namespace), {
                 "request_id": rid,
                 "model": request.model,
                 "token_ids": list(request.token_ids),
             })
             done = await asyncio.wait_for(fut, self.prefill_timeout)
+            span.set_attr(prefill_s=round(done.get("prefill_s", 0.0), 4),
+                          prefill_worker=done.get("address"))
+            t_pull = time.monotonic()
             onboarded = 0
             path = "host-staged"
             if self.transfer_plane is not None:
@@ -262,6 +281,12 @@ class DisaggDecodeClient:
                     covered_tokens=onboarded)
             self.remote_prefills += 1
             self.tokens_onboarded += onboarded
+            transfer_s = time.monotonic() - t_pull
+            if self.request_metrics is not None:
+                self.request_metrics.kv_transfer.observe(
+                    transfer_s, labels={"path": path})
+            span.set_attr(tokens_onboarded=onboarded, path=path,
+                          kv_transfer_s=round(transfer_s, 4))
             logger.info("remote prefill %s: %d tokens onboarded from %s "
                         "(%s)", rid, onboarded, done["address"], path)
         except (asyncio.TimeoutError, ConnectionError, OSError,
@@ -270,10 +295,9 @@ class DisaggDecodeClient:
             # evicted between announce and pull) — disagg is an
             # optimisation, never a correctness dependency.
             self.local_fallbacks += 1
+            span.set_attr(fallback="local", error=type(e).__name__)
             logger.warning("remote prefill %s failed (%s); prefilling "
                            "locally", rid, e)
-        finally:
-            self._waiters.pop(rid, None)
 
     async def generate(
         self, request: PreprocessedRequest

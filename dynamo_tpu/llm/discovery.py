@@ -118,17 +118,29 @@ def engine_wire_handler(engine_client) -> Callable:
     """Wrap any EngineClient as an RPC handler (worker side)."""
 
     async def handler(payload: dict) -> AsyncIterator[dict]:
+        from dynamo_tpu.runtime import tracing
+
         req = request_from_wire(payload)
         # Trace context: the frontend's request id arrives in the RPC
         # frame; logging it here gives one grep-able id across frontend
-        # and worker logs (reference `logging.rs:73-79`).
+        # and worker logs (reference `logging.rs:73-79`).  The RPC server
+        # span (runtime/rpc.py) is this task's current span; binding it
+        # to the request id lets the ENGINE THREAD parent its
+        # admission→first-token spans under this hop.
         logger.info("request %s: %d prompt tokens, max_tokens=%d",
                     req.request_id, len(req.token_ids),
                     req.sampling.max_tokens)
+        tracer = tracing.get_tracer()
+        span = tracing.current_span()
+        if span is not None:
+            tracer.bind(req.request_id, span.ctx)
         n_out = 0
-        async for delta in engine_client.generate(req):
-            n_out += len(delta.token_ids)
-            yield delta_to_wire(delta)
+        try:
+            async for delta in engine_client.generate(req):
+                n_out += len(delta.token_ids)
+                yield delta_to_wire(delta)
+        finally:
+            tracer.unbind(req.request_id)
         logger.info("request %s: finished, %d tokens", req.request_id, n_out)
 
     return handler

@@ -24,7 +24,9 @@ from dynamo_tpu.engine.scheduler import FinishReason
 from dynamo_tpu.llm.backend import StreamDetokenizer, wire_finish_reason
 from dynamo_tpu.llm.protocols import openai as oai
 from dynamo_tpu.llm.service import ModelHandle, ModelManager
-from dynamo_tpu.runtime.metrics import FrontendMetrics, MetricsRegistry
+from dynamo_tpu.runtime import tracing
+from dynamo_tpu.runtime.metrics import (
+    FrontendMetrics, MetricsRegistry, RequestMetrics)
 
 logger = logging.getLogger(__name__)
 
@@ -35,10 +37,15 @@ class HttpService:
         self,
         models: ModelManager,
         registry: Optional[MetricsRegistry] = None,
+        tracer: Optional[tracing.Tracer] = None,
     ) -> None:
         self.models = models
         self.registry = registry or MetricsRegistry()
         self.metrics = FrontendMetrics(self.registry)
+        # Per-request lifecycle histograms (dynamo_request_*): TTFT /
+        # TPOT / queue wait, always on (cheap); spans ride the tracer.
+        self.request_metrics = RequestMetrics(self.registry)
+        self.tracer = tracer or tracing.get_tracer()
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
         self.app.router.add_post("/v1/completions", self.completions)
@@ -47,6 +54,7 @@ class HttpService:
         self.app.router.add_post("/clear_kv_blocks", self.clear_kv_blocks)
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/metrics", self.prometheus)
+        self.app.router.add_get("/debug/traces", self.debug_traces)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
         self._runner: Optional[web.AppRunner] = None
@@ -93,6 +101,23 @@ class HttpService:
             return f"{header[:120]}-{uuid.uuid4().hex[:8]}"
         return oai.request_id(prefix)
 
+    def _start_trace(self, route: str, rid: str, model: str):
+        """Root span for one HTTP request, reusing the request id as the
+        trace id (one grep-able id across logs, metrics, and the merged
+        Perfetto view).  Returns (span, contextvar token); both are
+        no-ops when tracing is off."""
+        span = self.tracer.start_span(
+            f"http.{route}", trace_id=rid,
+            attrs={"rid": rid, "model": model})
+        token = tracing.use_span(span) if span.ctx is not None else None
+        return span, token
+
+    @staticmethod
+    def _end_trace(span, token) -> None:
+        span.end()
+        if token is not None:
+            tracing.restore(token)
+
     def _validate_context(self, handle: ModelHandle, pre):
         """Boundary validation (reference `protocols/openai/validate.rs`):
         a prompt that cannot fit the model context is a client error the
@@ -131,6 +156,17 @@ class HttpService:
         return web.Response(text=self.registry.expose(),
                             content_type="text/plain")
 
+    async def debug_traces(self, req: web.Request) -> web.Response:
+        """Most recent completed traces (`?n=K`, default 32) — the
+        per-process buffer tools/trace_merge.py stitches across the
+        deployment."""
+        try:
+            n = int(req.query.get("n", "32"))
+        except ValueError:
+            return self._error(400, "n must be an integer")
+        return web.json_response(
+            tracing.debug_traces_payload(n, self.tracer))
+
     async def list_models(self, _req: web.Request) -> web.Response:
         listing = oai.ModelList(
             data=[oai.ModelInfo(id=n) for n in self.models.names()])
@@ -146,31 +182,41 @@ class HttpService:
             return self._error(404, f"model {body.model!r} not found",
                                "model_not_found")
         rid = self._request_id(request, "chatcmpl")
+        root, tok = self._start_trace("chat", rid, body.model)
         try:
-            pre = handle.preprocessor.preprocess_chat(body, rid)
-        except ValueError as e:
-            return self._error(400, str(e))
-        mm = handle.multimodal
-        if mm is not None and mm.image_refs(body.messages):
-            # image_url parts → encode worker → prompt_embeds
-            # (llm/multimodal.py; reference multimodal_v1 processor).
-            try:
-                pre = await mm.attach(body.messages, pre)
-            except Exception as e:
+            with self.tracer.start_span("frontend.preprocess"):
+                try:
+                    pre = handle.preprocessor.preprocess_chat(body, rid)
+                except ValueError as e:
+                    return self._error(400, str(e))
+            mm = handle.multimodal
+            if mm is not None and mm.image_refs(body.messages):
+                # image_url parts → encode worker → prompt_embeds
+                # (llm/multimodal.py; reference multimodal_v1 processor).
+                try:
+                    with self.tracer.start_span("frontend.encode_images"):
+                        pre = await mm.attach(body.messages, pre)
+                except Exception as e:
+                    return self._error(
+                        502, f"image encoding failed: {e}", "encode_error")
+            elif mm is None and self._has_image_parts(body.messages):
                 return self._error(
-                    502, f"image encoding failed: {e}", "encode_error")
-        elif mm is None and self._has_image_parts(body.messages):
-            return self._error(
-                400, "this model has no multimodal pipeline configured "
-                     "(image_url parts unsupported)")
-        err = self._validate_context(handle, pre)
-        if err is not None:
-            return err
-        logger.info("request %s: chat model=%s prompt_tokens=%d stream=%s",
-                    rid, body.model, len(pre.token_ids), body.stream)
-        if body.stream:
-            return await self._stream_chat(request, handle, body, pre, rid)
-        return await self._unary_chat(handle, body, pre, rid)
+                    400, "this model has no multimodal pipeline configured "
+                         "(image_url parts unsupported)")
+            err = self._validate_context(handle, pre)
+            if err is not None:
+                return err
+            logger.info("request %s: chat model=%s prompt_tokens=%d "
+                        "stream=%s", rid, body.model, len(pre.token_ids),
+                        body.stream)
+            root.set_attr(prompt_tokens=len(pre.token_ids),
+                          stream=bool(body.stream))
+            if body.stream:
+                return await self._stream_chat(request, handle, body, pre,
+                                               rid)
+            return await self._unary_chat(handle, body, pre, rid)
+        finally:
+            self._end_trace(root, tok)
 
     @staticmethod
     def _has_image_parts(messages) -> bool:
@@ -188,16 +234,27 @@ class HttpService:
             return self._error(404, f"model {body.model!r} not found",
                                "model_not_found")
         rid = self._request_id(request, "cmpl")
+        root, tok = self._start_trace("completion", rid, body.model)
         try:
-            pre = handle.preprocessor.preprocess_completion(body, rid)
-        except ValueError as e:
-            return self._error(400, str(e))
+            return await self._completions_traced(request, handle, body,
+                                                  rid, root)
+        finally:
+            self._end_trace(root, tok)
+
+    async def _completions_traced(self, request, handle, body, rid, root):
+        with self.tracer.start_span("frontend.preprocess"):
+            try:
+                pre = handle.preprocessor.preprocess_completion(body, rid)
+            except ValueError as e:
+                return self._error(400, str(e))
         err = self._validate_context(handle, pre)
         if err is not None:
             return err
         logger.info("request %s: completion model=%s prompt_tokens=%d "
                     "stream=%s", rid, body.model, len(pre.token_ids),
                     body.stream)
+        root.set_attr(prompt_tokens=len(pre.token_ids),
+                      stream=bool(body.stream))
         if body.stream:
             return await self._stream_completion(request, handle, body, pre,
                                                  rid)
@@ -260,19 +317,31 @@ class HttpService:
             return self._error(404, f"model {body.model!r} not found",
                                "model_not_found")
         rid = self._request_id(request, "resp")
+        root, tok = self._start_trace("responses", rid, body.model)
         try:
-            chat = body.as_chat()
-            pre = handle.preprocessor.preprocess_chat(chat, rid)
-        except Exception as e:
-            # as_chat's ChatMessage validation failures are client input
-            # errors too (e.g. an unsupported role) — 400, not 500.
-            return self._error(400, str(e))
+            return await self._responses_traced(request, handle, body, rid,
+                                                root)
+        finally:
+            self._end_trace(root, tok)
+
+    async def _responses_traced(self, request, handle, body, rid, root):
+        with self.tracer.start_span("frontend.preprocess"):
+            try:
+                chat = body.as_chat()
+                pre = handle.preprocessor.preprocess_chat(chat, rid)
+            except Exception as e:
+                # as_chat's ChatMessage validation failures are client
+                # input errors too (e.g. an unsupported role) — 400, not
+                # 500.
+                return self._error(400, str(e))
         err = self._validate_context(handle, pre)
         if err is not None:
             return err
         logger.info("request %s: responses model=%s prompt_tokens=%d "
                     "stream=%s", rid, body.model, len(pre.token_ids),
                     body.stream)
+        root.set_attr(prompt_tokens=len(pre.token_ids),
+                      stream=bool(body.stream))
         if body.stream:
             return await self._stream_responses(request, handle, body, pre,
                                                 rid)
@@ -471,15 +540,16 @@ class HttpService:
         return out
 
     async def _collect_one(self, handle, pre, model, start, want_lp,
-                           on_first=None):
+                           on_first=None, observe_queue_wait=True):
         """Drain one engine stream → (text, finish_reason, det, lp_sink).
         `on_first` fires at the first yielded output (choice-0's prompt
         blocks are sealed by then — the signal siblings gate on)."""
         det = StreamDetokenizer(handle.tokenizer, pre.stop_sequences)
         lp_sink = [] if want_lp else None
         parts, reason = [], None
-        async for out in self._token_stream(handle, pre, det, model, start,
-                                            lp_sink=lp_sink):
+        async for out in self._token_stream(
+                handle, pre, det, model, start, lp_sink=lp_sink,
+                observe_queue_wait=observe_queue_wait):
             if on_first is not None:
                 on_first()
                 on_first = None
@@ -515,9 +585,11 @@ class HttpService:
         async def run_sib(clone):
             await sealed.wait()
             # Sibling TTFT measures from its own start: folding choice
-            # 0's prefill into the histogram would skew it.
+            # 0's prefill into the histogram would skew it.  Queue wait
+            # is choice 0's alone (a sibling's would read ~0).
             return await self._collect_one(handle, clone, model,
-                                           time.monotonic(), want_lp)
+                                           time.monotonic(), want_lp,
+                                           observe_queue_wait=False)
 
         results = await asyncio.gather(
             run0(), *(run_sib(c) for c in clones[1:]),
@@ -528,13 +600,37 @@ class HttpService:
         total_out = sum(det.completion_tokens for _, _, det, _ in results)
         return list(results), total_out
 
+    # TPOT interval spans recorded per trace before they'd crowd out the
+    # rest of the timeline (the histogram still sees every interval).
+    MAX_TPOT_SPANS = 32
+
     async def _token_stream(self, handle, pre, det, model, start_ts,
-                            lp_sink=None):
-        """Engine deltas → TextDeltas, with TTFT/ITL observation.
+                            lp_sink=None, observe_queue_wait=True):
+        """Engine deltas → TextDeltas, with TTFT/ITL observation and the
+        request-lifecycle trace spans (queue wait → TTFT → per-token
+        TPOT intervals, parented under the request's root span).
         `lp_sink`: list collecting (token_id, logprob) pairs when the
-        request asked for logprobs."""
+        request asked for logprobs.  `observe_queue_wait`: False for
+        n>1 sibling choices — their start_ts is their own launch time
+        (post-seal), so a ~0 "queue wait" per sibling would skew the
+        histogram low by a factor of n."""
+        labels = {"model": model}
+        tracer = self.tracer
+        parent = tracing.current_span() if tracer.enabled else None
+        if observe_queue_wait:
+            # Queue wait, frontend view: request arrival → the
+            # generation stream starting (preprocess, image encode,
+            # routing, admission to the client pipeline).  The
+            # engine-side engine.queue_wait span covers in-engine wait.
+            t_entry = time.monotonic()
+            self.request_metrics.queue_wait.observe(t_entry - start_ts,
+                                                    labels=labels)
+            if parent is not None:
+                tracer.record_span("frontend.queue_wait", parent,
+                                   start_ts, t_entry)
         first = True
         last_t = None
+        n_intervals = 0
         async for delta in handle.client.generate(pre):
             now = time.monotonic()
             if (lp_sink is not None and delta.logprobs
@@ -544,10 +640,24 @@ class HttpService:
                 if first:
                     self.metrics.ttft.observe(now - start_ts,
                                               labels={"model": model})
+                    self.request_metrics.ttft.observe(now - start_ts,
+                                                      labels=labels)
+                    if parent is not None:
+                        tracer.record_span("frontend.ttft", parent,
+                                           start_ts, now)
                     first = False
                 elif last_t is not None:
                     self.metrics.itl.observe(now - last_t,
                                              labels={"model": model})
+                    self.request_metrics.tpot.observe(now - last_t,
+                                                      labels=labels)
+                    n_intervals += 1
+                    if (parent is not None
+                            and n_intervals <= self.MAX_TPOT_SPANS):
+                        tracer.record_span(
+                            "decode.tpot", parent, last_t, now,
+                            attrs={"index": n_intervals,
+                                   "tokens": len(delta.token_ids)})
                 last_t = now
                 out = det.push_tokens(delta.token_ids)
                 if out.finished:      # stop string hit mid-stream
@@ -744,9 +854,9 @@ class HttpService:
                 st = start if i == 0 else time.monotonic()
                 lp_sink = [] if want_lp else None
                 sent = 0
-                async for out in self._token_stream(handle, clone, dets[i],
-                                                    body.model, st,
-                                                    lp_sink=lp_sink):
+                async for out in self._token_stream(
+                        handle, clone, dets[i], body.model, st,
+                        lp_sink=lp_sink, observe_queue_wait=(i == 0)):
                     sealed.set()
                     lps = []
                     if lp_sink is not None:

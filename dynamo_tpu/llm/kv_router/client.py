@@ -27,6 +27,7 @@ from dynamo_tpu.llm.kv_router.protocols import RouterEvent
 from dynamo_tpu.llm.kv_router.router import KvRouter, KvRouterConfig
 from dynamo_tpu.llm.kv_router.watcher import LoadMetricsWatcher
 from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -70,6 +71,7 @@ class KvRoutedEngineClient:
         # `push_router.rs:168`).
         self._penalty: dict = {}
         self._penalty_ttl = 3.0
+        self._last_decision = None  # last KVHitRateEvent (routing spans)
 
     async def start(self) -> None:
         self._sub = await self.runtime.cp.subscribe(KV_EVENTS_SUBJECT)
@@ -176,6 +178,7 @@ class KvRoutedEngineClient:
         # Sync callback from the selector: publish fire-and-forget — a
         # telemetry publish must never add a control-plane round trip (or
         # its failures) to the request hot path.
+        self._last_decision = ev  # routing-span attrs (cost, candidates)
         async def pub():
             try:
                 await self.runtime.cp.publish(HIT_RATE_SUBJECT, {
@@ -241,10 +244,26 @@ class KvRoutedEngineClient:
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:
         workers = self._sync_workers()
-        worker_id, overlap = self.router.find_best_match(
-            request.request_id, request.token_ids, workers,
-            expected_output_tokens=request.sampling.max_tokens,
-            metrics=self._metrics.fresh())
+        # Routing-decision span: which worker won, the prefix overlap it
+        # won on, and the selector's cost/candidate count — the
+        # "why was this request placed here" record in the merged trace.
+        route_span = tracing.get_tracer().start_span(
+            "router.select", attrs={"request_id": request.request_id})
+        try:
+            worker_id, overlap = self.router.find_best_match(
+                request.request_id, request.token_ids, workers,
+                expected_output_tokens=request.sampling.max_tokens,
+                metrics=self._metrics.fresh())
+        except BaseException as e:
+            # No candidates / selector failure: the span must still end,
+            # or an empty fleet leaks one open span per rejected request.
+            route_span.end(error=type(e).__name__)
+            raise
+        ev = self._last_decision
+        route_span.end(
+            worker=int(worker_id), overlap_blocks=int(overlap),
+            candidates=(ev.candidates if ev is not None else len(workers)),
+            cost=(round(ev.cost, 3) if ev is not None else None))
         logger.debug("kv-routed %s → worker %s (overlap %d blocks)",
                      request.request_id, worker_id, overlap)
         self._publish_seq("add", request.request_id, worker=worker_id,

@@ -37,11 +37,15 @@ logger = logging.getLogger(__name__)
 class KVHitRateEvent:
     """Emitted per routing decision for observability (reference
     `scheduler.rs:22`): how much of the request's prefix was already
-    cached on the chosen worker."""
+    cached on the chosen worker, plus the decision's cost and candidate
+    count so routing spans (runtime/tracing.py) can show WHY a worker
+    won, not just which one."""
 
     worker_id: WorkerId
     isl_blocks: int
     overlap_blocks: int
+    cost: float = 0.0
+    candidates: int = 0
 
 
 @dataclass
@@ -148,6 +152,8 @@ class DefaultWorkerSelector:
                     worker_id=chosen_id,
                     isl_blocks=request_blocks,
                     overlap_blocks=min(chosen.overlap_blocks, request_blocks),
+                    cost=costs[chosen_id],
+                    candidates=len(costs),
                 )
             )
         return chosen

@@ -39,10 +39,22 @@ class LocalEngineClient:
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:
-        async for delta in self._engine.generate(
-                request.request_id, request.token_ids, request.sampling,
-                prompt_embeds=request.prompt_embeds):
-            yield delta
+        from dynamo_tpu.runtime import tracing
+
+        # Bind the serving task's span to the request id so engine-thread
+        # spans (admission→first-token) parent under it — the in-process
+        # analog of engine_wire_handler's worker-side binding.
+        tracer = tracing.get_tracer()
+        span = tracing.current_span()
+        if span is not None:
+            tracer.bind(request.request_id, span.ctx)
+        try:
+            async for delta in self._engine.generate(
+                    request.request_id, request.token_ids, request.sampling,
+                    prompt_embeds=request.prompt_embeds):
+                yield delta
+        finally:
+            tracer.unbind(request.request_id)
 
     async def embed(self, token_lists):
         """Last-token hidden-state embeddings: [n, hidden] (the

@@ -6,6 +6,12 @@ Role of the reference's `components/metrics` Rust binary
 the latest snapshot per worker, and expose the aggregate as Prometheus
 text over HTTP — the series the planner and dashboards scrape.
 
+Additionally scrapes the `/metrics` of any process advertised under the
+control plane's `status_endpoints/` prefix (router_service, planner —
+components with a status server but no pub/sub metrics stream) and
+appends their exposition verbatim, so one aggregator URL covers the whole
+namespace.
+
     python -m dynamo_tpu.metrics_aggregator --control-plane HOST:PORT \
         [--http-port 8081]
 """
@@ -27,13 +33,17 @@ logger = logging.getLogger(__name__)
 
 HIT_RATE_SUBJECT = "kv_hit_rate"
 STALE_SECS = 30.0
+SCRAPE_INTERVAL = 5.0
 
 
 class MetricsAggregator:
-    """Subscribes, aggregates, exposes."""
+    """Subscribes, aggregates, exposes — and scrapes advertised status
+    servers (router_service, planner)."""
 
-    def __init__(self, cp) -> None:
+    def __init__(self, cp, scrape_interval: float = SCRAPE_INTERVAL) -> None:
         self.cp = cp
+        self.scrape_interval = scrape_interval
+        self._scraped: Dict[str, str] = {}   # address → last /metrics text
         self.registry = MetricsRegistry(prefix="dynamo_aggregate")
         self._watcher = LoadMetricsWatcher(cp, stale_secs=STALE_SECS,
                                            name="aggregator")
@@ -61,6 +71,7 @@ class MetricsAggregator:
         sub = await self.cp.subscribe(HIT_RATE_SUBJECT)
         self._subs.append(sub)
         self._tasks.append(asyncio.create_task(self._pump_hits(sub)))
+        self._tasks.append(asyncio.create_task(self._scrape_loop()))
 
     async def stop(self) -> None:
         await self._watcher.stop()
@@ -96,6 +107,55 @@ class MetricsAggregator:
             except Exception:
                 logger.exception("bad kv_hit_rate payload")
 
+    async def _scrape_loop(self) -> None:
+        """Pull `/metrics` from every status server advertised under
+        `status_endpoints/` (runtime/status.register_status_endpoint).
+        Unreachable targets drop from the cache — a crashed router or
+        planner must not leave frozen series in the aggregate."""
+        import aiohttp
+
+        from dynamo_tpu.runtime.status import STATUS_ENDPOINTS_PREFIX
+
+        while True:
+            # The whole iteration is guarded (like _pump_hits): one
+            # malformed status_endpoints entry or transient session
+            # error must not silently kill scraping forever.
+            try:
+                entries = await self.cp.get_prefix(
+                    f"{STATUS_ENDPOINTS_PREFIX}/")
+                addrs = sorted({
+                    entry["address"] for entry in entries.values()
+                    if isinstance(entry, dict) and entry.get("address")})
+                fresh: Dict[str, str] = {}
+                if addrs:
+                    timeout = aiohttp.ClientTimeout(total=2.0)
+
+                    async def fetch(s, addr):
+                        try:
+                            async with s.get(
+                                    f"http://{addr}/metrics") as resp:
+                                if resp.status == 200:
+                                    return addr, await resp.text()
+                        except (aiohttp.ClientError, asyncio.TimeoutError,
+                                OSError):
+                            pass  # gone → dropped from the aggregate
+                        return None
+
+                    # Concurrent fetches: registration keys are unleased
+                    # (stale ones accumulate across restarts), so one
+                    # sweep must cost ~one 2 s timeout total, not 2 s per
+                    # dead address serially.
+                    async with aiohttp.ClientSession(timeout=timeout) as s:
+                        results = await asyncio.gather(
+                            *(fetch(s, a) for a in addrs))
+                    fresh = dict(r for r in results if r is not None)
+                self._scraped = fresh
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("status-endpoint scrape failed; retrying")
+            await asyncio.sleep(self.scrape_interval)
+
     def fresh_workers(self) -> Dict[int, ForwardPassMetrics]:
         return self._watcher.fresh()
 
@@ -111,9 +171,44 @@ class MetricsAggregator:
         usages = [m.kv_stats.gpu_cache_usage_perc for m in fresh.values()]
         self._g_usage.set(sum(usages) / len(usages) if usages else 0.0)
 
+    @staticmethod
+    def _relabel(text: str, addr: str, seen_meta: set) -> str:
+        """Stamp an `instance` label on every scraped sample so two
+        processes of the same component (both exposing, say, an
+        unlabeled dynamo_router_requests_total) stay distinct series —
+        verbatim concatenation made Prometheus reject the whole
+        exposition as duplicate samples.  # HELP/# TYPE lines pass
+        through once per metric name across all targets."""
+        out = []
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 2)   # '#', 'HELP|TYPE', 'name...'
+                key = tuple(parts[:3])
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+                out.append(line)
+                continue
+            name_labels, _, value = line.rpartition(" ")
+            if not name_labels:
+                out.append(line)
+                continue
+            if name_labels.endswith("}"):
+                out.append(f'{name_labels[:-1]},instance="{addr}"}} {value}')
+            else:
+                out.append(f'{name_labels}{{instance="{addr}"}} {value}')
+        return "\n".join(out) + "\n" if out else ""
+
     def expose(self) -> str:
         self._refresh_gauges()
-        return self.registry.expose()
+        text = self.registry.expose()
+        seen_meta: set = set()
+        for addr in sorted(self._scraped):
+            text += (f"# scraped from {addr}\n"
+                     + self._relabel(self._scraped[addr], addr, seen_meta))
+        return text
 
 
 async def serve(cp, host: str = "127.0.0.1", port: int = 0):

@@ -38,12 +38,24 @@ def main(argv=None) -> None:
     p.add_argument("--prefill-worker-args", default=None,
                    help="sla: comma-joined args for the prefill pool "
                         "(omit for aggregated deployments)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="status server port for /metrics "
+                        "(0 = ephemeral; -1 disables)")
+    p.add_argument("--metrics-host", default="127.0.0.1",
+                   help="bind + ADVERTISED host for the status server; a "
+                        "cross-host aggregator needs a routable address "
+                        "(the 127.0.0.1 default only works single-host)")
     p.add_argument("worker_args", nargs="*",
                    help="args after -- go to spawned workers")
+    from dynamo_tpu.runtime.tracing import (
+        add_trace_args, configure_from_args)
+
+    add_trace_args(p)
     args = p.parse_args(argv)
     if args.mode == "sla" and (not args.profile or not args.metrics_url):
         p.error("--mode sla needs --profile and --metrics-url")
     logging.basicConfig(level=logging.INFO)
+    configure_from_args(args, service="planner")
 
     async def run():
         host, port = args.control_plane.rsplit(":", 1)
@@ -78,11 +90,27 @@ def main(argv=None) -> None:
                 kv_high=args.kv_high, kv_low=args.kv_low,
                 adjustment_interval=args.adjustment_interval))
         await planner.start()
+        status = None
+        if args.metrics_port >= 0:
+            from dynamo_tpu.planner.core import planner_metrics_text
+            from dynamo_tpu.runtime.status import (
+                StatusServer, register_status_endpoint)
+
+            status = StatusServer(
+                extra_text_fn=lambda: planner_metrics_text(planner,
+                                                           connector))
+            bound = await status.start(host=args.metrics_host,
+                                       port=args.metrics_port)
+            await register_status_endpoint(cp, "planner", bound,
+                                           host=args.metrics_host)
+            print(f"planner metrics on :{bound}/metrics", flush=True)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
+        if status is not None:
+            await status.stop()
         await planner.stop()
         await connector.shutdown()
         pc = getattr(planner, "prefill_connector", None)

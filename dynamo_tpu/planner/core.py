@@ -122,3 +122,33 @@ class LoadPlanner:
         return (f"usage~{self._usage_pred.predict_next():.2f} "
                 f"waiting~{self._waiting_pred.predict_next():.1f} "
                 f"replicas={self.connector.replicas()}")
+
+
+def planner_metrics_text(planner, connector) -> str:
+    """Prometheus text for the planner's status server (`/metrics` on
+    `python -m dynamo_tpu.planner --metrics-port`): replica count,
+    scaling-decision tallies, and the predictors' next-step view.  Works
+    for both LoadPlanner and SlaPlanner (fields read defensively — the
+    SLA variant keeps its own predictor names)."""
+    lines = []
+    try:
+        lines.append(f"dynamo_planner_replicas {connector.replicas()}")
+    except Exception:
+        pass
+    decisions = getattr(planner, "decisions", []) or []
+    ups = sum(1 for d in decisions if len(d) > 1 and d[1] == "up")
+    downs = sum(1 for d in decisions if len(d) > 1 and d[1] == "down")
+    lines.append('dynamo_planner_decisions_total{direction="up"} %d' % ups)
+    lines.append('dynamo_planner_decisions_total{direction="down"} %d'
+                 % downs)
+    for attr, name in (("_usage_pred", "kv_usage"),
+                       ("_waiting_pred", "requests_waiting")):
+        pred = getattr(planner, attr, None)
+        if pred is None:
+            continue
+        try:
+            lines.append('dynamo_planner_predicted{metric="%s"} %s'
+                         % (name, pred.predict_next()))
+        except Exception:
+            pass
+    return "\n".join(lines) + "\n"

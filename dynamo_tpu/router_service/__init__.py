@@ -27,6 +27,7 @@ from dynamo_tpu.llm.discovery import (
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.llm.service import ModelManager
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.metrics import MetricsRegistry
 
 logger = logging.getLogger(__name__)
 
@@ -38,7 +39,8 @@ class RouterService:
     def __init__(self, runtime: DistributedRuntime, model_name: str,
                  namespace: str = "dynamo",
                  component: str = "router",
-                 serve_as: Optional[str] = None) -> None:
+                 serve_as: Optional[str] = None,
+                 registry: Optional[MetricsRegistry] = None) -> None:
         """`serve_as`: public model name of the routed endpoint (default
         `<model>-routed`) — distinct from the raw workers' name so a
         frontend discovering both never mixes routed and unrouted
@@ -54,6 +56,18 @@ class RouterService:
                                     router_mode="kv")
         self.instance = None
         self._endpoint = None
+        # Routing-brain observability (`/metrics` via the shared
+        # registry on a StatusServer; satellite of the tracing PR —
+        # frontend/worker/aggregator already expose one, the router did
+        # not).
+        self.registry = registry or MetricsRegistry()
+        self._requests = self.registry.counter(
+            "router_requests_total", "Requests routed through this "
+            "router service")
+        self._streams = self.registry.gauge(
+            "router_inflight_streams", "Streams currently routed")
+        self._route_latency = self.registry.histogram(
+            "router_request_seconds", "Full routed-stream duration")
 
     async def start(self, wait_for_model_s: float = 30.0) -> None:
         await self.watcher.start()
@@ -63,7 +77,7 @@ class RouterService:
         self._endpoint = (self.runtime.namespace(self.namespace)
                           .component(self.component).endpoint("generate"))
         self.instance = await self._endpoint.serve(
-            engine_wire_handler(handle.client))
+            engine_wire_handler(self._counted(handle.client)))
         # Reuse the discovered card so tokenizer/template survive the hop,
         # re-advertised under the routed name.
         card_dict = None
@@ -80,6 +94,31 @@ class RouterService:
         await register_llm(self._endpoint, self.instance, card)
         logger.info("router service for %r at %s", self.model_name,
                     self.instance.address)
+
+    def _counted(self, client):
+        """Wrap the routed EngineClient so every stream through the
+        router lands in the registry (request count, in-flight gauge,
+        stream duration)."""
+        svc = self
+
+        class _Counted:
+            async def generate(self, request):
+                import time
+
+                svc._requests.inc()
+                svc._streams.add(1)
+                t0 = time.monotonic()
+                try:
+                    async for delta in client.generate(request):
+                        yield delta
+                finally:
+                    svc._streams.add(-1)
+                    svc._route_latency.observe(time.monotonic() - t0)
+
+            def __getattr__(self, name):  # embed / clear_kv passthrough
+                return getattr(client, name)
+
+        return _Counted()
 
     async def stop(self) -> None:
         if self._endpoint is not None:

@@ -13,14 +13,17 @@ thread-safe; histograms use fixed buckets chosen for LLM latencies.
 
 from __future__ import annotations
 
+import math
 import threading
 from bisect import bisect_right
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-# Buckets tuned for token-level latencies (seconds).
+# Buckets tuned for token-level latencies (seconds): sub-ms resolution at
+# the bottom (a routing decision or in-process TPOT at speedup is ~100 µs)
+# through 60 s at the top (a cold-compile TTFT).
 LATENCY_BUCKETS = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
 LabelKey = Tuple[Tuple[str, str], ...]
@@ -112,17 +115,29 @@ class Histogram:
         return self._sum.get(_label_key(labels), 0.0)
 
     def mean(self, labels: Optional[Dict[str, str]] = None) -> float:
+        """NaN on an empty label set (never raises): 0.0 read as "zero
+        latency" by the SLA planner's arithmetic; NaN propagates as
+        "no data" and comparisons against it are False."""
         n = self.count(labels)
-        return self.sum(labels) / n if n else 0.0
+        return self.sum(labels) / n if n else float("nan")
 
     def quantile(self, q: float, labels: Optional[Dict[str, str]] = None) -> float:
         """Approximate quantile from bucket counts (upper bound of the
-        bucket containing the q-th observation)."""
+        bucket containing the q-th observation).  Edge behavior: NaN on
+        an empty/unknown label set; q clamps to [0, 1]; q=0 returns the
+        first non-empty bucket's bound (a single observation answers
+        every quantile with its own bucket); +Inf past the last bucket.
+        Never raises."""
         k = _label_key(labels)
-        counts = self._counts.get(k)
-        if not counts:
-            return 0.0
-        target = q * self._total[k]
+        with self._lock:
+            counts = list(self._counts.get(k, ()))
+            total = self._total.get(k, 0)
+        if not counts or total <= 0:
+            return float("nan")
+        q = min(max(q, 0.0), 1.0)
+        # At least the first observation: q=0 must land in a non-empty
+        # bucket, not the (possibly empty) first one.
+        target = max(1, math.ceil(q * total))
         acc = 0
         for i, c in enumerate(counts):
             acc += c
@@ -248,6 +263,29 @@ class MetricsRegistry:
             for m in self._metrics.values():
                 lines.extend(m.expose())
         return "\n".join(lines) + "\n"
+
+
+class RequestMetrics:
+    """Per-request lifecycle histograms (`dynamo_request_*`): the series
+    the distributed-tracing work surfaces on every process that touches a
+    request — frontend `/metrics` observes TTFT / TPOT / queue wait,
+    disagg decode workers observe KV-transfer time.  Distinct from
+    FrontendMetrics (whose exact series names the SLA planner's queries
+    key on): these are the triage-oriented family `/debug/traces`
+    complements."""
+
+    def __init__(self, registry: MetricsRegistry) -> None:
+        self.ttft = registry.histogram(
+            "request_ttft_seconds", "Request time to first token")
+        self.tpot = registry.histogram(
+            "request_tpot_seconds", "Per-output-token interval "
+            "(time per output token after the first)")
+        self.queue_wait = registry.histogram(
+            "request_queue_wait_seconds",
+            "Arrival to generation-stream start")
+        self.kv_transfer = registry.histogram(
+            "request_kv_transfer_seconds",
+            "Disaggregated KV-block onboard time (remote prefill pull)")
 
 
 class FrontendMetrics:

@@ -8,8 +8,16 @@ both jobs here: the client connects to the worker's advertised address
 connection — fewer hops, no broker on the data path.
 
 Framing: 4-byte big-endian length + msgpack body.
-  client → server: {t:"req", sid, ep, payload} | {t:"cancel", sid}
+  client → server: {t:"req", sid, ep, payload, trace?} | {t:"cancel", sid}
   server → client: {t:"delta"|"end"|"err", sid, payload|error}
+
+The optional `trace` field carries a serialized TraceContext
+(runtime/tracing.py): the client stamps its open span's context on the
+request frame, the server extracts it, opens a server-side span parented
+to the client span, and makes it the handler task's current span — so
+worker-side spans stitch into the caller's trace (Dapper-style context
+propagation over our own transport).  Absent or malformed trace fields
+cost nothing and break nothing.
 
 Cancellation propagates: client-side generator close sends `cancel`, the
 server cancels the handler task (the reference's CancellationToken chain).
@@ -26,6 +34,8 @@ import struct
 from typing import AsyncIterator, Callable, Dict, Optional
 
 import msgpack
+
+from dynamo_tpu.runtime import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -130,8 +140,21 @@ class RpcServer:
         if me is not None:
             self._conn_tasks.add(me)
 
-        async def run_stream(sid: int, ep: str, payload: dict) -> None:
+        async def run_stream(sid: int, ep: str, payload: dict,
+                             trace=None) -> None:
             self.active_streams += 1
+            # Server-side span parented to the client's span (the `trace`
+            # frame field); made current for the handler task so any span
+            # the handler opens nests under it.
+            tracer = tracing.get_tracer()
+            span: object = tracing.NULL_SPAN
+            token = None
+            if tracer.enabled and trace is not None:
+                ctx = tracing.TraceContext.from_wire(trace)
+                if ctx is not None:
+                    span = tracer.start_span(f"rpc.server:{ep}", ctx,
+                                             attrs={"endpoint": ep})
+                    token = tracing.use_span(span)
             try:
                 handler = self._handlers.get(ep)
                 if handler is None:
@@ -151,12 +174,16 @@ class RpcServer:
                 pass
             except Exception as e:
                 logger.exception("handler error on %s", ep)
+                span.set_attr(error=type(e).__name__)
                 try:
                     await _send_frame(writer, {"t": "err", "sid": sid,
                                                "error": str(e)}, lock)
                 except ConnectionResetError:
                     pass
             finally:
+                span.end()
+                if token is not None:
+                    tracing.restore(token)
                 self.active_streams -= 1
                 tasks.pop(sid, None)
 
@@ -169,7 +196,8 @@ class RpcServer:
                 if t == "req":
                     sid = msg["sid"]
                     tasks[sid] = asyncio.create_task(
-                        run_stream(sid, msg["ep"], msg.get("payload", {})))
+                        run_stream(sid, msg["ep"], msg.get("payload", {}),
+                                   msg.get("trace")))
                 elif t == "cancel":
                     task = tasks.pop(msg["sid"], None)
                     if task:
@@ -249,11 +277,19 @@ class RpcClient:
         sid = next(self._sid)
         q: asyncio.Queue = asyncio.Queue()
         self._streams[sid] = q
-        await _send_frame(self._writer,
-                          {"t": "req", "sid": sid, "ep": endpoint,
-                           "payload": payload}, self._lock)
+        # Client-side span; its context rides the request frame so the
+        # server span parents under it (see module docstring).
+        span = tracing.get_tracer().start_span(
+            f"rpc.client:{endpoint}",
+            attrs={"endpoint": endpoint, "address": self.address})
+        frame = {"t": "req", "sid": sid, "ep": endpoint, "payload": payload}
+        if span.ctx is not None:
+            frame["trace"] = span.ctx.to_wire()
         done = False
         try:
+            # Inside the try: a send failure (peer died mid-write) must
+            # still end the span and drop the stream entry in finally.
+            await _send_frame(self._writer, frame, self._lock)
             while True:
                 msg = await q.get()
                 t = msg["t"]
@@ -268,6 +304,7 @@ class RpcClient:
                         raise ConnectionError(msg["error"])
                     raise RpcError(msg["error"])
         finally:
+            span.end(clean=done)
             self._streams.pop(sid, None)
             # Best-effort cancel only if the stream didn't finish cleanly
             # (client walked away mid-stream).

@@ -18,6 +18,26 @@ from dynamo_tpu.runtime.metrics import MetricsRegistry
 
 logger = logging.getLogger(__name__)
 
+# Control-plane prefix where processes advertise their status servers so
+# the metrics_aggregator can scrape /metrics from components that are not
+# workers (router_service, planner) — the reference's Prometheus
+# service-discovery analog, over our own control plane.
+STATUS_ENDPOINTS_PREFIX = "status_endpoints"
+
+
+async def register_status_endpoint(cp, component: str, port: int,
+                                   host: str = "127.0.0.1") -> str:
+    """Advertise a status server for aggregator scraping; returns the
+    key written.  Unleased on purpose: the aggregator treats unreachable
+    targets as gone, so a stale key after a crash is harmless noise.
+    `host` must be a cross-host-routable address when the aggregator
+    runs on another machine (same rule as the worker's --rpc-host)."""
+    import os
+
+    key = f"{STATUS_ENDPOINTS_PREFIX}/{component}/{os.getpid()}"
+    await cp.put(key, {"address": f"{host}:{port}", "component": component})
+    return key
+
 
 class StatusServer:
     def __init__(self,
@@ -38,6 +58,7 @@ class StatusServer:
         app.router.add_get("/health", self._health)
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/debug/traces", self._debug_traces)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -63,3 +84,16 @@ class StatusServer:
         if self.extra_text_fn:
             text += self.extra_text_fn()
         return web.Response(text=text, content_type="text/plain")
+
+    async def _debug_traces(self, req: web.Request) -> web.Response:
+        """This process's completed traces (`?n=K`, default 32); same
+        payload shape as the frontend's /debug/traces so
+        tools/trace_merge.py treats every process uniformly."""
+        from dynamo_tpu.runtime import tracing
+
+        try:
+            n = int(req.query.get("n", "32"))
+        except ValueError:
+            return web.json_response({"error": "n must be an integer"},
+                                     status=400)
+        return web.json_response(tracing.debug_traces_payload(n))

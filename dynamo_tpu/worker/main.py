@@ -105,6 +105,9 @@ def parse_args(argv=None):
                         "routable address (K8s manifests inject the pod "
                         "IP) — the 127.0.0.1 default only works "
                         "single-host")
+    from dynamo_tpu.runtime.tracing import add_trace_args
+
+    add_trace_args(p)
     apply_to_parser_defaults(p, load_layered_config(
         {"control_plane": None, "namespace": "dynamo",
          "component": "backend", "endpoint": "generate",
@@ -261,7 +264,9 @@ async def run_encode(args, cp, runtime) -> None:
 
 async def run(args) -> None:
     from dynamo_tpu import native
+    from dynamo_tpu.runtime.tracing import configure_from_args
 
+    configure_from_args(args, service=f"worker-{args.component}")
     await native.warmup()  # build the C++ hasher off the event loop
     cp = ControlPlaneClient(*_split(args.control_plane))
     await cp.start()
@@ -349,6 +354,12 @@ async def run(args) -> None:
         raise SystemExit(
             f"--role {args.role} requires a real engine (the mocker has "
             "no KV data plane); drop --role or --mocker")
+    # Shared worker registry: request-lifecycle histograms (disagg KV
+    # transfer) + whatever the status server's extra text adds.
+    from dynamo_tpu.runtime.metrics import MetricsRegistry, RequestMetrics
+
+    registry = MetricsRegistry()
+    request_metrics = RequestMetrics(registry)
     if args.role == "decode":
         from dynamo_tpu.llm.disagg import DisaggDecodeClient, disagg_config_key
 
@@ -357,7 +368,7 @@ async def run(args) -> None:
                          {"max_local_prefill_length": args.max_local_prefill})
         disagg_client = DisaggDecodeClient(
             engine, transfer_engine, cp, args.namespace, args.block_size,
-            transfer_plane=transfer_plane)
+            transfer_plane=transfer_plane, request_metrics=request_metrics)
         await disagg_client.start()
         serve_client = disagg_client
     else:
@@ -409,7 +420,8 @@ async def run(args) -> None:
                     lines.append(f"dynamo_worker_engine_{k} {v}")
             return "\n".join(lines) + "\n"
 
-        status = StatusServer(extra_text_fn=worker_metrics_text)
+        status = StatusServer(registry=registry,
+                              extra_text_fn=worker_metrics_text)
         hport = await status.start(port=args.health_port)
         print(f"worker status server on :{hport}", flush=True)
     print(f"worker instance {instance.instance_id} role={args.role} "

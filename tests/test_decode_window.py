@@ -142,7 +142,15 @@ def test_cancel_with_windows_in_flight():
 def test_steady_state_one_sync_per_window_no_recompiles():
     """The ISSUE 2 counting proxy: over >= 20 steady-state window steps,
     at most one host sync per window and ZERO compiled-shape cache
-    misses (the single-step cliff's suspects, now observable)."""
+    misses (the single-step cliff's suspects, now observable).
+
+    Runs with TRACING ENABLED at sampling=1.0 and a bound trace context
+    (the worst case): the absolute counter ceilings below double as the
+    ISSUE 3 "tracing adds zero host syncs" guarantee, and the steady
+    windows must also record ZERO spans — request-lifecycle spans land
+    once at first token (during warmup here), never per window."""
+    from dynamo_tpu.runtime import tracing
+
     K = 2
     core = _engine(
         decode_window=K, window_pipeline_depth=2,
@@ -151,18 +159,32 @@ def test_steady_state_one_sync_per_window_no_recompiles():
             max_prefill_chunk=128,
             decode_buckets=(1, 2, 4, 8), prefill_buckets=(16, 128)),
         num_blocks=128)
-    # Prompt sized so the page-bucket width stays in one power-of-two
-    # band for the whole measured range (a width flip is a legitimate
-    # recompile and would make the zero-miss assertion meaningless).
-    core.add_request("a", list(range(1, 71)), SamplingParams(max_tokens=64))
-    for _ in range(8):  # prefill + window warmup (fills the pipeline)
-        core.step()
-    assert core._inflight, "window pipeline not running after warmup"
+    tracer = tracing.get_tracer()
+    try:
+        tracer.reset()
+        tracer.configure(enabled=True, sampling=1.0)
+        tracer.bind("a", tracing.TraceContext("t-steady", "s0"))
+        # Prompt sized so the page-bucket width stays in one power-of-two
+        # band for the whole measured range (a width flip is a legitimate
+        # recompile and would make the zero-miss assertion meaningless).
+        core.add_request("a", list(range(1, 71)),
+                         SamplingParams(max_tokens=64))
+        for _ in range(8):  # prefill + window warmup (fills the pipeline)
+            core.step()
+        assert core._inflight, "window pipeline not running after warmup"
+        # Warmup recorded the once-per-request lifecycle spans
+        # (queue-wait, prefill, TTFT) and nothing else.
+        assert tracer.spans_recorded == 3, tracer.spans_recorded
 
-    base = core.counters.snapshot()
-    for _ in range(20):
-        core.step()
-    d = core.counters.delta(base)
+        base = core.counters.snapshot()
+        spans0 = tracer.spans_recorded
+        for _ in range(20):
+            core.step()
+        d = core.counters.delta(base)
+        steady_spans = tracer.spans_recorded - spans0
+    finally:
+        tracer.enabled = False
+        tracer.reset()
     assert d["window_dispatches"] == 20, d
     assert d["xla_cache_misses"] == 0, d
     assert d["host_syncs"] <= d["window_dispatches"], d
@@ -170,6 +192,8 @@ def test_steady_state_one_sync_per_window_no_recompiles():
     # (one new page every block_size/K dispatches) touch the device.
     assert d["h2d_uploads"] <= 20 * K // 8 + 1, d
     assert d["single_step_dispatches"] == 0, d
+    # Tracing was on the whole time and added nothing to the window loop.
+    assert steady_spans == 0, steady_spans
 
 
 def test_fused_greedy_single_step_matches_windows():

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Merge per-process /debug/traces buffers into one Perfetto file.
+
+Every traced process (frontend, worker, router_service, planner) keeps a
+bounded ring of completed traces and serves it at `/debug/traces?n=K`.
+This tool pulls those buffers, stitches spans from all processes together
+by trace_id, and writes Chrome trace-event JSON that Perfetto
+(https://ui.perfetto.dev) or chrome://tracing loads directly — one
+process lane per service, one thread lane per request, parented spans
+intact across the frontend → router → RPC → worker → engine path.
+
+    # two processes, most recent 64 traces each, open merged.json in Perfetto
+    python tools/trace_merge.py http://127.0.0.1:8080 http://127.0.0.1:9201 \
+        -o merged.json --n 64
+
+    # offline: previously-saved /debug/traces payloads
+    python tools/trace_merge.py frontend.json worker.json -o merged.json
+
+Sources may be base URLs (the /debug/traces path is appended), full URLs,
+or paths to saved payload files; spans duplicated across payloads (e.g.
+co-located processes sharing a tracer) dedupe by (trace_id, span_id).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.runtime.tracing import chrome_trace  # noqa: E402
+
+
+def fetch_payload(source: str, n: int, timeout: float = 5.0) -> dict:
+    """One /debug/traces payload from a URL or a saved JSON file."""
+    if source.startswith(("http://", "https://")):
+        url = source
+        if "/debug/traces" not in url:
+            url = url.rstrip("/") + f"/debug/traces?n={n}"
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    with open(source) as f:
+        return json.load(f)
+
+
+def merge_payloads(payloads: List[dict]) -> dict:
+    """Merge /debug/traces payloads by trace_id → Chrome trace JSON.
+
+    Only trace_ids seen in MORE than one payload — or in a single-source
+    run, all of them — are interesting, but partial traces (a worker
+    restarted, a ring overflowed) still render; missing parents just
+    show as top-level slices in Perfetto."""
+    by_trace: Dict[str, dict] = {}
+    for payload in payloads:
+        for trace in payload.get("traces", []):
+            tid = trace.get("trace_id")
+            if tid is None:
+                continue
+            merged = by_trace.setdefault(
+                tid, {"trace_id": tid, "spans": [],
+                      "services": set()})
+            merged["spans"].extend(trace.get("spans", []))
+            merged["services"].add(trace.get("service", "dynamo"))
+            if trace.get("forced_slow_sample"):
+                merged["forced_slow_sample"] = True
+    traces = []
+    for merged in by_trace.values():
+        merged["services"] = sorted(merged["services"])
+        merged["spans"].sort(key=lambda s: s.get("ts", 0.0))
+        traces.append(merged)
+    traces.sort(key=lambda t: t["spans"][0]["ts"] if t["spans"] else 0.0)
+    return chrome_trace(traces)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "tools/trace_merge.py", description=__doc__.splitlines()[0])
+    p.add_argument("sources", nargs="+",
+                   help="base URLs (http://host:port), full /debug/traces "
+                        "URLs, or saved payload JSON files")
+    p.add_argument("-o", "--out", default="merged_trace.json",
+                   help="output Chrome trace JSON (default "
+                        "merged_trace.json)")
+    p.add_argument("--n", type=int, default=64,
+                   help="traces to request per process (default 64)")
+    args = p.parse_args(argv)
+
+    payloads = []
+    for src in args.sources:
+        try:
+            payloads.append(fetch_payload(src, args.n))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"warning: skipping {src}: {e}", file=sys.stderr)
+    if not payloads:
+        print("error: no source produced a payload", file=sys.stderr)
+        return 1
+    merged = merge_payloads(payloads)
+    n_spans = sum(1 for ev in merged["traceEvents"] if ev["ph"] == "X")
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    print(f"wrote {args.out}: {n_spans} spans from {len(payloads)} "
+          f"process(es) — open in https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
