@@ -260,14 +260,17 @@ def bench_serving_path(cfg, params, decode_window, n_waves=3):
     n_out = 256
     # Waves use an UNBOUNDED mixed budget so the ramp runs full-batch
     # prefill and the timed decode phase measures the full 64-row fleet
-    # (the r4-comparable serving number).  The interference section below
-    # swaps in the default bounded budget — that is the knob it measures.
+    # (the r4-comparable serving number).  The adaptive mixed controller
+    # is OFF here for the same reason (it would bound the ramp to the
+    # interference target); the interference section below turns it on —
+    # the controller IS the serving default that section measures.
     core = EngineCore(
         EngineConfig(
             model=cfg,
             num_blocks=1 + BATCH * (MAX_PAGES // 8),
             enable_prefix_cache=False,  # distinct prompts; skip hash cost
             decode_window=decode_window,
+            mixed_prefill_adaptive=False,
             scheduler=SchedulerConfig(
                 max_seqs=BATCH, block_size=BLOCK,
                 max_pages_per_seq=MAX_PAGES,
@@ -319,9 +322,15 @@ def bench_serving_path(cfg, params, decode_window, n_waves=3):
     # measures the BOUNDED mixed budget (the serving default).
     import dataclasses as _dc
 
+    from dynamo_tpu.engine.scheduler import MixedPrefillController
+
     core.scheduler.config = _dc.replace(
         core.scheduler.config,
         mixed_prefill_tokens=SchedulerConfig().mixed_prefill_tokens)
+    # Serving default under measurement: the adaptive controller picks
+    # (duty, chunk) per step targeting modeled interference >= 0.85.
+    core._mixed_ctl = MixedPrefillController(
+        floor_tokens=core.scheduler.config.mixed_prefill_floor)
     half = BATCH // 2
     rng = np.random.default_rng(99)
     for i in range(half):

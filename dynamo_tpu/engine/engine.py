@@ -46,6 +46,7 @@ from dynamo_tpu.engine.scheduler import (
     BlockAllocator,
     DecodeWork,
     FinishReason,
+    MixedPrefillController,
     PrefillBatch,
     Request,
     RequestState,
@@ -154,6 +155,14 @@ class EngineConfig:
     # chunks).  The cost is prefill ramp / TTFT under load, which is the
     # Sarathi-style trade: ITL of in-flight streams is the SLA.
     mixed_prefill_duty: int = 2
+    # Adaptive mixed admission (ISSUE 4 satellite): each step a
+    # MixedPrefillController (scheduler.py) picks (duty, chunk budget)
+    # from the MODELED interference ratio — duty/chunk scale with the
+    # live decode fleet instead of the static constants that left r5 at
+    # 0.778.  Window engines only; `mixed_prefill_duty` stays the
+    # fallback when off (or when nothing is decoding).
+    mixed_prefill_adaptive: bool = True
+    mixed_prefill_target: float = 0.85
 
 
 class EngineCore:
@@ -457,6 +466,18 @@ class EngineCore:
         # Mixed-mode duty state: windows dispatched since the last
         # concurrent prefill chunk (see EngineConfig.mixed_prefill_duty).
         self._windows_since_prefill = 0
+        self._mixed_duty = config.mixed_prefill_duty
+        self._mixed_ctl: Optional[MixedPrefillController] = None
+        if config.mixed_prefill_adaptive and config.decode_window > 1:
+            self._mixed_ctl = MixedPrefillController(
+                target=config.mixed_prefill_target,
+                floor_tokens=sched_cfg.mixed_prefill_floor)
+        # Prefill seal-progress sink (disagg eager KV streaming): called
+        # on the engine thread with (request_id, sealed_block_count) as
+        # blocks seal.  Pure host bookkeeping piggybacking on the hashing
+        # _publish_completed_blocks already does — no device work, no
+        # host syncs, no spans.
+        self.seal_sink: Optional[Callable[[str, int], None]] = None
         self.metrics = ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_total_slots=config.scheduler.max_seqs),
@@ -555,6 +576,7 @@ class EngineCore:
             self._lockstep.broadcast({"op": "step"})
         deltas: List[TokenDelta] = []
         self._settle_first_tokens(deltas, block=False)
+        self._plan_mixed_budget()
         plan = self.scheduler.plan()
 
         work = self._window_work(plan)
@@ -579,7 +601,7 @@ class EngineCore:
                 deltas.extend(d)
                 self._windows_since_prefill += 1
                 if (plan.prefill and self._windows_since_prefill
-                        >= self.config.mixed_prefill_duty):
+                        >= self._mixed_duty):
                     # Concurrent bounded prefill behind the window; first
                     # tokens fetch asynchronously (a blocking sample here
                     # would serialize every window behind a device sync).
@@ -618,6 +640,30 @@ class EngineCore:
     def _has_prefill_backlog(self) -> bool:
         return bool(self.scheduler.waiting) or any(
             r.state is RequestState.PREFILL for r in self.scheduler.running)
+
+    def _plan_mixed_budget(self) -> None:
+        """Adaptive mixed-mode admission: consult the controller for this
+        step's (duty, chunk budget) so the MODELED interference ratio
+        holds at/above the target whatever the live decode-fleet size —
+        the static duty/per-row constants undershot at serving geometry
+        (r5: 0.778).  Deterministic from replicated scheduler state, so
+        multihost followers derive identical plans."""
+        if self._mixed_ctl is None:
+            return
+        decoding = sum(1 for r in self.scheduler.running
+                       if r.state is RequestState.DECODE)
+        backlog = sum(len(r.prompt_tokens) - r.prefilled
+                      for r in self.scheduler.running
+                      if r.state is RequestState.PREFILL)
+        backlog += sum(len(r.prompt_tokens) for r in self.scheduler.waiting)
+        if not decoding or not backlog:
+            self.scheduler.mixed_budget_override = None
+            self._mixed_duty = self.config.mixed_prefill_duty
+            return
+        want = min(backlog, self.scheduler.config.max_prefill_chunk)
+        self._mixed_duty, chunk = self._mixed_ctl.plan(
+            decoding, self.config.decode_window, want)
+        self.scheduler.mixed_budget_override = chunk
 
     def _window_work(self, plan) -> Optional[DecodeWork]:
         """Decode work for the window path this iteration, or None when
@@ -1689,6 +1735,11 @@ class EngineCore:
             self._emit(KvCacheEventData.stored(
                 [b.block_hash for b in new], parent_hash=parent))
         self._published_blocks[req.request_id] = len(complete)
+        if self.seal_sink is not None:
+            # Prefill seal-progress stream (disagg eager KV streaming):
+            # fires only when blocks actually sealed, and the sink is a
+            # dict-lookup no-op unless a watcher registered this rid.
+            self.seal_sink(req.request_id, len(complete))
 
     def _publish_removed_blocks(self, req: Request) -> None:
         if not self._kv_event_sink or not self.config.enable_kv_events:
@@ -1717,6 +1768,7 @@ class InferenceEngine:
     def __init__(self, core: EngineCore) -> None:
         self.core = core
         self._queues: Dict[str, asyncio.Queue] = {}
+        self._seal_watchers: Dict[str, asyncio.Queue] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._cmd_lock = threading.Lock()
@@ -1730,6 +1782,7 @@ class InferenceEngine:
 
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
+        self.core.seal_sink = self._on_seal
         self._thread = threading.Thread(
             target=self._run_loop, name="engine-step-loop", daemon=True)
         self._thread.start()
@@ -1832,6 +1885,31 @@ class InferenceEngine:
             with self._cmd_lock:
                 self._pending_cancels.append(request_id)
             self._wake.set()
+
+    # -- prefill seal-progress stream (disagg eager KV streaming) ---------
+
+    def _on_seal(self, request_id: str, sealed_blocks: int) -> None:
+        """Engine-thread callback: forward a request's sealed-block
+        high-water mark to its watcher.  A dict miss (no watcher — the
+        overwhelmingly common case) is zero work, so the steady decode
+        window pays nothing for the stream existing."""
+        q = self._seal_watchers.get(request_id)
+        if q is None or self._loop is None:
+            return
+        self._loop.call_soon_threadsafe(q.put_nowait, sealed_blocks)
+
+    def watch_seals(self, request_id: str) -> asyncio.Queue:
+        """Subscribe to a request's prefill progress: the returned queue
+        yields the count of sealed (hash-registered) prompt blocks so
+        far — what a disagg prefill worker publishes as incremental
+        announcements so decode-side pullers can start streaming KV
+        before the final done message."""
+        q: asyncio.Queue = asyncio.Queue()
+        self._seal_watchers[request_id] = q
+        return q
+
+    def unwatch_seals(self, request_id: str) -> None:
+        self._seal_watchers.pop(request_id, None)
 
     async def run_in_engine(self, fn):
         """Run fn() on the engine thread between steps (cache access must

@@ -23,7 +23,7 @@ import enum
 import logging
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Set
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from dynamo_tpu.engine.sampling import SamplingParams
 
@@ -272,6 +272,60 @@ class SchedulerConfig:
 
 
 @dataclass
+class MixedPrefillController:
+    """Adaptive mixed-mode admission: picks (duty, chunk budget) from the
+    MODELED interference ratio instead of the static
+    `mixed_prefill_duty`/`mixed_prefill_per_row` constants (which left r5
+    at 0.778, under the 0.80 gate floor).
+
+    Model: the decode fleet's work between consecutive prefill chunks is
+    `duty x n_decoding x window` token units; a chunk of C prefill tokens
+    costs `C x cost_ratio` of the same units (cost_ratio = modeled cost
+    of one chunked-prefill token relative to one window-decode token,
+    calibrated so BENCH_r05's geometry — duty 2, 128-token chunks behind
+    32 rows x window 8 — reproduces its measured 0.778).  Modeled
+    interference is then
+
+        duty·n·K / (duty·n·K + C·cost_ratio)
+
+    and the controller returns the smallest duty whose target-respecting
+    budget covers the backlog's desired chunk (fastest prefill cadence at
+    equal modeled interference), else the largest chunk max_duty affords
+    — floored at `floor_tokens` so prefill never starves, accepting
+    below-target interference only when the floor forces it (tiny decode
+    fleets, where absolute decode throughput is small anyway)."""
+
+    target: float = 0.85
+    cost_ratio: float = 1.15
+    max_duty: int = 8
+    floor_tokens: int = 64
+
+    def budget_for(self, duty: int, n_decoding: int, window: int) -> int:
+        """Largest chunk (tokens) whose modeled interference stays at or
+        above target when dispatched behind every `duty`-th window."""
+        w = duty * n_decoding * window
+        return int(w * (1.0 - self.target) / (self.target * self.cost_ratio))
+
+    def modeled_interference(self, duty: int, n_decoding: int, window: int,
+                             chunk_tokens: int) -> float:
+        w = duty * n_decoding * window
+        c = chunk_tokens * self.cost_ratio
+        return w / (w + c) if (w + c) > 0 else 1.0
+
+    def plan(self, n_decoding: int, window: int,
+             want_tokens: int) -> Tuple[int, int]:
+        """(duty, chunk_tokens) for this step's mixed admission."""
+        if n_decoding <= 0 or window <= 0 or want_tokens <= 0:
+            return 1, max(want_tokens, 0)
+        for duty in range(1, self.max_duty + 1):
+            if self.budget_for(duty, n_decoding, window) >= want_tokens:
+                return duty, want_tokens
+        return self.max_duty, max(
+            self.floor_tokens, self.budget_for(self.max_duty,
+                                               n_decoding, window))
+
+
+@dataclass
 class PrefillWork:
     """One prefill chunk for one sequence."""
 
@@ -320,6 +374,11 @@ class Scheduler:
         self.waiting: List[Request] = []
         self.running: List[Request] = []       # PREFILL or DECODE
         self._slots: List[Optional[Request]] = [None] * config.max_seqs
+        # Adaptive mixed-mode budget (engine-installed each step when a
+        # MixedPrefillController runs): replaces the static
+        # mixed_prefill_tokens / per-row slack caps while decode rows are
+        # live.  None = legacy static caps.
+        self.mixed_budget_override: Optional[int] = None
 
     # -- admission --------------------------------------------------------
 
@@ -438,10 +497,15 @@ class Scheduler:
             # Interference bound: with streams decoding, prefill gets at
             # most mixed_prefill_tokens this step, shrunk further to
             # track the decode fleet's own step cost (see SchedulerConfig
-            # mixed_prefill_per_row).
-            slack = max(self.config.mixed_prefill_floor,
-                        self.config.mixed_prefill_per_row * len(decoding))
-            budget = min(budget, self.config.mixed_prefill_tokens, slack)
+            # mixed_prefill_per_row).  The adaptive controller's budget
+            # (MixedPrefillController via the engine) replaces both
+            # static caps when installed.
+            if self.mixed_budget_override is not None:
+                budget = min(budget, max(0, self.mixed_budget_override))
+            else:
+                slack = max(self.config.mixed_prefill_floor,
+                            self.config.mixed_prefill_per_row * len(decoding))
+                budget = min(budget, self.config.mixed_prefill_tokens, slack)
 
         items: List[PrefillWork] = []
         for req in self.running:

@@ -239,22 +239,28 @@ class KvTransferPlane:
 
 async def pull_prefix_device(engine, plane: KvTransferPlane, rpc_client,
                              prompt_tokens: List[int],
-                             block_size: int) -> int:
+                             block_size: int,
+                             covered_tokens: int = 0) -> int:
     """Device-direct onboard of a peer's sealed prompt blocks: request a
     descriptor over the RPC plane, pull device-to-device, inject.  Returns
-    tokens covered; 0 when the peer offered nothing (caller falls back to
-    the host-staged pull or local prefill)."""
+    tokens covered; `covered_tokens` when the peer offered nothing (caller
+    falls back to the host-staged pull or local prefill).
+
+    `covered_tokens`: block-aligned prefix already resident locally (e.g.
+    landed by an eager host-staged stream) — those hashes are neither
+    offered nor pulled, mirroring pull_prefix's resume semantics."""
     from dynamo_tpu.llm.block_manager.transfer import (
         contiguous_prefix, sealed_hashes)
 
     hashes = sealed_hashes(prompt_tokens, block_size)
+    hashes = hashes[covered_tokens // block_size:]
     if not hashes:
-        return 0
+        return covered_tokens
     meta = None
     async for msg in rpc_client.call(KV_OFFER_ENDPOINT, {"hashes": hashes}):
         meta = msg
     if not meta or meta.get("uuid") is None:
-        return 0
+        return covered_tokens
     blocks = await plane.pull(meta)
     # Ack the pull so the holder retires the offer from its outstanding
     # accounting (fire-and-forget: a lost ack only consumes cap slack).
@@ -266,8 +272,8 @@ async def pull_prefix_device(engine, plane: KvTransferPlane, rpc_client,
         pass
     contiguous = contiguous_prefix(hashes, blocks)
     if not contiguous:
-        return 0
+        return covered_tokens
     # Device arrays ride the same inject path (jnp.asarray passes them
     # through without host staging).
     await engine.import_blocks(contiguous)
-    return len(contiguous) * block_size
+    return covered_tokens + len(contiguous) * block_size

@@ -1,0 +1,197 @@
+"""Eager KV-block streaming: pull sealed blocks WHILE remote prefill runs.
+
+The decode side of disaggregated P/D used to be fully serial: await the
+prefill worker's done message, then pull the whole sealed prefix in one
+blocking pass — disagg TTFT paid `prefill + full_transfer`.  The
+reference hides KV movement behind prefill compute by transferring
+layer-wise over NIXL as prefill proceeds (`disagg_serving.md:70-99`);
+our block-hash-addressed analog overlaps it block-wise:
+
+- the prefill worker publishes incremental announcements (sealed-hash
+  high-water mark + its RPC address) as chunks seal (disagg.py
+  `prefill_worker_loop` over the engine's seal-progress stream);
+- the `EagerPuller` here consumes those marks and pulls the newly sealed
+  blocks with bounded in-flight concurrency while remote prefill is
+  still running, injecting contiguous prefixes incrementally via
+  `engine.import_blocks` (extending `pull_prefix`'s `covered_tokens`
+  resume logic);
+- on prefill-done only the residual tail is fetched — TTFT becomes
+  roughly `max(prefill, transfer) + tail`.
+
+Failure semantics keep disagg an optimisation, never a correctness
+dependency: mid-stream death of the prefill worker (`abort()`) leaves
+whatever contiguous prefix already landed injected and registered; the
+caller's local-prefill fallback prefix-matches those blocks and
+recomputes only the rest.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from dynamo_tpu.llm.block_manager.transfer import (
+    EXPORT_BATCH_BLOCKS,
+    fetch_blocks,
+    pull_prefix,
+    sealed_hashes,
+)
+from dynamo_tpu.runtime.rpc import RpcError
+
+logger = logging.getLogger(__name__)
+
+
+class EagerPuller:
+    """Streams one pending request's sealed KV blocks from its prefill
+    worker as seal-progress announcements arrive.
+
+    `rpc_for(address)` returns a (cached) RpcClient for a peer address —
+    the announcements carry the address, so the puller needs no prior
+    knowledge of which worker took the job.  All methods run on the
+    caller's event loop; `on_progress` is synchronous (safe to call from
+    a subscription loop) and only schedules bounded pull tasks.
+    """
+
+    def __init__(self, engine, rpc_for: Callable[[str], object],
+                 prompt_tokens: List[int], block_size: int, *,
+                 max_inflight: int = 2,
+                 batch_blocks: int = EXPORT_BATCH_BLOCKS) -> None:
+        self.engine = engine
+        self._rpc_for = rpc_for
+        self.prompt_tokens = list(prompt_tokens)
+        self.block_size = block_size
+        self.hashes = sealed_hashes(self.prompt_tokens, block_size)
+        self.batch_blocks = max(1, batch_blocks)
+        self._sem = asyncio.Semaphore(max(1, max_inflight))
+        self._tasks: List[asyncio.Task] = []
+        self._ready: Dict[int, np.ndarray] = {}    # block index → data
+        self._inject_lock = asyncio.Lock()
+        self._scheduled = 0        # blocks handed to pull tasks
+        self._closed = False       # abort() called: stop pulling
+        self._announced = False    # finish() entered: no NEW schedules
+        self.covered_blocks = 0    # contiguous prefix injected locally
+        self.streamed_blocks = 0   # blocks fetched by progress-driven pulls
+        self.streamed_bytes = 0
+        # Snapshotted at finish(): what had landed when prefill-done
+        # arrived — the overlap accounting (bytes hidden behind prefill).
+        self.early_blocks = 0
+        self.early_bytes = 0
+
+    @property
+    def covered_tokens(self) -> int:
+        return self.covered_blocks * self.block_size
+
+    @property
+    def overlap_ratio(self) -> float:
+        """Blocks pulled before prefill-done / total sealed blocks (block
+        sizes are uniform, so the block ratio IS the byte ratio)."""
+        return self.early_blocks / len(self.hashes) if self.hashes else 0.0
+
+    # -- streaming (while remote prefill runs) -----------------------------
+
+    def on_progress(self, sealed_blocks: int, address: str) -> None:
+        """A progress announcement landed: schedule pulls for every newly
+        sealed block, in hash-chain order, bounded batches.  No-op once
+        finish()/abort() has begun — a late coalesced announcement must
+        not spawn tasks nobody drains (the residual pull covers those
+        blocks anyway)."""
+        if self._closed or self._announced or not address:
+            return
+        hwm = min(int(sealed_blocks), len(self.hashes))
+        while self._scheduled < hwm:
+            lo = self._scheduled
+            hi = min(hwm, lo + self.batch_blocks)
+            self._scheduled = hi
+            self._tasks.append(asyncio.ensure_future(
+                self._pull_batch(lo, hi, address)))
+
+    async def _pull_batch(self, lo: int, hi: int, address: str) -> None:
+        async with self._sem:
+            if self._closed:
+                return
+            try:
+                blocks = await fetch_blocks(
+                    self._rpc_for(address), self.hashes[lo:hi],
+                    batch=self.batch_blocks)
+            except (ConnectionError, OSError, RpcError) as e:
+                # A failed batch leaves a gap; the residual pass (or the
+                # local-prefill fallback) covers it.
+                logger.warning("eager pull of blocks [%d, %d) from %s "
+                               "failed: %s", lo, hi, address, e)
+                return
+            for j, h in enumerate(self.hashes[lo:hi]):
+                if h not in blocks:
+                    break  # gap inside the batch: keep the prefix only
+                self._ready[lo + j] = blocks[h]
+            self.streamed_blocks += len(blocks)
+            self.streamed_bytes += sum(a.nbytes for a in blocks.values())
+            await self._inject_ready()
+
+    async def _inject_ready(self) -> None:
+        """Inject the longest new contiguous run into the engine's prefix
+        cache.  Serialised: concurrent batch completions must not race
+        the covered_blocks frontier."""
+        async with self._inject_lock:
+            run: Dict[int, np.ndarray] = {}
+            i = self.covered_blocks
+            while i in self._ready:
+                run[self.hashes[i]] = self._ready.pop(i)
+                i += 1
+            if run:
+                await self.engine.import_blocks(run)
+                self.covered_blocks = i
+
+    async def _drain_tasks(self) -> None:
+        while self._tasks:
+            tasks, self._tasks = self._tasks, []
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+    # -- completion / failure ----------------------------------------------
+
+    async def finish(self, address: str) -> int:
+        """Prefill-done: snapshot the overlap, let in-flight pulls land,
+        then fetch ONLY the residual tail (pull_prefix resumes from the
+        contiguous covered prefix).  Returns tokens covered locally.
+        Transfer errors propagate — the caller falls back to local
+        prefill, reusing whatever landed."""
+        from dynamo_tpu.runtime import tracing
+
+        self._announced = True
+        self.early_blocks = min(self.streamed_blocks, len(self.hashes))
+        self.early_bytes = self.streamed_bytes
+        # `with` makes the span task-current: the residual kv.pull_prefix
+        # span (and its rpc children) nest under the overall pull.
+        with tracing.get_tracer().start_span(
+                "kv.pull",
+                attrs={"blocks_total": len(self.hashes),
+                       "blocks_streamed": self.early_blocks,
+                       "bytes_streamed": self.early_bytes}) as span:
+            await self._drain_tasks()
+            await self._inject_ready()
+            self._ready.clear()  # non-contiguous islands: residual refetches
+            covered = await pull_prefix(
+                self.engine, self._rpc_for(address), self.prompt_tokens,
+                self.block_size, covered_tokens=self.covered_tokens)
+            span.set_attr(overlap_ratio=round(self.overlap_ratio, 4),
+                          tokens_covered=covered)
+        self._closed = True  # late announcements are no-ops now
+        return covered
+
+    async def abort(self) -> int:
+        """Mid-stream failure (timeout, dead prefill worker, residual
+        pull error): cancel outstanding pulls, keep the landed contiguous
+        prefix.  Returns tokens covered — already injected + registered,
+        so the caller's local prefill prefix-matches them."""
+        self._closed = True
+        for t in self._tasks:
+            t.cancel()
+        await self._drain_tasks()
+        try:
+            await self._inject_ready()
+        except Exception:
+            logger.exception("eager pull: injecting landed prefix failed")
+        self._ready.clear()
+        return self.covered_tokens

@@ -30,6 +30,12 @@ logger = logging.getLogger(__name__)
 
 KV_BLOCKS_ENDPOINT = "kv_blocks"
 
+# Server-side export batch: each batch is ONE engine-thread command and
+# one burst of wire frames, so a long prefix neither monopolises the
+# engine thread in a single export_blocks call nor materialises every
+# block in memory before the first frame streams out.
+EXPORT_BATCH_BLOCKS = 8
+
 
 def _np_dtype(name: str) -> np.dtype:
     if name == "bfloat16":
@@ -56,30 +62,49 @@ def decode_block(msg: dict) -> tuple:
 def make_kv_blocks_handler(engine):
     """RPC handler streaming resident blocks by hash; register on the
     worker's RpcServer under KV_BLOCKS_ENDPOINT.  `engine` is an
-    InferenceEngine (async export) or anything with `export_blocks`."""
+    InferenceEngine (async export) or anything with `export_blocks`.
+
+    Blocks stream in bounded batches, in request order, and the stream
+    STOPS at the first missing hash: a gap breaks the hash chain, so
+    nothing past it is injectable as a contiguous prefix — shipping it
+    would be wire + export work the peer must discard."""
 
     async def handler(payload: dict):
         hashes = payload.get("hashes", [])
-        blocks = await engine.export_blocks(hashes)
-        for h in hashes:             # preserve request order for streaming
-            data = blocks.get(h)
-            if data is not None:
+        batch = max(1, int(payload.get("batch", EXPORT_BATCH_BLOCKS)))
+        for i in range(0, len(hashes), batch):
+            chunk = hashes[i:i + batch]
+            blocks = await engine.export_blocks(chunk)
+            for h in chunk:          # preserve request order for streaming
+                data = blocks.get(h)
+                if data is None:
+                    return           # hash-chain gap: stop the stream
                 yield encode_block(h, data)
 
     return handler
 
 
-async def fetch_blocks(rpc_client, hashes: Iterable[int],
+async def fetch_blocks(rpc_client, hashes: Iterable[int], *,
+                       batch: int = EXPORT_BATCH_BLOCKS,
                        ) -> Dict[int, np.ndarray]:
-    """Pull blocks from a peer worker; missing hashes are simply absent
-    from the result (the caller prefills them locally)."""
+    """Pull blocks from a peer worker, in request order; hashes from the
+    first gap onward are simply absent from the result (the caller
+    prefills them locally).  The client ABORTS the RPC at the first
+    out-of-order delivery — that is an old gap-skipping server streaming
+    post-gap blocks `contiguous_prefix` could never inject (current
+    servers stop at the gap on their own; see make_kv_blocks_handler)."""
     hashes = list(hashes)
     if not hashes:
         return {}
     out: Dict[int, np.ndarray] = {}
-    async for msg in rpc_client.call(KV_BLOCKS_ENDPOINT, {"hashes": hashes}):
+    idx = 0
+    async for msg in rpc_client.call(KV_BLOCKS_ENDPOINT,
+                                     {"hashes": hashes, "batch": batch}):
         h, arr = decode_block(msg)
+        if idx >= len(hashes) or h != hashes[idx]:
+            break  # generator close sends the RPC cancel frame
         out[h] = arr
+        idx += 1
     return out
 
 

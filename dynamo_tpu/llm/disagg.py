@@ -15,20 +15,28 @@ components, mapped onto our runtime:
   losing the request.
 - **prefill_worker_loop** — pops jobs, runs the prompt through the local
   engine (one token, discarded), which seals + registers the prompt's KV
-  blocks; then announces completion with its RPC address.
+  blocks.  While the prompt prefills, it watches the engine's
+  seal-progress stream (`InferenceEngine.watch_seals`) and publishes
+  incremental announcements — rid, its RPC address, the sealed-hash
+  high-water mark — then announces completion.
 - **DisaggDecodeClient** — decode-side EngineClient wrapper: long prompts
-  are enqueued for remote prefill, completion is awaited, the sealed
-  blocks are pulled over the kv_blocks data plane
-  (block_manager/transfer.py `pull_prefix`), and only then does the local
-  engine run — whose prefix-cache match skips everything but the last
-  partial block.  Remote failure (timeout, dead prefill worker) falls
-  back to local prefill: disagg is an optimisation, never a correctness
+  are enqueued for remote prefill, and an **EagerPuller**
+  (block_manager/eager.py) streams sealed blocks over the kv_blocks data
+  plane WHILE remote prefill runs, so at the done message only the
+  residual tail is pulled — disagg TTFT ≈ max(prefill, transfer) + tail
+  instead of prefill + full_transfer (the reference overlaps its NIXL
+  transfer with prefill compute the same way, layer-wise;
+  `disagg_serving.md:70-99`).  Then the local engine runs — its
+  prefix-cache match skips everything but the last partial block.
+  Remote failure (timeout, dead prefill worker — including MID-STREAM)
+  falls back to local prefill seeded with whatever contiguous prefix
+  already landed: disagg is an optimisation, never a correctness
   dependency (the reference decode handler behaves the same,
   `components/backends/vllm/src/dynamo/vllm/handlers.py:113-146`).
 
 Streaming TTFT is preserved: the decode worker's stream opens immediately;
-the first token arrives after remote-prefill + pull, which replaces the
-(longer) local prefill the client would otherwise wait on.
+the first token arrives after remote-prefill + residual pull, which
+replaces the (longer) local prefill the client would otherwise wait on.
 """
 
 from __future__ import annotations
@@ -49,6 +57,7 @@ from dynamo_tpu.runtime.rpc import RpcClient, RpcError
 logger = logging.getLogger(__name__)
 
 PREFILL_DONE_SUBJECT = "prefill_done"
+PREFILL_PROGRESS_SUBJECT = "prefill_progress"
 
 
 def prefill_queue_name(namespace: str) -> str:
@@ -113,6 +122,27 @@ class DisaggRouter:
         return limit is not None and prompt_len > limit
 
 
+async def _publish_progress(cp, rid: str, address: str,
+                            seal_q: "asyncio.Queue") -> None:
+    """Forward a prefilling prompt's seal high-water marks to the control
+    plane as incremental progress announcements.  Bursts coalesce (only
+    the latest mark publishes); cancellation is silent — prefill
+    finished, and the done message is the final word."""
+    hwm = 0
+    while True:
+        sealed = await seal_q.get()
+        while not seal_q.empty():
+            sealed = max(sealed, seal_q.get_nowait())
+        if sealed <= hwm:
+            continue
+        hwm = sealed
+        await cp.publish(PREFILL_PROGRESS_SUBJECT, {
+            "request_id": rid,
+            "address": address,
+            "sealed_blocks": hwm,
+        })
+
+
 async def prefill_worker_loop(cp, namespace: str, engine_client,
                               address: str, *,
                               visibility_timeout: float = 60.0) -> None:
@@ -122,8 +152,22 @@ async def prefill_worker_loop(cp, namespace: str, engine_client,
     registers every full prompt block) → announce → ack.  Ack comes LAST:
     a crash mid-prefill redelivers the job to a surviving prefill worker
     (at-least-once; re-prefilling an already-sealed prompt is a cheap
-    prefix-cache hit)."""
+    prefix-cache hit).
+
+    Eager KV streaming: while the prompt prefills, the engine's
+    seal-progress stream feeds incremental PREFILL_PROGRESS announcements
+    (rid → sealed-hash high-water mark + this worker's RPC address) so
+    decode-side EagerPullers start pulling sealed blocks before the done
+    message.  Engines without a seal stream (no `watch_seals`) simply
+    skip the announcements — the done message alone reproduces the
+    serial protocol."""
     queue = prefill_queue_name(namespace)
+    # The seal stream lives on the InferenceEngine behind the client
+    # (LocalEngineClient wraps it as `_engine`); duck-typed so wrapped or
+    # bare engines both work and anything else degrades to done-only.
+    seal_engine = getattr(engine_client, "_engine", engine_client)
+    if not hasattr(seal_engine, "watch_seals"):
+        seal_engine = None
     while True:
         # The whole iteration is guarded: an unhandled exception here
         # (control-plane hiccup during pop/publish/ack) would silently
@@ -131,10 +175,15 @@ async def prefill_worker_loop(cp, namespace: str, engine_client,
         try:
             msg_id, job = await cp.queue_pop(queue, visibility_timeout)
             rid = job["request_id"]
+            prid = f"prefill-{rid}"
             t0 = time.monotonic()
+            progress: Optional[asyncio.Task] = None
+            if seal_engine is not None:
+                progress = asyncio.create_task(_publish_progress(
+                    cp, rid, address, seal_engine.watch_seals(prid)))
             try:
                 req = PreprocessedRequest(
-                    request_id=f"prefill-{rid}",
+                    request_id=prid,
                     model=job.get("model", ""),
                     token_ids=list(job["token_ids"]),
                     sampling=SamplingParams(max_tokens=1),
@@ -145,6 +194,16 @@ async def prefill_worker_loop(cp, namespace: str, engine_client,
                 logger.exception("prefill job %s failed (will redeliver)",
                                  rid)
                 continue  # no ack: redelivery after visibility timeout
+            finally:
+                if seal_engine is not None:
+                    seal_engine.unwatch_seals(prid)
+                if progress is not None:
+                    progress.cancel()
+                    # gather(return_exceptions=True) absorbs the child's
+                    # CancelledError / errors but still propagates OUR
+                    # OWN cancellation — a bare `await progress` here
+                    # could swallow the loop's shutdown cancel.
+                    await asyncio.gather(progress, return_exceptions=True)
             await cp.publish(PREFILL_DONE_SUBJECT, {
                 "request_id": rid,
                 "address": address,
@@ -165,13 +224,24 @@ class DisaggDecodeClient:
     def __init__(self, inner, engine, cp, namespace: str,
                  block_size: int, *,
                  prefill_timeout: float = 120.0,
-                 transfer_plane=None, request_metrics=None) -> None:
+                 transfer_plane=None, request_metrics=None,
+                 eager: bool = True, eager_inflight: int = 2,
+                 eager_batch_blocks: int = 8) -> None:
         """`inner`: the local EngineClient; `engine`: the InferenceEngine
         (import_blocks side of the data plane); `transfer_plane`: the
         device-direct KvTransferPlane when this worker runs one — blocks
         then cross device-to-device, the host-staged pull remaining the
         fallback.  `request_metrics`: a runtime.metrics.RequestMetrics —
-        KV-transfer time lands in its kv_transfer_seconds histogram."""
+        KV-transfer time lands in its kv_transfer_seconds histogram and
+        the eager-streaming overlap in kv_transfer_overlap.
+
+        `eager`: stream sealed blocks over the host-staged plane WHILE
+        remote prefill runs (EagerPuller per pending rid, driven by the
+        PREFILL_PROGRESS subscription).  Engages when no transfer_plane
+        is configured — the device-direct plane pulls whole prefixes
+        descriptor-at-a-time on done and stays the faster path where
+        available; composing it with mid-prefill streaming is future
+        work."""
         self.inner = inner
         self.engine = engine
         self.cp = cp
@@ -180,32 +250,45 @@ class DisaggDecodeClient:
         self.prefill_timeout = prefill_timeout
         self.transfer_plane = transfer_plane
         self.request_metrics = request_metrics
+        self.eager = eager
+        self.eager_inflight = eager_inflight
+        self.eager_batch_blocks = eager_batch_blocks
         self.device_pulls = 0
         self._waiters: Dict[str, asyncio.Future] = {}
+        self._pullers: Dict[str, object] = {}   # rid → EagerPuller
         self._rpc_clients: Dict[str, RpcClient] = {}
         self._sub = None
+        self._progress_sub = None
         self._task: Optional[asyncio.Task] = None
+        self._progress_task: Optional[asyncio.Task] = None
         self.router = DisaggRouter(cp, namespace)
         # Observability: how disagg admission went (metrics + tests).
         self.remote_prefills = 0
         self.local_fallbacks = 0
         self.tokens_onboarded = 0
+        self.tokens_streamed = 0        # pulled BEFORE prefill-done
+        self.last_overlap_ratio = 0.0
 
     async def start(self) -> None:
         await self.router.start()
         self._sub = await self.cp.subscribe(PREFILL_DONE_SUBJECT)
         self._task = asyncio.create_task(self._done_loop())
+        self._progress_sub = await self.cp.subscribe(
+            PREFILL_PROGRESS_SUBJECT)
+        self._progress_task = asyncio.create_task(self._progress_loop())
 
     async def stop(self) -> None:
         await self.router.stop()
-        if self._sub:
-            self._sub.cancel()
-        if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
+        for sub in (self._sub, self._progress_sub):
+            if sub:
+                sub.cancel()
+        for task in (self._task, self._progress_task):
+            if task:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
         for c in self._rpc_clients.values():
             await c.close()
 
@@ -214,6 +297,28 @@ class DisaggDecodeClient:
             fut = self._waiters.pop(msg.get("request_id", ""), None)
             if fut and not fut.done():
                 fut.set_result(msg)
+
+    async def _progress_loop(self) -> None:
+        """Route incremental prefill announcements to the pending rid's
+        EagerPuller — unknown rids (another decode worker's request, or
+        one that already completed) cost a dict miss."""
+        try:
+            async for msg in self._progress_sub:
+                try:
+                    puller = self._pullers.get(msg.get("request_id", ""))
+                    if puller is not None:
+                        puller.on_progress(msg.get("sealed_blocks", 0),
+                                           msg.get("address", ""))
+                except Exception:
+                    # One malformed announcement (version-skewed peer)
+                    # must not kill streaming for every future request.
+                    logger.exception("bad prefill-progress message: %r",
+                                     msg)
+        except ConnectionError:
+            # Control plane gone (shutdown / restart): progress simply
+            # stops flowing; pending pulls degrade to done-only, and the
+            # done waiter times out into local fallback on its own.
+            logger.warning("prefill-progress subscription lost")
 
     def _rpc(self, address: str) -> RpcClient:
         client = self._rpc_clients.get(address)
@@ -238,6 +343,18 @@ class DisaggDecodeClient:
             self._waiters.pop(rid, None)
 
     async def _remote_prefill_traced(self, request, rid, fut, span) -> None:
+        puller = None
+        if self.eager and self.transfer_plane is None:
+            from dynamo_tpu.llm.block_manager.eager import EagerPuller
+
+            # Registered BEFORE the queue push: a fast prefill worker's
+            # first progress announcement must find its puller.
+            puller = EagerPuller(
+                self.engine, self._rpc, list(request.token_ids),
+                self.block_size, max_inflight=self.eager_inflight,
+                batch_blocks=self.eager_batch_blocks)
+            self._pullers[rid] = puller
+        settled = False   # success OR handled fallback reached abort()
         try:
             await self.cp.queue_push(prefill_queue_name(self.namespace), {
                 "request_id": rid,
@@ -250,37 +367,56 @@ class DisaggDecodeClient:
             t_pull = time.monotonic()
             onboarded = 0
             path = "host-staged"
-            if self.transfer_plane is not None:
-                # Device-direct first (NIXL-analog pull, no host hop);
-                # any failure falls through to the host-staged plane.
-                from dynamo_tpu.llm.block_manager.device_transfer import (
-                    pull_prefix_device)
+            if puller is not None:
+                # Eager path: whatever streamed during prefill is already
+                # injected; finish() drains in-flight pulls and fetches
+                # only the residual tail.
+                streamed = puller.streamed_blocks * self.block_size
+                onboarded = await puller.finish(done["address"])
+                if streamed:
+                    path = "eager-stream"
+                overlap = puller.overlap_ratio
+                self.tokens_streamed += streamed
+                self.last_overlap_ratio = overlap
+                if self.request_metrics is not None:
+                    self.request_metrics.kv_transfer_overlap.observe(
+                        overlap)
+                span.set_attr(overlap_ratio=round(overlap, 4),
+                              tokens_streamed=streamed)
+            else:
+                if self.transfer_plane is not None:
+                    # Device-direct first (NIXL-analog pull, no host
+                    # hop); any failure falls through to the host-staged
+                    # plane.
+                    from dynamo_tpu.llm.block_manager.device_transfer import (
+                        pull_prefix_device)
 
-                try:
-                    onboarded = await pull_prefix_device(
-                        self.engine, self.transfer_plane,
-                        self._rpc(done["address"]),
-                        list(request.token_ids), self.block_size)
-                except (ConnectionError, OSError, RpcError,
-                        RuntimeError) as e:
-                    logger.warning("device-direct pull %s failed (%s); "
-                                   "using host-staged plane", rid, e)
-                if onboarded:
-                    self.device_pulls += 1
-                    path = "device-direct"
-            sealed = (len(request.token_ids) // self.block_size
-                      * self.block_size)
-            if onboarded < sealed:
-                # Host-staged plane covers what the device pull didn't:
-                # blocks offloaded to G2/G3 live host-side anyway (and a
-                # failed device pull covers nothing).  import skips the
-                # already-onboarded prefix.
-                onboarded = await pull_prefix(
-                    self.engine, self._rpc(done["address"]),
-                    list(request.token_ids), self.block_size,
-                    covered_tokens=onboarded)
+                    try:
+                        onboarded = await pull_prefix_device(
+                            self.engine, self.transfer_plane,
+                            self._rpc(done["address"]),
+                            list(request.token_ids), self.block_size)
+                    except (ConnectionError, OSError, RpcError,
+                            RuntimeError) as e:
+                        logger.warning("device-direct pull %s failed (%s); "
+                                       "using host-staged plane", rid, e)
+                    if onboarded:
+                        self.device_pulls += 1
+                        path = "device-direct"
+                sealed = (len(request.token_ids) // self.block_size
+                          * self.block_size)
+                if onboarded < sealed:
+                    # Host-staged plane covers what the device pull
+                    # didn't: blocks offloaded to G2/G3 live host-side
+                    # anyway (and a failed device pull covers nothing).
+                    # import skips the already-onboarded prefix.
+                    onboarded = await pull_prefix(
+                        self.engine, self._rpc(done["address"]),
+                        list(request.token_ids), self.block_size,
+                        covered_tokens=onboarded)
             self.remote_prefills += 1
             self.tokens_onboarded += onboarded
+            settled = True
             transfer_s = time.monotonic() - t_pull
             if self.request_metrics is not None:
                 self.request_metrics.kv_transfer.observe(
@@ -293,11 +429,32 @@ class DisaggDecodeClient:
                 RpcError) as e:
             # RpcError: the peer's kv_blocks handler failed (e.g. blocks
             # evicted between announce and pull) — disagg is an
-            # optimisation, never a correctness dependency.
+            # optimisation, never a correctness dependency.  A mid-stream
+            # death keeps the landed contiguous prefix: the local prefill
+            # below prefix-matches it and recomputes only the rest.
             self.local_fallbacks += 1
-            span.set_attr(fallback="local", error=type(e).__name__)
-            logger.warning("remote prefill %s failed (%s); prefilling "
-                           "locally", rid, e)
+            landed = 0
+            if puller is not None:
+                landed = await puller.abort()
+                self.tokens_onboarded += landed
+            settled = True
+            span.set_attr(fallback="local", error=type(e).__name__,
+                          landed_tokens=landed)
+            logger.warning(
+                "remote prefill %s failed (%s); prefilling locally"
+                "%s", rid, e,
+                f" (reusing {landed} landed tokens)" if landed else "")
+        finally:
+            self._pullers.pop(rid, None)
+            if puller is not None and not settled:
+                # Unwinding through an unhandled path (cancellation,
+                # unexpected error): the in-flight pull tasks must not
+                # outlive their owner.
+                try:
+                    await puller.abort()
+                except Exception:
+                    logger.exception("eager puller cleanup failed (%s)",
+                                     rid)
 
     async def generate(
         self, request: PreprocessedRequest

@@ -286,6 +286,11 @@ class RequestMetrics:
         self.kv_transfer = registry.histogram(
             "request_kv_transfer_seconds",
             "Disaggregated KV-block onboard time (remote prefill pull)")
+        self.kv_transfer_overlap = registry.histogram(
+            "kv_transfer_overlap",
+            "Fraction of the disagg KV prefix streamed before "
+            "prefill-done (eager-streaming overlap ratio, 0-1)",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
 
 
 class FrontendMetrics:

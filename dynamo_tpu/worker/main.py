@@ -56,12 +56,22 @@ def parse_args(argv=None):
                         "to the control plane at startup; prompts longer "
                         "than this prefill remotely.  The key is watched, "
                         "so operators can retune it live.")
+    p.add_argument("--no-eager-kv", action="store_true",
+                   help="decode role: disable eager KV-block streaming "
+                        "(pull the whole sealed prefix only after the "
+                        "prefill-done announcement, the pre-streaming "
+                        "serial protocol)")
     p.add_argument("--mocker", action="store_true")
     p.add_argument("--model", default=None,
                    help="model preset name (random weights) or HF-layout "
                         "checkpoint directory (real weights + tokenizer)")
     p.add_argument("--num-blocks", type=int, default=512)
     p.add_argument("--block-size", type=int, default=64)
+    p.add_argument("--max-prefill-chunk", type=int, default=512,
+                   help="chunked-prefill step ceiling (tokens).  Prefill "
+                        "workers seal + announce blocks per chunk, so "
+                        "smaller chunks mean finer-grained eager KV "
+                        "streaming at the cost of more prefill steps")
     # Parallelism as a serving capability (reference: one-flag TP,
     # `components/backends/sglang/launch/disagg.sh:25`): degrees multiply
     # to the device count; the worker builds the mesh and the engine
@@ -183,7 +193,9 @@ def run_follower_rank(args) -> None:
                      mesh=build_mesh(args),
                      dp_attention=args.dp_attention,
                      decode_window=args.decode_window,
-                     scheduler=SchedulerConfig(block_size=args.block_size)),
+                     scheduler=SchedulerConfig(
+                         block_size=args.block_size,
+                         max_prefill_chunk=args.max_prefill_chunk)),
         params=params)
     host, port = _split(args.lockstep)
     chan = LockstepFollower(host, port)
@@ -221,7 +233,9 @@ async def build_engine(args, kv_event_sink):
                      mesh=mesh,
                      dp_attention=args.dp_attention,
                      decode_window=args.decode_window,
-                     scheduler=SchedulerConfig(block_size=args.block_size)),
+                     scheduler=SchedulerConfig(
+                         block_size=args.block_size,
+                         max_prefill_chunk=args.max_prefill_chunk)),
         params=params,
         kv_event_sink=kv_event_sink)
     engine = InferenceEngine(core)
@@ -368,7 +382,8 @@ async def run(args) -> None:
                          {"max_local_prefill_length": args.max_local_prefill})
         disagg_client = DisaggDecodeClient(
             engine, transfer_engine, cp, args.namespace, args.block_size,
-            transfer_plane=transfer_plane, request_metrics=request_metrics)
+            transfer_plane=transfer_plane, request_metrics=request_metrics,
+            eager=not args.no_eager_kv)
         await disagg_client.start()
         serve_client = disagg_client
     else:

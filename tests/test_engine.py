@@ -470,6 +470,74 @@ def test_mixed_budget_caps_prefill_when_decoding():
     assert sum(w.length for w in plan.prefill.items) > 8
 
 
+def test_mixed_prefill_controller_modeled_interference():
+    """ISSUE 4 satellite: the adaptive (duty, chunk) controller.  Pure
+    model, CPU-runnable — pins (a) the calibration anchor (the static r5
+    geometry reproduces its measured 0.778), (b) every non-floored plan
+    models at/above the 0.85 target, (c) floor semantics (prefill never
+    starves, even when tiny fleets can't reach the target)."""
+    from dynamo_tpu.engine.scheduler import MixedPrefillController
+
+    ctl = MixedPrefillController()
+    # (a) Calibration: r5 ran duty 2 + 128-token chunks behind 32 rows x
+    # window 8 and measured interference 0.778.
+    assert abs(ctl.modeled_interference(2, 32, 8, 128) - 0.778) < 0.01
+    # (b) The same serving geometry with a deep backlog now plans to the
+    # target instead of undershooting it.
+    duty, chunk = ctl.plan(32, 8, 512)
+    assert chunk >= ctl.floor_tokens
+    assert ctl.modeled_interference(duty, 32, 8, chunk) >= ctl.target
+    # Small backlogs ride the smallest duty that affords them whole.
+    duty_small, chunk_small = ctl.plan(32, 8, 64)
+    assert chunk_small == 64 and duty_small <= duty
+    assert ctl.modeled_interference(duty_small, 32, 8, 64) >= ctl.target
+    # More decode rows afford a faster prefill cadence at equal target.
+    duty_big_fleet, _ = ctl.plan(64, 8, 512)
+    assert duty_big_fleet <= duty
+    # (c) Floor: a tiny fleet can never satisfy the target, but the chunk
+    # bottoms out at floor_tokens (prefill must progress) at max duty.
+    duty_tiny, chunk_tiny = ctl.plan(1, 2, 512)
+    assert chunk_tiny == ctl.floor_tokens and duty_tiny == ctl.max_duty
+    # Degenerate inputs never divide by zero or return negative chunks.
+    assert ctl.plan(0, 8, 512) == (1, 512)
+    assert ctl.plan(32, 8, 0) == (1, 0)
+
+
+def test_adaptive_mixed_budget_drives_scheduler():
+    """The engine installs the controller's chunk budget as the
+    scheduler's mixed-budget override while decoding with a prefill
+    backlog, and clears it when either side empties."""
+    core = small_engine(
+        decode_window=4, window_pipeline_depth=2, num_blocks=128,
+        scheduler=SchedulerConfig(
+            max_seqs=8, block_size=8, max_pages_per_seq=16,
+            max_prefill_chunk=16,
+            decode_buckets=(1, 2, 4, 8), prefill_buckets=(8, 16)))
+    assert core._mixed_ctl is not None  # adaptive is the default
+    core.add_request("dec", list(range(1, 10)),
+                     SamplingParams(max_tokens=48))
+    early: list = []
+    for _ in range(6):   # prefill + enter window mode
+        early.extend(t for d in core.step() for t in d.token_ids)
+    assert core.scheduler.mixed_budget_override is None  # no backlog
+    core.add_request("inj", list(range(20, 44)),
+                     SamplingParams(max_tokens=4))
+    early.extend(t for d in core.step() for t in d.token_ids
+                 if d.request_id == "dec")
+    ov = core.scheduler.mixed_budget_override
+    assert ov is not None and ov >= core.scheduler.config.mixed_prefill_floor
+    assert core._mixed_duty == core._mixed_ctl.max_duty  # tiny fleet: floored
+    out, fin = run_to_completion(core)
+    assert len(early) + len(out["dec"]) == 48 and len(out["inj"]) == 4
+    # Off switch restores the static path.
+    core2 = small_engine(decode_window=4, mixed_prefill_adaptive=False)
+    assert core2._mixed_ctl is None
+    core2.add_request("a", [1, 2, 3], SamplingParams(max_tokens=4))
+    core2.step()
+    assert core2.scheduler.mixed_budget_override is None
+    assert core2._mixed_duty == core2.config.mixed_prefill_duty
+
+
 def test_windows_continue_through_prefill_injection():
     """Decode windows must keep running while injected prompts prefill
     (bounded chunks ride behind each window), and every stream must

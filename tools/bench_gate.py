@@ -165,7 +165,11 @@ def run_smoke(args) -> int:
     4. gate a fabricated regressed run and a fabricated invalid run —
        both must FAIL the gate; an honest run must pass;
     5. bound tracing overhead: steady decode with sampling=1.0 adds no
-       host syncs, no per-window spans, and ≤1% modeled wall time.
+       host syncs, no per-window spans, and ≤1% modeled wall time;
+    6. measure the modeled disagg-TTFT benchmark (real EagerPuller over
+       a mocked seal timeline + wire): eager streaming must hide >= half
+       the transfer behind prefill (transfer_overlap_ratio >= 0.5) and
+       land TTFT near max(prefill, transfer) + tail, not their sum.
     """
     import asyncio
 
@@ -220,6 +224,10 @@ def run_smoke(args) -> int:
     tpu_interfered = dict(
         tpu_good, mixed_prefill_decode={"interference_ratio": 0.70})
 
+    from dynamo_tpu.bench.disagg import run_disagg_ttft_model
+
+    disagg = asyncio.run(asyncio.wait_for(run_disagg_ttft_model(), 120))
+
     checks = {
         "predicted_hit_rate": round(predicted, 4),
         "measured_hit_rate": round(measured, 4),
@@ -232,6 +240,13 @@ def run_smoke(args) -> int:
         "low_mbu_fails": not gate.compare(tpu_low_mbu, tpu_low_mbu).ok,
         "interference_fails": not gate.compare(tpu_interfered,
                                                tpu_interfered).ok,
+        "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),
+        "disagg_ttft_streamed_ms": round(
+            disagg["ttft_streamed_s"] * 1e3, 1),
+        "transfer_overlap_ratio": disagg["overlap_ratio"],
+        "transfer_overlap_ok": disagg["overlap_ratio"] >= 0.5,
+        "disagg_streamed_beats_serial": disagg["streamed_beats_serial"],
+        "disagg_ttft_near_max_bound": disagg["ttft_near_max_bound"],
         **tracing_overhead_checks(),
     }
     ok = all(v is not False for v in checks.values())
