@@ -886,6 +886,13 @@ class EngineCore:
         ks.kv_active_blocks = (self.allocator.num_blocks - 1
                                - self.allocator.free_blocks)
         ks.gpu_cache_usage_perc = self.allocator.usage
+        # Real-engine prefix-cache hit rate (the mocker reported this
+        # from day one; the real engine was dark): fraction of admitted
+        # prompt tokens whose prefill the cache skipped, from the
+        # scheduler's admission-time match accounting.  Host ints only.
+        matched = self.scheduler.prefix_hit_tokens
+        total = matched + self.scheduler.prefix_miss_tokens
+        ks.gpu_prefix_cache_hit_rate = matched / total if total else 0.0
         if self._moe and self.step_count % 32 == 0:
             # Periodic (not per-step: each snapshot syncs the device).
             self.metrics.expert_load = [

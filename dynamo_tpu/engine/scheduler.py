@@ -374,6 +374,15 @@ class Scheduler:
         self.waiting: List[Request] = []
         self.running: List[Request] = []       # PREFILL or DECODE
         self._slots: List[Optional[Request]] = [None] * config.max_seqs
+        # Cumulative admission prefix-match accounting (tokens): hit =
+        # prompt tokens whose prefill the cache skipped, miss = tokens
+        # that had to be computed.  The engine derives
+        # gpu_prefix_cache_hit_rate (ForwardPassMetrics) from these and
+        # KvCacheMetrics exports them as
+        # dynamo_kv_prefix_cache_{hits,misses}_tokens.  Re-admissions
+        # after preemption recount — each admission is a real lookup.
+        self.prefix_hit_tokens = 0
+        self.prefix_miss_tokens = 0
         # Adaptive mixed-mode budget (engine-installed each step when a
         # MixedPrefillController runs): replaces the static
         # mixed_prefill_tokens / per-row slack caps while decode rows are
@@ -435,6 +444,8 @@ class Scheduler:
             # Cached prefix skips prefill compute, but at least the last
             # prompt token is always recomputed so admission yields logits.
             req.prefilled = min(cached_tokens, len(req.prompt_tokens) - 1)
+            self.prefix_hit_tokens += req.prefilled
+            self.prefix_miss_tokens += len(req.prompt_tokens) - req.prefilled
             req.slot = slot
             self._slots[slot] = req
             req.state = RequestState.PREFILL

@@ -81,9 +81,11 @@ def parse_args(argv=None):
                    help="batch mode: JSONL output (default: input + .out)")
     from dynamo_tpu.runtime.config import (
         apply_to_parser_defaults, load_layered_config)
+    from dynamo_tpu.runtime.slo import add_slo_args
     from dynamo_tpu.runtime.tracing import add_trace_args
 
     add_trace_args(p)
+    add_slo_args(p)
     apply_to_parser_defaults(p, load_layered_config(
         {"http_host": "127.0.0.1", "http_port": 8080,
          "control_plane": None, "router_mode": "round_robin",
@@ -288,6 +290,14 @@ def _read_prompt():
         return None
 
 
+async def _cancel_task(task) -> None:
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass
+
+
 async def run_batch(models: ModelManager, batch_file: str,
                     batch_output: str, concurrency: int = 32) -> dict:
     """Offline batch inference (reference `dynamo-run in=batch`,
@@ -372,6 +382,7 @@ async def run(args) -> None:
         args.control_plane = args.control_plane or f"127.0.0.1:{port}"
         print(f"control plane on 127.0.0.1:{port}", flush=True)
 
+    cp_client = None  # set in distributed mode: status-endpoint registration
     if args.out.startswith("dyn://") and not args.mocker:
         # Static remote attachment bypasses discovery entirely
         # (build_model_handle dials the endpoint itself; --mocker is a
@@ -395,6 +406,7 @@ async def run(args) -> None:
                                migration_limit=args.migration_limit)
         await watcher.start()
         shutdowns += [watcher.stop, runtime.shutdown, cp.close]
+        cp_client = cp
         banner = f"discovering models via {args.control_plane}"
     else:
         handle, shutdown = await build_model_handle(args)
@@ -433,8 +445,42 @@ async def run(args) -> None:
             else:
                 batch.cancel()
         else:
+            from dynamo_tpu.runtime.slo import monitor_from_args
+
             svc = HttpService(models)
+            # SLO burn-rate monitor over this frontend's request
+            # histograms (--slo-* flags; /debug/slo + dynamo_slo_*
+            # gauges on /metrics).
+            slo_monitor = monitor_from_args(args, svc.request_metrics,
+                                            registry=svc.registry)
+            if slo_monitor is not None:
+                svc.slo_monitor = slo_monitor
+                slo_monitor.start(interval=args.slo_tick)
+                shutdowns.append(slo_monitor.stop)
             port = await svc.start(args.http_host, args.http_port)
+            if cp_client is not None:
+                # Fleet discovery: the aggregator and `dynamo top` find
+                # this frontend under status_endpoints/ like any worker.
+                # Best-effort with retry — a control plane mid-restart
+                # must not crash the frontend.
+                from dynamo_tpu.runtime.status import (
+                    register_status_endpoint_task)
+
+                adv_host = args.http_host
+                if adv_host in ("0.0.0.0", "::", ""):
+                    # Wildcard binds are not scrapeable addresses; fall
+                    # back to loopback (cross-host fleets should pass a
+                    # routable --http-host, same rule as the worker's
+                    # --rpc-host).
+                    logger.warning(
+                        "--http-host %s is a wildcard bind; advertising "
+                        "127.0.0.1 under status_endpoints/ — pass a "
+                        "routable --http-host for cross-host scraping",
+                        adv_host)
+                    adv_host = "127.0.0.1"
+                reg_task = register_status_endpoint_task(
+                    cp_client, "frontend", port, host=adv_host)
+                shutdowns.append(lambda: _cancel_task(reg_task))
             print(f"dynamo_tpu frontend {banner} "
                   f"on http://{args.http_host}:{port}", flush=True)
             await stop_ev.wait()

@@ -73,6 +73,10 @@ class BlockPool:
         self.on_evict = on_evict
         self.hits = 0
         self.misses = 0
+        # Cumulative LRU evictions (dynamo_kv_evictions_total source —
+        # KvCacheMetrics samples this; admin clear_inactive flushes are
+        # deliberate drops, not pressure, and don't count).
+        self.evictions = 0
 
     # -- views ------------------------------------------------------------
 
@@ -142,6 +146,7 @@ class BlockPool:
         del self.registry.by_hash[h]
         del self._slots[slot.index]
         self._free.append(slot.index)
+        self.evictions += 1
         if self.on_evict:
             self.on_evict(h, slot.index)
 

@@ -114,10 +114,19 @@ EMBED_ENDPOINT = "embed"
 CLEAR_KV_ENDPOINT = "clear_kv"
 
 
-def engine_wire_handler(engine_client) -> Callable:
-    """Wrap any EngineClient as an RPC handler (worker side)."""
+def engine_wire_handler(engine_client, request_metrics=None) -> Callable:
+    """Wrap any EngineClient as an RPC handler (worker side).
+
+    `request_metrics` (runtime/metrics.RequestMetrics): when provided,
+    the handler observes worker-side TTFT / TPOT histograms and terminal
+    outcomes — the worker's own SLO-objective sources, measured at the
+    RPC boundary (excludes frontend queueing, includes engine admission
+    wait).  A few monotonic reads per delta on the event loop; nothing
+    touches the engine thread."""
 
     async def handler(payload: dict) -> AsyncIterator[dict]:
+        import time as _time
+
         from dynamo_tpu.runtime import tracing
 
         req = request_from_wire(payload)
@@ -135,12 +144,37 @@ def engine_wire_handler(engine_client) -> Callable:
         if span is not None:
             tracer.bind(req.request_id, span.ctx)
         n_out = 0
+        start = _time.monotonic()
+        last_t = None
+        finished_ok = None
         try:
             async for delta in engine_client.generate(req):
+                if request_metrics is not None and delta.token_ids:
+                    now = _time.monotonic()
+                    if last_t is None:
+                        request_metrics.ttft.observe(now - start)
+                    else:
+                        request_metrics.tpot.observe(now - last_t)
+                    last_t = now
+                if delta.finished:
+                    finished_ok = delta.finish_reason is not FinishReason.ERROR
                 n_out += len(delta.token_ids)
                 yield delta_to_wire(delta)
+        except (GeneratorExit, asyncio.CancelledError):
+            raise  # client disconnect / teardown: not an engine failure
+        except Exception:
+            # A raising generate() (dead disagg peer, engine fault) IS a
+            # served-request failure — it must burn error-rate budget
+            # even though no ERROR delta was yielded.
+            finished_ok = False
+            raise
         finally:
             tracer.unbind(req.request_id)
+            if request_metrics is not None:
+                # A stream torn down without a terminal delta (client
+                # disconnect mid-generation) is not an engine failure.
+                request_metrics.observe_outcome(
+                    ok=finished_ok if finished_ok is not None else True)
         logger.info("request %s: finished, %d tokens", req.request_id, n_out)
 
     return handler

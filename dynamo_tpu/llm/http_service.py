@@ -45,6 +45,10 @@ class HttpService:
         # Per-request lifecycle histograms (dynamo_request_*): TTFT /
         # TPOT / queue wait, always on (cheap); spans ride the tracer.
         self.request_metrics = RequestMetrics(self.registry)
+        # SLO burn-rate monitor (runtime/slo.py), installed by the
+        # embedding process (frontend main) when --slo-* flags configure
+        # objectives; None → /debug/slo reports enabled=false.
+        self.slo_monitor = None
         self.tracer = tracer or tracing.get_tracer()
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
@@ -55,6 +59,7 @@ class HttpService:
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/metrics", self.prometheus)
         self.app.router.add_get("/debug/traces", self.debug_traces)
+        self.app.router.add_get("/debug/slo", self.debug_slo)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
         self._runner: Optional[web.AppRunner] = None
@@ -166,6 +171,15 @@ class HttpService:
             return self._error(400, "n must be an integer")
         return web.json_response(
             tracing.debug_traces_payload(n, self.tracer))
+
+    async def debug_slo(self, _req: web.Request) -> web.Response:
+        """Current SLO burn-rate evaluation over this frontend's request
+        histograms (runtime/slo.py; enabled via the --slo-* flags)."""
+        from dynamo_tpu.runtime import slo as slo_mod
+
+        if self.slo_monitor is None:
+            return web.json_response(slo_mod.disabled_payload())
+        return web.json_response(self.slo_monitor.payload())
 
     async def list_models(self, _req: web.Request) -> web.Response:
         listing = oai.ModelList(
@@ -661,14 +675,21 @@ class HttpService:
                 last_t = now
                 out = det.push_tokens(delta.token_ids)
                 if out.finished:      # stop string hit mid-stream
+                    self.request_metrics.observe_outcome(ok=True)
                     yield out
                     return
                 if out.text:
                     yield out
             if delta.finished:
+                # Terminal outcome feeds the SLO error-rate objective:
+                # engine ERROR finishes are budget burn, everything else
+                # (stop/length/cancel) is a served request.
+                self.request_metrics.observe_outcome(
+                    ok=delta.finish_reason is not FinishReason.ERROR)
                 yield det.finish(delta.finish_reason)
                 return
         # Engine stream ended without a finished marker (worker died):
+        self.request_metrics.observe_outcome(ok=False)
         yield det.finish(FinishReason.ERROR)
 
     async def _unary_chat(self, handle, body, pre, rid):

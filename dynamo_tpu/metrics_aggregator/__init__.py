@@ -34,17 +34,30 @@ logger = logging.getLogger(__name__)
 HIT_RATE_SUBJECT = "kv_hit_rate"
 STALE_SECS = 30.0
 SCRAPE_INTERVAL = 5.0
+SCRAPE_TIMEOUT = 2.0
+# A target that keeps failing is carried (marked stale) this long after
+# its last success, then dropped entirely.
+STALE_DROP_SECS = 60.0
 
 
 class MetricsAggregator:
     """Subscribes, aggregates, exposes — and scrapes advertised status
-    servers (router_service, planner)."""
+    servers (workers, frontend, router_service, planner)."""
 
-    def __init__(self, cp, scrape_interval: float = SCRAPE_INTERVAL) -> None:
+    def __init__(self, cp, scrape_interval: float = SCRAPE_INTERVAL,
+                 scrape_timeout: float = SCRAPE_TIMEOUT,
+                 stale_drop_secs: float = STALE_DROP_SECS) -> None:
         self.cp = cp
         self.scrape_interval = scrape_interval
-        self._scraped: Dict[str, str] = {}   # address → last /metrics text
+        self.scrape_timeout = scrape_timeout
+        self.stale_drop_secs = stale_drop_secs
+        # address → {"text": last /metrics text, "last_ok": ts,
+        #            "stale": last attempt failed}
+        self._scraped: Dict[str, dict] = {}
         self.registry = MetricsRegistry(prefix="dynamo_aggregate")
+        self._scrape_failures = self.registry.counter(
+            "scrape_failures_total",
+            "Failed /metrics scrapes of advertised status endpoints")
         self._watcher = LoadMetricsWatcher(cp, stale_secs=STALE_SECS,
                                            name="aggregator")
         self._tasks = []
@@ -107,49 +120,69 @@ class MetricsAggregator:
             except Exception:
                 logger.exception("bad kv_hit_rate payload")
 
-    async def _scrape_loop(self) -> None:
-        """Pull `/metrics` from every status server advertised under
-        `status_endpoints/` (runtime/status.register_status_endpoint).
-        Unreachable targets drop from the cache — a crashed router or
-        planner must not leave frozen series in the aggregate."""
+    async def _scrape_once(self) -> None:
+        """One sweep of `/metrics` from every status server advertised
+        under `status_endpoints/` (runtime/status.register_status_endpoint).
+
+        Failure policy (a crashed worker must be VISIBLE, not silently
+        flat): a failed target increments
+        `dynamo_aggregate_scrape_failures_total`, its last-good series
+        stay in the exposition behind a STALE comment for
+        `stale_drop_secs` after the last success, and only then drop.
+        Targets no longer advertised drop immediately."""
         import aiohttp
 
         from dynamo_tpu.runtime.status import STATUS_ENDPOINTS_PREFIX
 
+        entries = await self.cp.get_prefix(f"{STATUS_ENDPOINTS_PREFIX}/")
+        addrs = sorted({
+            entry["address"] for entry in entries.values()
+            if isinstance(entry, dict) and entry.get("address")})
+        results = []
+        if addrs:
+            # Per-endpoint timeout: one hung target must not consume the
+            # sweep's whole budget and starve the others.
+            timeout = aiohttp.ClientTimeout(total=self.scrape_timeout)
+
+            async def fetch(s, addr):
+                try:
+                    async with s.get(f"http://{addr}/metrics",
+                                     timeout=timeout) as resp:
+                        if resp.status == 200:
+                            return addr, await resp.text()
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError):
+                    pass
+                return addr, None
+
+            # Concurrent fetches: registration keys are unleased
+            # (stale ones accumulate across restarts), so one
+            # sweep must cost ~one timeout total, not one per
+            # dead address serially.
+            async with aiohttp.ClientSession(timeout=timeout) as s:
+                results = await asyncio.gather(
+                    *(fetch(s, a) for a in addrs))
+        now = time.monotonic()
+        fresh: Dict[str, dict] = {}
+        for addr, text in results:
+            if text is not None:
+                fresh[addr] = {"text": text, "last_ok": now,
+                               "stale": False}
+                continue
+            self._scrape_failures.inc(labels={"endpoint": addr})
+            prev = self._scraped.get(addr)
+            if prev is not None and (now - prev["last_ok"]
+                                     <= self.stale_drop_secs):
+                fresh[addr] = dict(prev, stale=True)
+        self._scraped = fresh
+
+    async def _scrape_loop(self) -> None:
         while True:
             # The whole iteration is guarded (like _pump_hits): one
             # malformed status_endpoints entry or transient session
             # error must not silently kill scraping forever.
             try:
-                entries = await self.cp.get_prefix(
-                    f"{STATUS_ENDPOINTS_PREFIX}/")
-                addrs = sorted({
-                    entry["address"] for entry in entries.values()
-                    if isinstance(entry, dict) and entry.get("address")})
-                fresh: Dict[str, str] = {}
-                if addrs:
-                    timeout = aiohttp.ClientTimeout(total=2.0)
-
-                    async def fetch(s, addr):
-                        try:
-                            async with s.get(
-                                    f"http://{addr}/metrics") as resp:
-                                if resp.status == 200:
-                                    return addr, await resp.text()
-                        except (aiohttp.ClientError, asyncio.TimeoutError,
-                                OSError):
-                            pass  # gone → dropped from the aggregate
-                        return None
-
-                    # Concurrent fetches: registration keys are unleased
-                    # (stale ones accumulate across restarts), so one
-                    # sweep must cost ~one 2 s timeout total, not 2 s per
-                    # dead address serially.
-                    async with aiohttp.ClientSession(timeout=timeout) as s:
-                        results = await asyncio.gather(
-                            *(fetch(s, a) for a in addrs))
-                    fresh = dict(r for r in results if r is not None)
-                self._scraped = fresh
+                await self._scrape_once()
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -205,9 +238,15 @@ class MetricsAggregator:
         self._refresh_gauges()
         text = self.registry.expose()
         seen_meta: set = set()
+        now = time.monotonic()
         for addr in sorted(self._scraped):
-            text += (f"# scraped from {addr}\n"
-                     + self._relabel(self._scraped[addr], addr, seen_meta))
+            entry = self._scraped[addr]
+            header = f"# scraped from {addr}\n"
+            if entry.get("stale"):
+                age = now - entry["last_ok"]
+                header = (f"# scraped from {addr} "
+                          f"(STALE: last success {age:.1f}s ago)\n")
+            text += header + self._relabel(entry["text"], addr, seen_meta)
         return text
 
 

@@ -35,6 +35,13 @@ def main(argv=None) -> None:
                    help="sla: target inter-token latency (s)")
     p.add_argument("--metrics-url", default=None,
                    help="sla: frontend /metrics URL to observe")
+    p.add_argument("--slo-url", default=None,
+                   help="load mode: a /debug/slo URL (frontend or "
+                        "worker) whose burn rate biases scale-up "
+                        "(runtime/slo.py)")
+    p.add_argument("--slo-burn-scale-up", type=float, default=2.0,
+                   help="fast-window burn rate at or above which the "
+                        "load planner scales up regardless of KV usage")
     p.add_argument("--prefill-worker-args", default=None,
                    help="sla: comma-joined args for the prefill pool "
                         "(omit for aggregated deployments)")
@@ -88,7 +95,9 @@ def main(argv=None) -> None:
                 min_replicas=args.min_replicas,
                 max_replicas=args.max_replicas,
                 kv_high=args.kv_high, kv_low=args.kv_low,
-                adjustment_interval=args.adjustment_interval))
+                adjustment_interval=args.adjustment_interval,
+                slo_burn_scale_up=args.slo_burn_scale_up),
+                slo_url=args.slo_url)
         await planner.start()
         status = None
         if args.metrics_port >= 0:

@@ -27,19 +27,38 @@ class PlannerConfig:
     adjustment_interval: float = 5.0
     metrics_stale_secs: float = 10.0
     predictor: str = "moving_average"
+    # SLO bias (runtime/slo.py): when a watched /debug/slo reports a
+    # fast-window burn rate at or above this, scale up even though KV
+    # usage looks fine — latency SLOs burn before memory fills (the
+    # AIBrix-style signal the load moving-average can't see).  Scale-
+    # DOWN is additionally vetoed while any burn is >= 1.0 (actively
+    # consuming budget is the wrong moment to shed capacity).
+    slo_burn_scale_up: float = 2.0
+    # A /debug/slo payload older than this exerts no pressure: a crashed
+    # SLO source must not pin the fleet at max_replicas forever on its
+    # last (possibly mid-incident) reading.
+    slo_stale_secs: float = 60.0
 
 
 class LoadPlanner:
     """Watches `load_metrics`, steps a replica target, drives a connector.
 
     `connector` contract: `replicas() -> int` (current), plus
-    `add_worker()` / `remove_worker()` (one step each, async)."""
+    `add_worker()` / `remove_worker()` (one step each, async).
+
+    `slo_url`: a /debug/slo endpoint (frontend or worker) polled each
+    adjustment interval; its burn rates bias scaling per
+    PlannerConfig.slo_burn_scale_up."""
 
     def __init__(self, cp, connector,
-                 config: Optional[PlannerConfig] = None) -> None:
+                 config: Optional[PlannerConfig] = None,
+                 slo_url: Optional[str] = None) -> None:
         self.cp = cp
         self.connector = connector
         self.config = config or PlannerConfig()
+        self.slo_url = slo_url
+        self._slo: Optional[dict] = None       # last /debug/slo payload
+        self._slo_ts: float = 0.0              # when it was fetched
         self._watcher = LoadMetricsWatcher(
             cp, stale_secs=self.config.metrics_stale_secs, name="planner")
         self._usage_pred = make_predictor(self.config.predictor)
@@ -69,6 +88,18 @@ class LoadPlanner:
         waiting = sum(m.worker_stats.num_requests_waiting for m in fresh)
         return len(fresh), usage, waiting
 
+    def slo_pressure(self) -> float:
+        """Worst fast-window burn rate from the last /debug/slo poll
+        (0.0 with no SLO source configured, monitor disabled, or a
+        payload past slo_stale_secs — dead sources stop steering)."""
+        from dynamo_tpu.runtime.slo import max_burn
+
+        if (self._slo is not None
+                and time.monotonic() - self._slo_ts
+                > self.config.slo_stale_secs):
+            return 0.0
+        return max_burn(self._slo)
+
     def plan_step(self) -> Optional[str]:
         """One planning decision from current predictions; returns
         "up" | "down" | None.  Synchronous and side-effect-free on the
@@ -77,6 +108,12 @@ class LoadPlanner:
         if replicas < self.config.min_replicas:
             # Floor check needs no observations — it's how the fleet
             # bootstraps (no worker yet → no metrics yet).
+            return "up"
+        burn = self.slo_pressure()
+        if (burn >= self.config.slo_burn_scale_up
+                and replicas < self.config.max_replicas):
+            # SLO bias: budget is burning NOW; don't wait for the KV
+            # moving-average to catch up.
             return "up"
         obs = self._observe()
         if obs is None:
@@ -90,18 +127,38 @@ class LoadPlanner:
                 and replicas < self.config.max_replicas):
             return "up"
         # Scale down only if the survivors could absorb the load under
-        # kv_low: usage*n / (n-1) stays below the low-water mark.
+        # kv_low: usage*n / (n-1) stays below the low-water mark — and
+        # never while an SLO is actively burning budget.
         if (replicas > self.config.min_replicas and p_waiting < 1.0
-                and n_reporting > 1
+                and n_reporting > 1 and burn < 1.0
                 and p_usage * n_reporting / (n_reporting - 1)
                 < self.config.kv_low):
             return "down"
         return None
 
+    async def _fetch_slo(self) -> None:
+        """Refresh the /debug/slo view; keeps the last payload on
+        transient fetch errors (stale pressure beats none mid-incident)."""
+        if not self.slo_url:
+            return
+        import aiohttp
+
+        try:
+            timeout = aiohttp.ClientTimeout(total=2.0)
+            async with aiohttp.ClientSession(timeout=timeout) as s:
+                async with s.get(self.slo_url) as resp:
+                    if resp.status == 200:
+                        self._slo = await resp.json()
+                        self._slo_ts = time.monotonic()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            logger.debug("slo poll of %s failed; keeping last payload",
+                         self.slo_url)
+
     async def _loop(self) -> None:
         while True:
             await asyncio.sleep(self.config.adjustment_interval)
             try:
+                await self._fetch_slo()
                 decision = self.plan_step()
                 if decision == "up":
                     self.decisions.append((time.monotonic(), "up",
@@ -119,9 +176,13 @@ class LoadPlanner:
                 logger.exception("planner: adjustment failed; continuing")
 
     def _reason(self) -> str:
-        return (f"usage~{self._usage_pred.predict_next():.2f} "
-                f"waiting~{self._waiting_pred.predict_next():.1f} "
-                f"replicas={self.connector.replicas()}")
+        reason = (f"usage~{self._usage_pred.predict_next():.2f} "
+                  f"waiting~{self._waiting_pred.predict_next():.1f} "
+                  f"replicas={self.connector.replicas()}")
+        burn = self.slo_pressure()
+        if burn > 0:
+            reason += f" slo_burn~{burn:.1f}"
+        return reason
 
 
 def planner_metrics_text(planner, connector) -> str:

@@ -33,8 +33,16 @@ def _label_key(labels: Optional[Dict[str, str]]) -> LabelKey:
     return tuple(sorted((labels or {}).items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-exposition escaping: inside a label value, `\\`,
+    `"` and newline must be escaped or the whole exposition is invalid
+    (a scraper rejects every series, not just the bad one)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(key: LabelKey, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in key]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in key]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -56,7 +64,9 @@ class Counter:
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for k, v in sorted(self._values.items()):
+        with self._lock:  # concurrent inc() must not tear the snapshot
+            values = sorted(self._values.items())
+        for k, v in values:
             out.append(f"{self.name}{_fmt_labels(k)} {v}")
         return out
 
@@ -81,7 +91,9 @@ class Gauge:
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for k, v in sorted(self._values.items()):
+        with self._lock:  # concurrent set()/add() must not tear the snapshot
+            values = sorted(self._values.items())
+        for k, v in values:
             out.append(f"{self.name}{_fmt_labels(k)} {v}")
         return out
 
@@ -118,8 +130,41 @@ class Histogram:
         """NaN on an empty label set (never raises): 0.0 read as "zero
         latency" by the SLA planner's arithmetic; NaN propagates as
         "no data" and comparisons against it are False."""
-        n = self.count(labels)
-        return self.sum(labels) / n if n else float("nan")
+        k = _label_key(labels)
+        with self._lock:  # count and sum must come from one snapshot
+            n = self._total.get(k, 0)
+            s = self._sum.get(k, 0.0)
+        return s / n if n else float("nan")
+
+    # -- label-aggregated views (SLO burn-rate sources) --------------------
+
+    def total_count(self) -> int:
+        """Observations across ALL label sets."""
+        with self._lock:
+            return sum(self._total.values())
+
+    def total_sum(self) -> float:
+        with self._lock:
+            return sum(self._sum.values())
+
+    def total_mean(self) -> float:
+        """Mean across all label sets; NaN when empty (same "no data"
+        propagation contract as `mean`)."""
+        with self._lock:
+            n = sum(self._total.values())
+            s = sum(self._sum.values())
+        return s / n if n else float("nan")
+
+    def count_le(self, value: float) -> int:
+        """Observations known to be <= `value`, across all label sets —
+        the cumulative count at the largest bucket bound <= `value`
+        (matching the `le` cumulative the exposition prints).  Bucket
+        granularity: observations in the bucket CONTAINING a mid-bucket
+        `value` are excluded (conservative for SLO accounting — they
+        count as bad); pick thresholds at bucket bounds for exactness."""
+        idx = bisect_right(self.buckets, value)
+        with self._lock:
+            return sum(sum(c[:idx]) for c in self._counts.values())
 
     def quantile(self, q: float, labels: Optional[Dict[str, str]] = None) -> float:
         """Approximate quantile from bucket counts (upper bound of the
@@ -148,16 +193,23 @@ class Histogram:
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} histogram"]
-        for k in sorted(self._counts):
+        with self._lock:
+            # Snapshot under the lock: a concurrent observe() between
+            # reading _counts and _sum would emit torn cumulative counts
+            # (bucket cum > _count, or _sum missing the observation).
+            snap = {k: (list(self._counts[k]), self._sum[k])
+                    for k in self._counts}
+        for k in sorted(snap):
+            counts, total_sum = snap[k]
             cum = 0
             for i, b in enumerate(self.buckets):
-                cum += self._counts[k][i]
+                cum += counts[i]
                 le = _fmt_labels(k, 'le="%s"' % b)
                 out.append(f"{self.name}_bucket{le} {cum}")
-            cum += self._counts[k][-1]
+            cum += counts[-1]
             le_inf = _fmt_labels(k, 'le="+Inf"')
             out.append(f"{self.name}_bucket{le_inf} {cum}")
-            out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]}")
+            out.append(f"{self.name}_sum{_fmt_labels(k)} {total_sum}")
             out.append(f"{self.name}_count{_fmt_labels(k)} {cum}")
         return out
 
@@ -291,6 +343,15 @@ class RequestMetrics:
             "Fraction of the disagg KV prefix streamed before "
             "prefill-done (eager-streaming overlap ratio, 0-1)",
             buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
+        # status="ok"|"error" — the SLO monitor's error-rate objective
+        # source (runtime/slo.py), observed where the stream finishes
+        # (frontend token stream; worker engine_wire_handler).
+        self.outcomes = registry.counter(
+            "request_outcomes_total",
+            "Finished requests by terminal status (ok|error)")
+
+    def observe_outcome(self, ok: bool) -> None:
+        self.outcomes.inc(labels={"status": "ok" if ok else "error"})
 
 
 class FrontendMetrics:
@@ -316,3 +377,213 @@ class FrontendMetrics:
         self.output_tokens = registry.histogram(
             "frontend_output_sequence_tokens", "Output tokens per request",
             buckets=(1, 4, 16, 64, 256, 1024, 4096))
+
+
+class KvCacheMetrics:
+    """Memory-plane telemetry: the capacity-side series KVCache-centric
+    schedulers and SLO-driven autoscalers treat as first-class inputs.
+
+    Series (labels `tier` = device|host|disk, `pool` = pool name):
+
+    - `dynamo_kv_pool_{capacity,active,reusable,free}_blocks` — gauges
+      sampled from `BlockPool` occupancy views;
+    - `dynamo_kv_evictions_total` — LRU evictions per pool;
+    - `dynamo_kv_prefix_cache_{hits,misses}_tokens` — prompt tokens
+      served from / missed by the prefix cache at admission;
+    - `dynamo_hbm_{used,limit}_bytes` (labels `device`, `kind`) —
+      per-accelerator HBM occupancy, fed by `HbmPoller`.
+
+    Pull-based: `observe_*` SAMPLES host-side integers the pools and
+    scheduler already maintain — called at scrape/pump time off the
+    engine thread, so the steady decode window pays zero added host
+    syncs and zero dispatches for the telemetry existing (pinned by
+    tests/test_kv_metrics.py and `bench_gate --smoke`)."""
+
+    def __init__(self, registry: MetricsRegistry) -> None:
+        self.pool_capacity = registry.gauge(
+            "kv_pool_capacity_blocks", "KV pool slot capacity")
+        self.pool_active = registry.gauge(
+            "kv_pool_active_blocks", "KV slots pinned by live sequences")
+        self.pool_reusable = registry.gauge(
+            "kv_pool_reusable_blocks",
+            "Allocatable slots (free + evictable inactive)")
+        self.pool_free = registry.gauge(
+            "kv_pool_free_blocks", "Slots on the free list")
+        self.evictions = registry.counter(
+            "kv_evictions_total", "Registered blocks LRU-evicted")
+        self.prefix_hits = registry.counter(
+            "kv_prefix_cache_hits_tokens",
+            "Prompt tokens served from the prefix cache at admission")
+        self.prefix_misses = registry.counter(
+            "kv_prefix_cache_misses_tokens",
+            "Prompt tokens that missed the prefix cache at admission")
+        self.hbm_used = registry.gauge(
+            "hbm_used_bytes", "Accelerator memory in use")
+        self.hbm_limit = registry.gauge(
+            "hbm_limit_bytes", "Accelerator memory capacity")
+        # Cumulative-source high-water marks: counters can only inc, so
+        # sampled monotonic ints (pool.evictions, scheduler token
+        # counters) convert to increments by delta from the last sample.
+        self._last: Dict[tuple, float] = {}
+
+    def _inc_to(self, counter: Counter, labels: Dict[str, str],
+                cum: float) -> None:
+        key = (counter.name, _label_key(labels))
+        prev = self._last.get(key, 0.0)
+        if cum < prev:
+            prev = 0.0  # source restarted (fresh pool/engine)
+        if cum > prev:
+            counter.inc(cum - prev, labels=labels)
+        self._last[key] = cum
+
+    def observe_pool(self, pool, tier: str) -> None:
+        """Sample one BlockPool's occupancy + eviction counters."""
+        labels = {"tier": tier, "pool": pool.name}
+        self.pool_capacity.set(pool.capacity, labels=labels)
+        self.pool_active.set(pool.active_slots, labels=labels)
+        self.pool_reusable.set(pool.reusable_slots, labels=labels)
+        self.pool_free.set(pool.free_slots, labels=labels)
+        self._inc_to(self.evictions, labels, pool.evictions)
+
+    def observe_engine(self, core) -> None:
+        """Sample an EngineCore's block source (all tiers) and the
+        scheduler's admission prefix-match counters.  Reads host-side
+        ints only — never device arrays — so it is safe to call from a
+        scrape thread while the engine steps."""
+        alloc = core.allocator
+        manager = getattr(alloc, "manager", None)
+        if manager is not None:
+            self.observe_pool(manager.device, "device")
+            if manager.host is not None:
+                self.observe_pool(manager.host, "host")
+            if manager.disk is not None:
+                self.observe_pool(manager.disk, "disk")
+            device_pool = manager.device.name
+        else:
+            # Plain free-list allocator: no pool object, synthesize the
+            # device-tier gauges from its counts (no reuse → active =
+            # allocated, reusable = free).
+            labels = {"tier": "device", "pool": "plain"}
+            cap = alloc.num_blocks - 1
+            free = alloc.free_blocks
+            self.pool_capacity.set(cap, labels=labels)
+            self.pool_active.set(cap - free, labels=labels)
+            self.pool_reusable.set(free, labels=labels)
+            self.pool_free.set(free, labels=labels)
+            device_pool = "plain"
+        sched = getattr(core, "scheduler", None)
+        if sched is not None:
+            labels = {"tier": "device", "pool": device_pool}
+            self._inc_to(self.prefix_hits, labels,
+                         getattr(sched, "prefix_hit_tokens", 0))
+            self._inc_to(self.prefix_misses, labels,
+                         getattr(sched, "prefix_miss_tokens", 0))
+
+
+class HbmPoller:
+    """Slow-poll thread feeding `dynamo_hbm_{used,limit}_bytes` from
+    `jax.local_devices()[i].memory_stats()`.
+
+    Off the engine thread by construction (its own daemon thread), and
+    `memory_stats()` is a PJRT host-side query — no device dispatch, no
+    sync injected into the step loop.  Backends without memory stats
+    (CPU) fall back to process RSS / system RAM under
+    `device="host", kind="cpu"`, so the series family exists everywhere
+    and `dynamo top` renders uniformly."""
+
+    def __init__(self, metrics: KvCacheMetrics,
+                 interval: float = 10.0) -> None:
+        self.metrics = metrics
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def poll_once(self) -> int:
+        """One sample of every local device; returns the number of
+        devices that reported real memory stats (0 → fallback used)."""
+        devices = []
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:  # pre-init failure / no backend: fallback below
+            devices = []
+        reported = 0
+        for i, dev in enumerate(devices):
+            stats = None
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats or "bytes_in_use" not in stats:
+                continue
+            labels = {"device": str(i),
+                      "kind": getattr(dev, "platform", "unknown")}
+            self.metrics.hbm_used.set(stats["bytes_in_use"], labels=labels)
+            limit = stats.get("bytes_limit") or stats.get(
+                "bytes_reservable_limit")
+            if limit:
+                self.metrics.hbm_limit.set(limit, labels=labels)
+            reported += 1
+        if not reported:
+            self._poll_host_fallback()
+        return reported
+
+    @staticmethod
+    def _current_rss_bytes() -> Optional[int]:
+        """CURRENT resident set, not getrusage's lifetime high-water
+        mark (a gauge fed by ru_maxrss could never decrease — one model-
+        load spike would read as a permanently full host; and ru_maxrss
+        units are platform-dependent: KB on Linux, bytes on macOS)."""
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            import os
+
+            return pages * os.sysconf("SC_PAGE_SIZE")
+        except Exception:
+            pass
+        try:  # non-Linux fallback: the peak is better than nothing
+            import resource
+            import sys
+
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            return rss if sys.platform == "darwin" else rss * 1024
+        except Exception:
+            return None
+
+    def _poll_host_fallback(self) -> None:
+        labels = {"device": "host", "kind": "cpu"}
+        rss = self._current_rss_bytes()
+        if rss is None:
+            return
+        self.metrics.hbm_used.set(rss, labels=labels)
+        try:
+            import os
+
+            total = (os.sysconf("SC_PHYS_PAGES")
+                     * os.sysconf("SC_PAGE_SIZE"))
+            self.metrics.hbm_limit.set(total, labels=labels)
+        except (ValueError, OSError, AttributeError):
+            pass
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="hbm-poll", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # telemetry must never kill the process
+                pass
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

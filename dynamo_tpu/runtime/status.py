@@ -39,17 +39,52 @@ async def register_status_endpoint(cp, component: str, port: int,
     return key
 
 
+def register_status_endpoint_task(cp, component: str, port: int,
+                                  host: str = "127.0.0.1",
+                                  retry_interval: float = 1.0):
+    """Best-effort registration as a background task: retries until the
+    put lands (the control-plane client reconnects underneath), so a
+    control plane that is briefly down at process startup neither
+    crashes the process nor silently loses its discovery entry.  Returns
+    the task (cancel at shutdown)."""
+    import asyncio
+
+    async def register():
+        while True:
+            try:
+                await register_status_endpoint(cp, component, port,
+                                               host=host)
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # ANY failure retries (ConnectionError while down,
+                # RuntimeError from an error reply mid-restart, …): a
+                # dead registration task would silently drop this
+                # process from fleet discovery forever.
+                logger.warning(
+                    "status-endpoint registration for %s failed (%s); "
+                    "retrying", component, e)
+                await asyncio.sleep(retry_interval)
+
+    return asyncio.get_running_loop().create_task(register())
+
+
 class StatusServer:
     def __init__(self,
                  registry: Optional[MetricsRegistry] = None,
                  ready_fn: Optional[Callable[[], bool]] = None,
-                 extra_text_fn: Optional[Callable[[], str]] = None) -> None:
+                 extra_text_fn: Optional[Callable[[], str]] = None,
+                 slo_fn: Optional[Callable[[], dict]] = None) -> None:
         """`ready_fn`: readiness probe (default: always ready once
         serving).  `extra_text_fn`: extra Prometheus text appended to the
-        registry exposition (e.g. the worker's ForwardPassMetrics)."""
+        registry exposition (e.g. the worker's ForwardPassMetrics).
+        `slo_fn`: /debug/slo payload provider (an SloMonitor's `payload`;
+        None reports the monitor as disabled)."""
         self.registry = registry or MetricsRegistry()
         self.ready_fn = ready_fn or (lambda: True)
         self.extra_text_fn = extra_text_fn
+        self.slo_fn = slo_fn
         self._runner: Optional[web.AppRunner] = None
         self.port: Optional[int] = None
 
@@ -59,6 +94,7 @@ class StatusServer:
         app.router.add_get("/live", self._live)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/debug/traces", self._debug_traces)
+        app.router.add_get("/debug/slo", self._debug_slo)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -97,3 +133,13 @@ class StatusServer:
             return web.json_response({"error": "n must be an integer"},
                                      status=400)
         return web.json_response(tracing.debug_traces_payload(n))
+
+    async def _debug_slo(self, _req: web.Request) -> web.Response:
+        """Current SLO burn-rate evaluation (runtime/slo.py) — same
+        payload shape as the frontend's /debug/slo so `dynamo top`
+        treats every process uniformly."""
+        from dynamo_tpu.runtime import slo as slo_mod
+
+        if self.slo_fn is None:
+            return web.json_response(slo_mod.disabled_payload())
+        return web.json_response(self.slo_fn())

@@ -109,15 +109,21 @@ def parse_args(argv=None):
     p.add_argument("--health-port", type=int, default=0,
                    help="per-worker status server port (0 = ephemeral; "
                         "-1 disables; reference system_status_server.rs)")
+    p.add_argument("--hbm-poll-interval", type=float, default=10.0,
+                   help="seconds between HBM occupancy polls "
+                        "(jax device memory_stats; CPU backends fall "
+                        "back to process RSS).  0 disables the poller.")
     p.add_argument("--rpc-host", default="127.0.0.1",
                    help="bind + ADVERTISED host for this worker's RPC "
                         "server; cross-host deployments must set a "
                         "routable address (K8s manifests inject the pod "
                         "IP) — the 127.0.0.1 default only works "
                         "single-host")
+    from dynamo_tpu.runtime.slo import add_slo_args
     from dynamo_tpu.runtime.tracing import add_trace_args
 
     add_trace_args(p)
+    add_slo_args(p)
     apply_to_parser_defaults(p, load_layered_config(
         {"control_plane": None, "namespace": "dynamo",
          "component": "backend", "endpoint": "generate",
@@ -369,11 +375,19 @@ async def run(args) -> None:
             f"--role {args.role} requires a real engine (the mocker has "
             "no KV data plane); drop --role or --mocker")
     # Shared worker registry: request-lifecycle histograms (disagg KV
-    # transfer) + whatever the status server's extra text adds.
-    from dynamo_tpu.runtime.metrics import MetricsRegistry, RequestMetrics
+    # transfer, RPC-boundary TTFT/TPOT), the memory-plane KvCacheMetrics
+    # family, and SLO burn-rate gauges.
+    from dynamo_tpu.runtime.metrics import (
+        HbmPoller, KvCacheMetrics, MetricsRegistry, RequestMetrics)
+    from dynamo_tpu.runtime.slo import monitor_from_args
 
     registry = MetricsRegistry()
     request_metrics = RequestMetrics(registry)
+    kv_metrics = KvCacheMetrics(registry)
+    slo_monitor = monitor_from_args(args, request_metrics,
+                                    registry=registry)
+    if slo_monitor is not None:
+        slo_monitor.start(interval=args.slo_tick)
     if args.role == "decode":
         from dynamo_tpu.llm.disagg import DisaggDecodeClient, disagg_config_key
 
@@ -389,7 +403,8 @@ async def run(args) -> None:
     else:
         serve_client = engine
 
-    instance = await endpoint.serve(engine_wire_handler(serve_client))
+    instance = await endpoint.serve(engine_wire_handler(
+        serve_client, request_metrics=request_metrics))
     # (Transfer-plane discovery needs no control-plane record: the peer's
     # RPC address is already the instance record, and the per-transfer
     # descriptor — uuid + transfer address — travels in the kv_offer
@@ -409,8 +424,11 @@ async def run(args) -> None:
                                    **card_fields)
         await register_llm(endpoint, instance, card)
     status = None
+    hbm_poller = None
+    status_reg_task = None
     if args.health_port >= 0:
-        from dynamo_tpu.runtime.status import StatusServer
+        from dynamo_tpu.runtime.status import (
+            StatusServer, register_status_endpoint_task)
 
         def worker_metrics_text() -> str:
             m = metrics_fn()
@@ -420,6 +438,8 @@ async def run(args) -> None:
                 f"dynamo_worker_requests_waiting {ws.num_requests_waiting}",
                 f"dynamo_worker_kv_active_blocks {ks.kv_active_blocks}",
                 f"dynamo_worker_kv_usage {ks.gpu_cache_usage_perc}",
+                "dynamo_worker_kv_prefix_cache_hit_rate "
+                f"{ks.gpu_prefix_cache_hit_rate}",
             ]
             if m.expert_load:
                 for e, n in enumerate(m.expert_load):
@@ -433,11 +453,29 @@ async def run(args) -> None:
             if counters is not None:
                 for k, v in counters.to_dict().items():
                     lines.append(f"dynamo_worker_engine_{k} {v}")
+            # Memory-plane sample at scrape time: pool occupancy /
+            # eviction / prefix-hit series land in the shared registry.
+            # Runs on the status server's event loop (host ints only),
+            # never the engine thread.
+            if core is not None:
+                kv_metrics.observe_engine(core)
             return "\n".join(lines) + "\n"
 
-        status = StatusServer(registry=registry,
-                              extra_text_fn=worker_metrics_text)
-        hport = await status.start(port=args.health_port)
+        status = StatusServer(
+            registry=registry, extra_text_fn=worker_metrics_text,
+            slo_fn=(slo_monitor.payload if slo_monitor is not None
+                    else None))
+        hport = await status.start(host=args.rpc_host,
+                                   port=args.health_port)
+        # Advertise for fleet discovery: metrics_aggregator scrapes it,
+        # `dynamo top` renders it.  Best-effort with retry — a control
+        # plane mid-restart must not crash the worker.
+        status_reg_task = register_status_endpoint_task(
+            cp, f"worker-{args.role}", hport, host=args.rpc_host)
+        if args.hbm_poll_interval > 0:
+            hbm_poller = HbmPoller(kv_metrics,
+                                   interval=args.hbm_poll_interval)
+            hbm_poller.start()
         print(f"worker status server on :{hport}", flush=True)
     print(f"worker instance {instance.instance_id} role={args.role} "
           f"serving {args.model_name!r} at {instance.address}", flush=True)
@@ -476,6 +514,12 @@ async def run(args) -> None:
         prefill_task.cancel()
     if disagg_client is not None:
         await disagg_client.stop()
+    if status_reg_task is not None:
+        status_reg_task.cancel()
+    if hbm_poller is not None:
+        hbm_poller.stop()
+    if slo_monitor is not None:
+        await slo_monitor.stop()
     if status is not None:
         await status.stop()
     await shutdown()

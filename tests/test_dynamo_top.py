@@ -1,0 +1,217 @@
+"""`tools/dynamo_top.py`: Prometheus parsing units + the mini-fleet e2e
+(frontend + worker status servers discovered via status_endpoints/,
+scraped by the real CLI in a subprocess with --once --json)."""
+
+import asyncio
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import dynamo_top  # noqa: E402
+
+
+def _run(coro, timeout=120):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# -- parsing units -----------------------------------------------------------
+
+
+def test_parse_prom_names_labels_values():
+    text = (
+        "# HELP x y\n"
+        "# TYPE x gauge\n"
+        'x{a="1",b="two"} 3.5\n'
+        "plain 7\n"
+        'esc{v="a\\"b\\nc"} 1\n'
+        "garbage line with no value trailing\n"
+    )
+    samples = dynamo_top.parse_prom(text)
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["x"] == [({"a": "1", "b": "two"}, 3.5)]
+    assert by_name["plain"] == [({}, 7.0)]
+    assert by_name["esc"][0][0]["v"] == 'a"b\nc'
+
+
+def test_total_sums_matching_label_subsets():
+    samples = [("m", {"tier": "device"}, 2.0),
+               ("m", {"tier": "host"}, 3.0),
+               ("other", {}, 9.0)]
+    assert dynamo_top.total(samples, "m") == 5.0
+    assert dynamo_top.total(samples, "m", tier="device") == 2.0
+    assert dynamo_top.total(samples, "missing") is None
+
+
+def test_hist_quantile_from_buckets():
+    # 10 observations: 9 in le=0.01, 1 more by le=1.0 (across 2 label
+    # sets to exercise aggregation).
+    samples = [
+        ("h_bucket", {"m": "a", "le": "0.01"}, 5.0),
+        ("h_bucket", {"m": "a", "le": "1.0"}, 5.0),
+        ("h_bucket", {"m": "a", "le": "+Inf"}, 5.0),
+        ("h_bucket", {"m": "b", "le": "0.01"}, 4.0),
+        ("h_bucket", {"m": "b", "le": "1.0"}, 5.0),
+        ("h_bucket", {"m": "b", "le": "+Inf"}, 5.0),
+    ]
+    assert dynamo_top.hist_quantile(samples, "h", 0.5) == 0.01
+    assert dynamo_top.hist_quantile(samples, "h", 0.99) == 1.0
+    assert dynamo_top.hist_quantile([], "h", 0.5) is None
+    # Overflow bucket: worst latencies clamp to the largest finite
+    # bound (a number, not the no-data dash).
+    overflow = [
+        ("h_bucket", {"le": "1.0"}, 1.0),
+        ("h_bucket", {"le": "+Inf"}, 10.0),
+    ]
+    assert dynamo_top.hist_quantile(overflow, "h", 0.99) == 1.0
+
+
+def test_summarize_row_from_series():
+    samples = [
+        ("dynamo_worker_request_active_slots", {}, 3.0),
+        ("dynamo_kv_pool_active_blocks",
+         {"tier": "device", "pool": "G1-device"}, 30.0),
+        ("dynamo_kv_pool_capacity_blocks",
+         {"tier": "device", "pool": "G1-device"}, 60.0),
+        ("dynamo_kv_prefix_cache_hits_tokens",
+         {"tier": "device", "pool": "G1-device"}, 75.0),
+        ("dynamo_kv_prefix_cache_misses_tokens",
+         {"tier": "device", "pool": "G1-device"}, 25.0),
+        ("dynamo_hbm_used_bytes", {"device": "0", "kind": "tpu"}, 2.0e9),
+        ("dynamo_hbm_limit_bytes", {"device": "0", "kind": "tpu"}, 16e9),
+    ]
+    slo = {"enabled": True, "state": "WARN",
+           "objectives": [{"burn_fast": 4.5}]}
+    row = dynamo_top.summarize("worker-both", "127.0.0.1:1", samples, slo)
+    assert row["inflight"] == 3.0
+    assert row["kv_usage"] == 0.5
+    assert row["prefix_hit_rate"] == 0.75
+    assert row["hbm_used_bytes"] == 2.0e9
+    assert row["slo_state"] == "WARN"
+    assert row["slo_max_burn"] == 4.5
+
+
+# -- mini-fleet e2e ----------------------------------------------------------
+
+
+async def _mini_fleet():
+    """A control plane + a worker-shaped status server + a frontend
+    HttpService, all registered under status_endpoints/."""
+    from dynamo_tpu.llm.block_manager.pool import BlockPool
+    from dynamo_tpu.llm.http_service import HttpService
+    from dynamo_tpu.llm.service import ModelManager
+    from dynamo_tpu.runtime.control_plane_tcp import (
+        ControlPlaneClient, ControlPlaneServer)
+    from dynamo_tpu.runtime.metrics import (
+        KvCacheMetrics, MetricsRegistry, RequestMetrics)
+    from dynamo_tpu.runtime.slo import (
+        SloMonitor, SloObjective, latency_source)
+    from dynamo_tpu.runtime.status import (
+        StatusServer, register_status_endpoint)
+
+    srv = ControlPlaneServer()
+    cp_port = await srv.start()
+    cp = ControlPlaneClient("127.0.0.1", cp_port)
+    await cp.start()
+
+    # Worker: real BlockPool driven through an alloc/release cycle.
+    wreg = MetricsRegistry()
+    kvm = KvCacheMetrics(wreg)
+    pool = BlockPool(16, name="G1-device", reserve_null=True)
+    pages = pool.allocate(6)
+    for i, p in enumerate(pages[:3]):
+        pool.register(p, 0x100 + i)
+    kvm.observe_pool(pool, "device")
+    wrm = RequestMetrics(wreg)
+    for v in (0.05, 0.1, 0.2):
+        wrm.ttft.observe(v, labels={"model": "m"})
+        wrm.tpot.observe(v / 10, labels={"model": "m"})
+    wmon = SloMonitor(
+        [(SloObjective("ttft_p99", threshold_s=0.5),
+          latency_source(wrm.ttft, 0.5))], registry=wreg)
+    wmon.tick()
+    worker_status = StatusServer(registry=wreg, slo_fn=wmon.payload)
+    wport = await worker_status.start()
+    await register_status_endpoint(cp, "worker-both", wport)
+
+    # Frontend: the real HttpService with an SLO monitor installed.
+    svc = HttpService(ModelManager())
+    svc.request_metrics.ttft.observe(0.03, labels={"model": "m"})
+    svc.request_metrics.observe_outcome(ok=True)
+    fmon = SloMonitor(
+        [(SloObjective("ttft_p99", threshold_s=0.5),
+          latency_source(svc.request_metrics.ttft, 0.5))],
+        registry=svc.registry)
+    svc.slo_monitor = fmon
+    fport = await svc.start()
+    await register_status_endpoint(cp, "frontend", fport)
+
+    async def teardown():
+        await svc.stop()
+        await worker_status.stop()
+        await cp.close()
+        await srv.stop()
+
+    return cp_port, teardown
+
+
+def test_dynamo_top_once_json_covers_every_process():
+    async def main():
+        cp_port, teardown = await _mini_fleet()
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, os.path.join(REPO, "tools", "dynamo_top.py"),
+                "--control-plane", f"127.0.0.1:{cp_port}",
+                "--once", "--json",
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE, cwd=REPO)
+            out, err = await asyncio.wait_for(proc.communicate(), 90)
+            assert proc.returncode == 0, err.decode()[-2000:]
+            snapshot = json.loads(out.decode())
+        finally:
+            await teardown()
+
+        rows = {p["component"]: p for p in snapshot["processes"]}
+        assert set(rows) == {"worker-both", "frontend"}
+        for row in rows.values():
+            assert not row.get("unreachable"), row
+        worker = rows["worker-both"]
+        # KV usage from the pool series: 6 active of 16 capacity.
+        assert worker["kv_active_blocks"] == 6.0
+        assert worker["kv_capacity_blocks"] == 16.0
+        assert abs(worker["kv_usage"] - 6.0 / 16.0) < 1e-9
+        assert worker["ttft_p50_s"] is not None
+        assert worker["slo_state"] in ("OK", "WARN", "PAGE")
+        front = rows["frontend"]
+        assert front["slo_state"] in ("OK", "WARN", "PAGE")
+        assert front["ttft_p50_s"] is not None
+
+    _run(main())
+
+
+def test_collect_marks_dead_process_unreachable():
+    async def main():
+        from dynamo_tpu.runtime.control_plane_tcp import (
+            ControlPlaneClient, ControlPlaneServer)
+        from dynamo_tpu.runtime.status import register_status_endpoint
+
+        srv = ControlPlaneServer()
+        cp_port = await srv.start()
+        cp = ControlPlaneClient("127.0.0.1", cp_port)
+        await cp.start()
+        # Advertised but nothing listening.
+        await register_status_endpoint(cp, "worker-ghost", 1)
+        try:
+            snapshot = await dynamo_top.collect(
+                f"127.0.0.1:{cp_port}", timeout=1.0)
+        finally:
+            await cp.close()
+            await srv.stop()
+        assert len(snapshot["processes"]) == 1
+        assert snapshot["processes"][0]["unreachable"]
+
+    _run(main())

@@ -56,6 +56,85 @@ def test_aggregates_worker_metrics_and_hit_events():
     _run(main())
 
 
+def test_scrape_failure_counted_and_series_marked_stale():
+    """A dead advertised endpoint must be VISIBLE: its last-good series
+    stay behind a STALE comment (within stale_drop_secs), the failure
+    counter increments, and after the drop window the series disappear."""
+    async def main():
+        from dynamo_tpu.runtime.metrics import MetricsRegistry
+        from dynamo_tpu.runtime.status import (
+            StatusServer, register_status_endpoint)
+
+        cp = InProcessControlPlane()
+        await cp.start()
+        agg = MetricsAggregator(cp, stale_drop_secs=3600.0)
+        reg = MetricsRegistry()
+        reg.gauge("router_requests", "t").set(5.0)
+        status = StatusServer(registry=reg)
+        port = await status.start()
+        addr = f"127.0.0.1:{port}"
+        await register_status_endpoint(cp, "router", port)
+        try:
+            await agg._scrape_once()
+            text = agg.expose()
+            assert f"# scraped from {addr}\n" in text
+            assert "dynamo_router_requests" in text
+            assert "STALE" not in text
+
+            await status.stop()            # process "crashes"
+            await agg._scrape_once()
+            text = agg.expose()
+            # Series survive behind the staleness marker ...
+            assert f"# scraped from {addr} (STALE: last success" in text
+            assert "dynamo_router_requests" in text
+            # ... and the failure is counted.
+            assert agg._scrape_failures.value({"endpoint": addr}) == 1
+            exposed = agg.registry.expose()
+            assert "dynamo_aggregate_scrape_failures_total" in exposed
+
+            # Past the drop window the dead target's series disappear.
+            agg.stale_drop_secs = 0.0
+            await agg._scrape_once()
+            assert "dynamo_router_requests" not in agg.expose()
+            assert agg._scrape_failures.value({"endpoint": addr}) == 2
+        finally:
+            await agg.stop()
+            await cp.close()
+
+    _run(main())
+
+
+def test_unregistered_target_drops_immediately_without_stale():
+    async def main():
+        from dynamo_tpu.runtime.metrics import MetricsRegistry
+        from dynamo_tpu.runtime.status import (
+            STATUS_ENDPOINTS_PREFIX, StatusServer,
+            register_status_endpoint)
+
+        cp = InProcessControlPlane()
+        await cp.start()
+        agg = MetricsAggregator(cp)
+        reg = MetricsRegistry()
+        reg.gauge("planner_replicas", "t").set(1.0)
+        status = StatusServer(registry=reg)
+        port = await status.start()
+        key = await register_status_endpoint(cp, "planner", port)
+        try:
+            await agg._scrape_once()
+            assert "dynamo_planner_replicas" in agg.expose()
+            await cp.delete(key)           # clean de-registration
+            await agg._scrape_once()
+            text = agg.expose()
+            assert "dynamo_planner_replicas" not in text
+            assert "STALE" not in text
+        finally:
+            await status.stop()
+            await agg.stop()
+            await cp.close()
+
+    _run(main())
+
+
 def test_http_exposition():
     async def main():
         import aiohttp

@@ -1,0 +1,260 @@
+"""SLO burn-rate monitor (runtime/slo.py): window math, state
+transitions, NaN propagation, sources, and the planner scale-up bias."""
+
+import json
+
+from dynamo_tpu.runtime.metrics import (
+    Histogram, MetricsRegistry, RequestMetrics)
+from dynamo_tpu.runtime.slo import (
+    OK, PAGE, WARN, SloMonitor, SloObjective, disabled_payload,
+    error_source, latency_source, max_burn, monitor_from_args)
+
+
+class _Source:
+    """Controllable cumulative (total, bad) source."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.bad = 0.0
+
+    def __call__(self):
+        return self.total, self.bad
+
+
+def _monitor(src, objective=0.99, **kw):
+    kw.setdefault("fast_window", 300.0)
+    kw.setdefault("slow_window", 3600.0)
+    return SloMonitor([(SloObjective("ttft_p99", objective=objective,
+                                     threshold_s=0.5), src)], **kw)
+
+
+def _obj(payload, name="ttft_p99"):
+    return next(o for o in payload["objectives"] if o["name"] == name)
+
+
+# -- burn-rate math ----------------------------------------------------------
+
+
+def test_burn_rate_is_bad_fraction_over_budget():
+    src = _Source()
+    mon = _monitor(src)
+    mon.tick(now=0.0)
+    src.total, src.bad = 1000.0, 0.0
+    o = _obj(mon.tick(now=100.0))
+    assert o["burn_fast"] == 0.0 and o["compliant"]
+    # 50 bad of 2000 window events = 2.5% bad over a 1% budget → burn
+    # 2.5 on both windows (they cover the same samples here).
+    src.total, src.bad = 2000.0, 50.0
+    o = _obj(mon.tick(now=200.0))
+    assert abs(o["bad_frac_fast"] - 0.025) < 1e-9
+    assert abs(o["burn_fast"] - 2.5) < 1e-6
+    assert abs(o["burn_slow"] - 2.5) < 1e-6
+    assert not o["compliant"]
+
+
+def test_window_edges_old_samples_excluded_from_fast_window():
+    src = _Source()
+    mon = _monitor(src)
+    mon.tick(now=0.0)                      # (0, 0)
+    src.total, src.bad = 1000.0, 500.0
+    mon.tick(now=1000.0)                   # old badness
+    src.total, src.bad = 1100.0, 500.0     # 100 clean events since
+    o = _obj(mon.tick(now=1400.0))
+    # Fast window [1100, 1400]: baseline = the t=1000 sample → clean.
+    assert o["burn_fast"] == 0.0
+    # Slow window still sees the incident.
+    assert o["burn_slow"] > 10.0
+
+
+def test_no_traffic_burns_no_budget():
+    src = _Source()
+    mon = _monitor(src)
+    mon.tick(now=0.0)
+    o = _obj(mon.tick(now=100.0))
+    assert o["burn_fast"] == 0.0 and o["burn_slow"] == 0.0
+    assert o["bad_frac_fast"] is None       # no events ≠ 0% bad
+    assert o["compliant"]
+    assert mon.state == OK
+
+
+def test_counter_reset_treated_as_no_data():
+    src = _Source()
+    mon = _monitor(src)
+    src.total, src.bad = 1000.0, 100.0
+    mon.tick(now=0.0)
+    src.total, src.bad = 10.0, 0.0          # process restarted
+    o = _obj(mon.tick(now=100.0))
+    assert o["burn_fast"] == 0.0 and o["compliant"]
+
+
+def test_series_pruned_but_slow_window_baseline_kept():
+    src = _Source()
+    mon = _monitor(src, slow_window=100.0, fast_window=10.0)
+    for i in range(50):
+        src.total += 10
+        mon.tick(now=float(i * 10))
+    dq = mon._series["ttft_p99"]
+    # Bounded: only ~slow_window worth of samples plus one baseline.
+    assert len(dq) <= 13
+    assert dq[0][0] <= 490.0 - 100.0  # a baseline at/just past the edge
+
+
+# -- state transitions -------------------------------------------------------
+
+
+def test_warn_then_page_transitions():
+    src = _Source()
+    mon = _monitor(src, warn_burn=3.0, page_burn=14.4)
+    mon.tick(now=0.0)
+    # 4% bad (burn 4): WARN but not PAGE.
+    src.total, src.bad = 1000.0, 40.0
+    mon.tick(now=100.0)
+    assert mon.state == WARN
+    # Incident escalates: 20% bad in the new traffic → burn >= 14.4 on
+    # both windows.
+    src.total, src.bad = 2000.0, 340.0
+    mon.tick(now=200.0)
+    assert mon.state == PAGE
+    # Recovery: fast window clears first (PAGE needs BOTH windows).
+    src.total, src.bad = 4000.0, 340.0
+    mon.tick(now=500.0)
+    assert mon.state in (OK, WARN)
+    assert mon.state != PAGE
+
+
+def test_state_gauges_exported():
+    registry = MetricsRegistry()
+    src = _Source()
+    mon = _monitor(src, registry=registry)
+    mon.tick(now=0.0)
+    src.total, src.bad = 1000.0, 500.0
+    mon.tick(now=10.0)
+    text = registry.expose()
+    assert 'dynamo_slo_burn_rate{objective="ttft_p99",window="fast"}' in text
+    assert 'dynamo_slo_compliant{objective="ttft_p99"} 0.0' in text
+    assert "dynamo_slo_state 2.0" in text
+
+
+# -- NaN propagation / JSON safety -------------------------------------------
+
+
+def test_empty_histogram_nan_propagates_as_none_and_json_safe():
+    hist = Histogram("t", "t")
+    # The underlying NaN contract (Histogram.mean on no data) ...
+    import math
+
+    assert math.isnan(hist.mean())
+    assert math.isnan(hist.total_mean())
+    # ... must surface as JSON null, never a bare NaN token.
+    mon = SloMonitor([(SloObjective("ttft_p99", threshold_s=0.5),
+                       latency_source(hist, 0.5))])
+    payload = mon.tick(now=0.0)
+    payload = mon.tick(now=10.0)
+    o = _obj(payload)
+    assert o["bad_frac_fast"] is None
+    parsed = json.loads(json.dumps(payload, allow_nan=False))
+    assert parsed["state"] == OK
+
+
+# -- sources -----------------------------------------------------------------
+
+
+def test_latency_source_counts_above_threshold_as_bad():
+    hist = Histogram("t", "t")
+    for _ in range(9):
+        hist.observe(0.01, {"model": "a"})
+    hist.observe(2.0, {"model": "b"})       # across label sets
+    total, bad = latency_source(hist, 0.5)()
+    assert total == 10 and bad == 1
+
+
+def test_error_source_reads_outcome_counter():
+    registry = MetricsRegistry()
+    rm = RequestMetrics(registry)
+    for _ in range(7):
+        rm.observe_outcome(ok=True)
+    rm.observe_outcome(ok=False)
+    total, bad = error_source(rm.outcomes)()
+    assert total == 8 and bad == 1
+
+
+def test_monitor_from_args_flag_surface():
+    import argparse
+
+    from dynamo_tpu.runtime.slo import add_slo_args
+
+    p = argparse.ArgumentParser()
+    add_slo_args(p)
+    registry = MetricsRegistry()
+    rm = RequestMetrics(registry)
+    assert monitor_from_args(p.parse_args([]), rm) is None
+    args = p.parse_args(["--slo-ttft-p99", "0.5", "--slo-error-rate",
+                         "0.01", "--slo-fast-window", "60"])
+    mon = monitor_from_args(args, rm, registry=registry)
+    names = {obj.name for obj, _ in mon.objectives}
+    assert names == {"ttft_p99", "error_rate"}
+    assert mon.fast_window == 60.0
+    rm.ttft.observe(0.1, {"model": "m"})
+    payload = mon.tick(now=0.0)
+    assert payload["enabled"] and len(payload["objectives"]) == 2
+
+
+def test_max_burn_helper():
+    assert max_burn(None) == 0.0
+    assert max_burn(disabled_payload()) == 0.0
+    assert max_burn({"enabled": True, "objectives": [
+        {"burn_fast": 1.5}, {"burn_fast": None}, {"burn_fast": 7.0},
+    ]}) == 7.0
+
+
+# -- planner bias ------------------------------------------------------------
+
+
+class _Conn:
+    def __init__(self, n):
+        self.n = n
+
+    def replicas(self):
+        return self.n
+
+
+def test_planner_scales_up_on_slo_burn_and_vetoes_scale_down():
+    import time
+
+    from dynamo_tpu.planner.core import LoadPlanner, PlannerConfig
+    from dynamo_tpu.runtime.control_plane import InProcessControlPlane
+
+    def inject(planner, burn):
+        planner._slo = {"enabled": True,
+                        "objectives": [{"burn_fast": burn}]}
+        planner._slo_ts = time.monotonic()
+
+    cp = InProcessControlPlane()
+    planner = LoadPlanner(cp, _Conn(2), PlannerConfig(
+        min_replicas=1, max_replicas=4, slo_burn_scale_up=2.0))
+    # No SLO payload, no metrics: no decision.
+    assert planner.plan_step() is None
+    # Burning budget fast → scale up without any load observation.
+    inject(planner, 5.0)
+    assert planner.plan_step() == "up"
+    assert "slo_burn~5.0" in planner._reason()
+    # A stale payload (dead SLO source) stops exerting pressure.
+    planner._slo_ts = time.monotonic() - 120.0
+    assert planner.slo_pressure() == 0.0
+    assert planner.plan_step() is None
+    # At max replicas the bias cannot exceed the ceiling.
+    inject(planner, 5.0)
+    planner.connector = _Conn(4)
+    assert planner.plan_step() is None
+    # Sub-threshold but >= 1.0 burn vetoes scale-down even at low usage.
+    planner2 = LoadPlanner(cp, _Conn(2), PlannerConfig(
+        min_replicas=1, max_replicas=4, kv_low=0.5))
+    inject(planner2, 1.2)
+
+    def observe():
+        return (2, 0.05, 0)
+
+    planner2._observe = observe
+    assert planner2.plan_step() is None     # would be "down" without SLO
+    inject(planner2, 0.2)
+    assert planner2.plan_step() == "down"

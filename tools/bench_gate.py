@@ -155,6 +155,59 @@ def tracing_overhead_checks() -> dict:
     }
 
 
+def telemetry_overhead_checks() -> dict:
+    """KV/HBM telemetry must be free where it matters: a steady decode
+    window with the memory-plane collectors sampling EVERY step (far
+    hotter than the real scrape cadence) pays 0 extra host syncs and 0
+    extra dispatches vs telemetry disabled — the same
+    EngineStepCounters.delta pinning discipline as the tracing check."""
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models import config as mcfg
+    from dynamo_tpu.runtime.metrics import (
+        HbmPoller, KvCacheMetrics, MetricsRegistry)
+
+    def steady_run(observe: bool):
+        core = EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=128,
+            enable_prefix_cache=True, decode_window=2,
+            window_pipeline_depth=2,
+            scheduler=SchedulerConfig(
+                max_seqs=8, block_size=8, max_pages_per_seq=32,
+                max_prefill_chunk=128, decode_buckets=(1, 2, 4, 8),
+                prefill_buckets=(16, 128))))
+        kvm = KvCacheMetrics(MetricsRegistry())
+        poller = HbmPoller(kvm)
+        core.add_request("a", list(range(1, 71)),
+                         SamplingParams(max_tokens=64))
+        for _ in range(8):   # prefill + window warmup
+            core.step()
+        base = core.counters.snapshot()
+        for _ in range(20):
+            core.step()
+            if observe:
+                kvm.observe_engine(core)
+        if observe:
+            poller.poll_once()
+        return core.counters.delta(base)
+
+    d_off = steady_run(False)
+    d_on = steady_run(True)
+    dispatch_keys = ("window_dispatches", "single_step_dispatches",
+                     "prefill_dispatches", "h2d_uploads")
+    return {
+        "kv_telemetry_extra_host_syncs":
+            d_on["host_syncs"] - d_off["host_syncs"],
+        "kv_telemetry_zero_extra_syncs":
+            d_on["host_syncs"] == d_off["host_syncs"],
+        "kv_telemetry_extra_dispatches":
+            sum(d_on[k] - d_off[k] for k in dispatch_keys),
+        "kv_telemetry_zero_extra_dispatches":
+            all(d_on[k] == d_off[k] for k in dispatch_keys),
+    }
+
+
 def run_smoke(args) -> int:
     """Mocker-backed smoke of the whole measurement loop — CPU-only, no
     JAX device work, fast enough for tier-1.
@@ -169,7 +222,9 @@ def run_smoke(args) -> int:
     6. measure the modeled disagg-TTFT benchmark (real EagerPuller over
        a mocked seal timeline + wire): eager streaming must hide >= half
        the transfer behind prefill (transfer_overlap_ratio >= 0.5) and
-       land TTFT near max(prefill, transfer) + tail, not their sum.
+       land TTFT near max(prefill, transfer) + tail, not their sum;
+    7. bound KV/HBM telemetry overhead: per-step memory-plane sampling
+       adds 0 host syncs and 0 dispatches to the steady decode window.
     """
     import asyncio
 
@@ -248,6 +303,7 @@ def run_smoke(args) -> int:
         "disagg_streamed_beats_serial": disagg["streamed_beats_serial"],
         "disagg_ttft_near_max_bound": disagg["ttft_near_max_bound"],
         **tracing_overhead_checks(),
+        **telemetry_overhead_checks(),
     }
     ok = all(v is not False for v in checks.values())
     print(json.dumps({"smoke": "pass" if ok else "fail", **checks},

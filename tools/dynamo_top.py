@@ -1,0 +1,329 @@
+#!/usr/bin/env python
+"""`dynamo top` — one table for the whole fleet's capacity + SLO state.
+
+Discovers every process advertised under the control plane's
+`status_endpoints/` registry (workers, frontend, router_service,
+planner — anything with a status server), scrapes each one's `/metrics`
+and `/debug/slo`, and renders one row per process: role, inflight
+requests, KV usage, prefix-cache hit rate, HBM, TTFT/TPOT p50/p99, and
+the SLO burn-rate state (OK|WARN|PAGE).
+
+    # live view, refreshed every 2 s
+    python tools/dynamo_top.py --control-plane 127.0.0.1:4222
+
+    # one machine-readable snapshot (scripting / tests / cron)
+    python tools/dynamo_top.py --control-plane 127.0.0.1:4222 --once --json
+
+Latency quantiles are computed client-side from the scraped
+`dynamo_request_{ttft,tpot}_seconds` histogram buckets (bucket upper
+bounds, same resolution as the server's own `Histogram.quantile`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneClient  # noqa: E402
+from dynamo_tpu.runtime.slo import max_burn  # noqa: E402
+from dynamo_tpu.runtime.status import STATUS_ENDPOINTS_PREFIX  # noqa: E402
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+Sample = Tuple[str, Dict[str, str], float]
+
+
+def _unescape(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_prom(text: str) -> List[Sample]:
+    """Prometheus text exposition → [(name, labels, value)].  Tolerant:
+    unparseable lines are skipped (one bad series must not blank a whole
+    process's row)."""
+    out: List[Sample] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_labels, _, raw = line.rpartition(" ")
+        if not name_labels:
+            continue
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        name = name_labels
+        brace = name_labels.find("{")
+        if brace >= 0:
+            name = name_labels[:brace]
+            labels = {k: _unescape(v) for k, v in
+                      _LABEL_RE.findall(name_labels[brace:])}
+        out.append((name, labels, value))
+    return out
+
+
+def total(samples: List[Sample], name: str,
+          **match: str) -> Optional[float]:
+    """Sum of `name` across label sets matching the given subset; None
+    when the series is absent (distinct from a real 0)."""
+    vals = [v for n, labels, v in samples
+            if n == name and all(labels.get(k) == mv
+                                 for k, mv in match.items())]
+    return sum(vals) if vals else None
+
+
+def hist_quantile(samples: List[Sample], name: str,
+                  q: float) -> Optional[float]:
+    """Approximate quantile from `<name>_bucket` cumulative counts,
+    aggregated across label sets (shared bucket bounds).  A quantile
+    landing in the +Inf overflow bucket clamps to the largest finite
+    bound (read as "at least this") — the worst latencies must render
+    as a number, not as the no-data dash, exactly when the operator
+    needs them.  None only with no data at all."""
+    by_le: Dict[float, float] = {}
+    for n, labels, v in samples:
+        if n != name + "_bucket" or "le" not in labels:
+            continue
+        le = labels["le"]
+        bound = math.inf if le == "+Inf" else float(le)
+        by_le[bound] = by_le.get(bound, 0.0) + v
+    if not by_le:
+        return None
+    bounds = sorted(by_le)
+    total_n = by_le[bounds[-1]]
+    if total_n <= 0:
+        return None
+    finite = [b for b in bounds if not math.isinf(b)]
+    target = max(1, math.ceil(min(max(q, 0.0), 1.0) * total_n))
+    for b in bounds:
+        if by_le[b] >= target:
+            if math.isinf(b):
+                break
+            return b
+    return finite[-1] if finite else None
+
+
+# -- per-process summarization ------------------------------------------
+
+
+def summarize(component: str, address: str, samples: List[Sample],
+              slo: Optional[dict]) -> dict:
+    """One `dynamo top` row from a process's scraped series."""
+    inflight = total(samples, "dynamo_frontend_inflight_requests")
+    if inflight is None:
+        inflight = total(samples, "dynamo_worker_request_active_slots")
+    kv_active = total(samples, "dynamo_kv_pool_active_blocks",
+                      tier="device")
+    kv_capacity = total(samples, "dynamo_kv_pool_capacity_blocks",
+                        tier="device")
+    kv_usage = None
+    if kv_active is not None and kv_capacity:
+        kv_usage = kv_active / kv_capacity
+    if kv_usage is None:
+        kv_usage = total(samples, "dynamo_worker_kv_usage")
+    hits = total(samples, "dynamo_kv_prefix_cache_hits_tokens")
+    misses = total(samples, "dynamo_kv_prefix_cache_misses_tokens")
+    hit_rate = None
+    if hits is not None or misses is not None:
+        h, m = hits or 0.0, misses or 0.0
+        hit_rate = h / (h + m) if (h + m) > 0 else 0.0
+    if hit_rate is None:
+        hit_rate = total(samples, "dynamo_worker_kv_prefix_cache_hit_rate")
+    hbm_used = total(samples, "dynamo_hbm_used_bytes")
+    hbm_limit = total(samples, "dynamo_hbm_limit_bytes")
+    slo_state = None
+    if slo is not None:
+        slo_state = slo.get("state") if slo.get("enabled") else "—"
+    return {
+        "component": component,
+        "address": address,
+        "inflight": inflight,
+        "kv_active_blocks": kv_active,
+        "kv_capacity_blocks": kv_capacity,
+        "kv_usage": kv_usage,
+        "prefix_hit_rate": hit_rate,
+        "evictions": total(samples, "dynamo_kv_evictions_total"),
+        "hbm_used_bytes": hbm_used,
+        "hbm_limit_bytes": hbm_limit,
+        "ttft_p50_s": hist_quantile(samples,
+                                    "dynamo_request_ttft_seconds", 0.5),
+        "ttft_p99_s": hist_quantile(samples,
+                                    "dynamo_request_ttft_seconds", 0.99),
+        "tpot_p50_s": hist_quantile(samples,
+                                    "dynamo_request_tpot_seconds", 0.5),
+        "tpot_p99_s": hist_quantile(samples,
+                                    "dynamo_request_tpot_seconds", 0.99),
+        "slo_state": slo_state,
+        "slo_max_burn": (max_burn(slo)
+                         if slo and slo.get("enabled") else None),
+    }
+
+
+# -- collection ----------------------------------------------------------
+
+
+async def _scrape(addr: str, timeout: float) -> Tuple[Optional[str],
+                                                      Optional[dict]]:
+    """(metrics_text, slo_payload) for one process; None parts on
+    failure (a dead process still gets a row — marked unreachable)."""
+    import aiohttp
+
+    t = aiohttp.ClientTimeout(total=timeout)
+    metrics_text = slo = None
+    try:
+        async with aiohttp.ClientSession(timeout=t) as s:
+            try:
+                async with s.get(f"http://{addr}/metrics") as r:
+                    if r.status == 200:
+                        metrics_text = await r.text()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                pass
+            try:
+                async with s.get(f"http://{addr}/debug/slo") as r:
+                    if r.status == 200:
+                        slo = await r.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                    ValueError):
+                pass
+    except Exception:
+        pass
+    return metrics_text, slo
+
+
+async def collect(cp_addr: str, timeout: float = 3.0) -> dict:
+    """One fleet snapshot: discover via `status_endpoints/`, scrape
+    every process concurrently, summarize.  Importable (the mini-fleet
+    e2e test calls this in-process; the CLI wraps it)."""
+    host, _, port = cp_addr.rpartition(":")
+    cp = ControlPlaneClient(host or "127.0.0.1", int(port))
+    await cp.start()
+    try:
+        entries = await cp.get_prefix(f"{STATUS_ENDPOINTS_PREFIX}/")
+    finally:
+        await cp.close()
+    targets = []
+    seen = set()
+    for key, entry in sorted(entries.items()):
+        if not isinstance(entry, dict) or not entry.get("address"):
+            continue
+        addr = entry["address"]
+        if addr in seen:
+            continue  # one process may be re-registered across restarts
+        seen.add(addr)
+        targets.append((entry.get("component")
+                        or key.split("/")[1], addr))
+    scrapes = await asyncio.gather(
+        *(_scrape(addr, timeout) for _, addr in targets))
+    processes = []
+    for (component, addr), (text, slo) in zip(targets, scrapes):
+        if text is None and slo is None:
+            processes.append({"component": component, "address": addr,
+                              "unreachable": True})
+            continue
+        processes.append(summarize(component, addr,
+                                   parse_prom(text or ""), slo))
+    return {"generated_at": time.time(), "control_plane": cp_addr,
+            "processes": processes}
+
+
+# -- rendering -----------------------------------------------------------
+
+
+def _fmt(v, kind: str = "num") -> str:
+    if v is None:
+        return "—"
+    if kind == "pct":
+        return f"{100.0 * v:.1f}%"
+    if kind == "ms":
+        return f"{1e3 * v:.1f}"
+    if kind == "bytes":
+        for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+            if abs(v) < 1024 or unit == "TiB":
+                return (f"{v:.0f}{unit}" if unit == "B"
+                        else f"{v:.1f}{unit}")
+            v /= 1024
+    if kind == "int":
+        return str(int(v))
+    return f"{v:g}"
+
+
+COLUMNS = (
+    ("ROLE", 16, lambda r: r["component"]),
+    ("ADDRESS", 21, lambda r: r["address"]),
+    ("INFL", 5, lambda r: _fmt(r.get("inflight"), "int")),
+    ("KV%", 6, lambda r: _fmt(r.get("kv_usage"), "pct")),
+    ("HIT%", 6, lambda r: _fmt(r.get("prefix_hit_rate"), "pct")),
+    ("HBM", 16, lambda r: (f'{_fmt(r.get("hbm_used_bytes"), "bytes")}'
+                           f'/{_fmt(r.get("hbm_limit_bytes"), "bytes")}'
+                           if r.get("hbm_used_bytes") is not None
+                           else "—")),
+    ("TTFTp50", 8, lambda r: _fmt(r.get("ttft_p50_s"), "ms")),
+    ("TTFTp99", 8, lambda r: _fmt(r.get("ttft_p99_s"), "ms")),
+    ("TPOTp50", 8, lambda r: _fmt(r.get("tpot_p50_s"), "ms")),
+    ("TPOTp99", 8, lambda r: _fmt(r.get("tpot_p99_s"), "ms")),
+    ("SLO", 5, lambda r: r.get("slo_state") or "—"),
+)
+
+
+def render_table(snapshot: dict) -> str:
+    lines = [f"dynamo top — {len(snapshot['processes'])} process(es) via "
+             f"{snapshot['control_plane']}  (latencies in ms)"]
+    lines.append("  ".join(h.ljust(w) for h, w, _ in COLUMNS))
+    for row in snapshot["processes"]:
+        if row.get("unreachable"):
+            lines.append("  ".join([
+                row["component"].ljust(16), row["address"].ljust(21),
+                "UNREACHABLE"]))
+            continue
+        lines.append("  ".join(
+            str(fn(row))[:w].ljust(w) for _, w, fn in COLUMNS))
+    return "\n".join(lines)
+
+
+async def _run(args) -> int:
+    while True:
+        snapshot = await collect(args.control_plane, timeout=args.timeout)
+        if args.json:
+            print(json.dumps(snapshot, indent=None if args.once else 2))
+        else:
+            if not args.once:
+                print("\x1b[2J\x1b[H", end="")  # clear screen, home
+            print(render_table(snapshot), flush=True)
+        if args.once:
+            return 0
+        await asyncio.sleep(args.interval)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "tools/dynamo_top.py", description=__doc__.splitlines()[0])
+    p.add_argument("--control-plane", required=True, help="HOST:PORT")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh interval (seconds)")
+    p.add_argument("--timeout", type=float, default=3.0,
+                   help="per-process scrape timeout (seconds)")
+    args = p.parse_args(argv)
+    try:
+        return asyncio.run(_run(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
