@@ -438,6 +438,21 @@ def main():
     # swings single runs ±30%).
     serving_runs, prefill_runs, mixed = bench_serving_path(
         cfg, params, decode_window=window)
+
+    # Decode-bandwidth-wall sections (ISSUE 6): modeled int8-KV traffic
+    # vs bf16 at this bench's serving geometry, and MEASURED speculative
+    # acceptance + sweep-count speedup on the repetitive workload (gate
+    # floors: traffic_ratio <= 0.55, acceptance >= 0.6, modeled speedup
+    # >= 1.3 — see dynamo_tpu/bench/gate.py TPU_FLOORS rationale).
+    from dynamo_tpu.bench.decode_wall import (
+        kv_quant_traffic, measure_spec_acceptance)
+
+    kv_quant = kv_quant_traffic(
+        cfg, block_size=BLOCK, batch=BATCH, ctx=CTX, hbm_bw=hbm_bw,
+        weight_bytes=weight_bytes)
+    spec_decode = measure_spec_acceptance(
+        cfg, params=params, k=4, n_requests=8, n_out=64, prompt_len=64,
+        period=8, block_size=BLOCK)
     serving_tok_s = sorted(serving_runs)[len(serving_runs) // 2]
     prefill_cold = prefill_runs[0]
     prefill_steady = max(prefill_runs[1:])
@@ -499,6 +514,8 @@ def main():
         # prefill vs the same fleet undisturbed (the stall disagg exists
         # to remove; 1.0 = no interference).
         "mixed_prefill_decode": mixed,
+        "kv_quant": kv_quant,
+        "spec_decode": spec_decode,
         "peak_flops_nominal": round(peak / 1e12, 1),
         "peak_flops_measured": round(peak_measured / 1e12, 1),
         "hbm_bw_nominal_gbs": round(hbm_bw / 1e9, 1),

@@ -94,6 +94,13 @@ class EngineConfig:
     num_blocks: int = 512
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
     cache_dtype: Optional[jnp.dtype] = None
+    # Quantized KV plane (`--kv-quant`): "int8" stores K/V pages as int8
+    # with per-token-per-head f32 scales and dequantizes inside the
+    # decode kernel's VMEM tile — HBM bytes per context token drop to
+    # ~0.53x bf16 at serving geometry (kv_cache.py module docstring).
+    # Meshless engines only (the sharded attention bodies don't thread
+    # scale buffers); combination with mesh/pp raises at construction.
+    kv_quant: str = "none"
     mesh: Optional[object] = None          # jax.sharding.Mesh for tp/ep
     # Batch-sharded attention with slot-sharded KV (tp beyond the kv-head
     # count; reference sglang --enable-dp-attention).
@@ -130,15 +137,22 @@ class EngineConfig:
     # in-line and the round-trip swallowed 98% of serving wall-clock.
     decode_window: int = 8
     window_pipeline_depth: int = 8
-    # Speculative decoding via prompt-lookup drafts (PLD / n-gram): when
-    # > 0, greedy decode steps propose `speculative_tokens` continuation
-    # tokens from the sequence's own history and verify them in ONE
-    # device step (reference surface: SpecDecodeStats the delegated
-    # engines publish).  Engages only for all-greedy batches on the
-    # single-chip path; repetitive text (code, extraction, RAG quotes)
-    # accepts multiple tokens per step.
+    # Self-speculative decoding (`--spec-decode`): when > 0, decode steps
+    # draft `speculative_tokens` continuation tokens (prompt-lookup
+    # n-gram by default; `drafter` plugs in anything, e.g. a draft
+    # model), verify them in ONE batched forward through the existing
+    # step, and accept the longest agreeing prefix — greedy rows emit
+    # the exact argmax chain (byte-identical to non-spec greedy);
+    # stochastic rows use rejection-sampling fallback
+    # (sampling.speculative_verify), so the output DISTRIBUTION is
+    # unchanged.  Repetitive text (code, extraction, RAG quotes, agent
+    # loops) accepts multiple tokens per step, amortising each
+    # bandwidth-bound HBM sweep over >1 emitted token.
     speculative_tokens: int = 0
     speculative_ngram: int = 3
+    # Pluggable draft proposer (engine/drafter.py Drafter); None = the
+    # NgramDrafter(speculative_ngram) prompt-lookup default.
+    drafter: Optional[object] = None
     # Sequence-parallel ring prefill (mesh with sp > 1): full-prompt
     # prefills of at least this many tokens route through the ICI ring
     # (ops/ring_attention.py) instead of the chunked gather path — the
@@ -178,9 +192,14 @@ class EngineCore:
         cfg = config.model
         sched_cfg = config.scheduler
         self.block_size = sched_cfg.block_size
+        if config.kv_quant != "none" and config.mesh is not None:
+            raise ValueError(
+                f"kv_quant={config.kv_quant!r} requires a meshless engine "
+                "(the sharded attention paths don't thread scale buffers); "
+                "drop --kv-quant or the parallelism flags")
         self.cache_cfg = kvc.KvCacheConfig.for_model(
             cfg, num_blocks=config.num_blocks, block_size=self.block_size,
-            dtype=config.cache_dtype,
+            dtype=config.cache_dtype, kv_quant=config.kv_quant,
         )
         self.mesh = config.mesh
         # Multi-process mesh (SURVEY §2.5 multinode analog): every process
@@ -346,6 +365,15 @@ class EngineCore:
         # steady shape.  Unsharded engines only (self._fwd_raw); lazily
         # jitted on first all-greedy single-step decode.
         self._greedy_fused: Optional[Callable] = None
+        # Speculative decoding: pluggable drafter + lazily-jitted batched
+        # verify (sampling.speculative_verify).
+        self._spec_verify: Optional[Callable] = None
+        if config.drafter is not None:
+            self._drafter = config.drafter
+        else:
+            from dynamo_tpu.engine.drafter import NgramDrafter
+
+            self._drafter = NgramDrafter(config.speculative_ngram)
         # Constant per-bucket device arrays the decode path re-used to
         # upload EVERY step (sample_positions is always zeros for T=1 —
         # on a tunneled chip each small upload is a blocking RPC).
@@ -730,71 +758,109 @@ class EngineCore:
                     float(lps[j]) if lps is not None else None))
         self._pending_batches = remaining
 
-    # -- speculative decoding (prompt-lookup drafts) -----------------------
-
-    @staticmethod
-    def _draft_lookup(hist: List[int], ngram: int, k: int) -> List[int]:
-        """Prompt-lookup draft: find the most recent PRIOR occurrence of
-        the trailing `ngram` and propose the k tokens that followed it.
-        Empty when history is short or the n-gram never repeats."""
-        n = len(hist)
-        if n <= ngram:
-            return []
-        tail = hist[-ngram:]
-        # Scan right-to-left over prior positions (recency wins).
-        for start in range(n - ngram - 1, -1, -1):
-            if hist[start:start + ngram] == tail:
-                cont = hist[start + ngram:start + ngram + k]
-                if cont:
-                    return list(cont)
-        return []
+    # -- speculative decoding (draft-k, verify-batched) ---------------------
 
     def _spec_eligible(self, plan) -> bool:
         # logprobs requests take the plain path: the spec accept loop
         # doesn't thread per-token logprobs (the API contract must not
-        # change with a server-side perf flag).
+        # change with a server-side perf flag).  UNSEEDED stochastic
+        # rows ARE eligible: speculative_verify's rejection-sampling
+        # fallback keeps their output distribution exactly `sample`'s.
+        # SEEDED stochastic rows are not: their documented contract is
+        # "stream depends only on (seed, token index)", and a burst
+        # drawn jointly through accept/reject chains depends on step
+        # boundaries and draft content — only the plain per-token path
+        # can honor the seed guarantee.
         return (self.config.speculative_tokens > 0
                 and not self._pp  # pp step has no all-positions logits
                 and not self._mh  # spec path not audited for lockstep v1
+                # dp-attention locality pins rows to slots; the verify
+                # batch uses compact rows, which would read the wrong
+                # shard's pages — plain decode serves dp_local fleets.
+                and not self._dp_local
                 and plan.decode is not None
                 and plan.prefill is None
                 and not self.scheduler.waiting
-                and all(r.sampling.temperature <= 0
-                        and not r.sampling.logprobs
+                and all(not r.sampling.logprobs
+                        and not (r.sampling.temperature > 0
+                                 and r.sampling.seed is not None)
                         for r in plan.decode.requests))
+
+    def _spec_verify_fn(self):
+        """Lazily-jitted batched verify (sampling.speculative_verify):
+        accept/resample runs on device, ONE host sync fetches
+        (emitted [B, K+1], n_emit [B]) instead of [B, T, V] logits.
+        `greedy_only` is static — the all-greedy serving case compiles
+        to an argmax chain with no sort/softmax/categorical."""
+        if self._spec_verify is None:
+            from dynamo_tpu.engine.sampling import speculative_verify
+
+            self._spec_verify = jax.jit(
+                speculative_verify, static_argnames=("greedy_only",))
+        return self._spec_verify
+
+    def _row_keys(self, reqs, n: int):
+        """Per-row sampling keys, ONE discipline for the plain and spec
+        paths: one fresh split per step for unseeded rows; seeded rows
+        overwritten with fold_in(seed, emitted-token index) so a seeded
+        stream depends only on (seed, token index).  (The spec path
+        never sees seeded stochastic rows — _spec_eligible routes them
+        to the plain path, the only one that can honor that contract.)"""
+        self._rng, sub = jax.random.split(self._rng)
+        keys = jax.random.split(sub, n)
+        for i, r in enumerate(reqs):
+            if r.sampling.seed is not None:
+                keys = keys.at[i].set(jax.random.fold_in(
+                    jax.random.key(r.sampling.seed),
+                    r.prior_output + len(r.output_tokens)))
+        return keys
 
     def _run_decode_spec(self, work: DecodeWork) -> Optional[List[TokenDelta]]:
         """One speculative step: feed [last_token, draft_0..draft_{k-1}]
-        as a T=k+1 chunk, get logits at every position, and greedily
-        accept the longest draft prefix the model agrees with — up to
-        k+1 tokens per device step (the +1 is the model's own token at
-        the first disagreement, which costs nothing extra).
+        as a T=k+1 chunk, get logits at every position, and accept the
+        longest draft prefix the model agrees with — up to k+1 tokens
+        per device step (the +1 is the model's own token at the first
+        disagreement / the bonus after a full accept, which costs
+        nothing extra).  Accept/resample semantics live in
+        sampling.speculative_verify (greedy = argmax chain, stochastic =
+        rejection sampling).
 
-        Rejected positions leave junk KV in their slots; that is safe by
-        the same discipline as window overshoot: a future token at
-        position p REWRITES slot p before anything attends to it, and
-        context gathers mask positions >= seq_len.
+        KV rollback for rejected positions is the overwrite discipline:
+        a rejected draft's KV row sits at a position the request's NEXT
+        fed token rewrites before anything attends to it (growth is
+        monotonic and context gathers mask positions >= seq_len), so no
+        explicit scrub pass is needed — the accounting below only ever
+        advances context_len by the ACCEPTED count.
 
         Returns None when capacity can't cover the lookahead (caller
-        falls back to the plain path, which preempts properly)."""
+        falls back to the plain path, which preempts properly) or no row
+        produced a draft (a (K+1)-wide forward to emit ~1 token per row
+        is strictly worse than the plain step)."""
         K = self.config.speculative_tokens
         T = K + 1
         reqs = work.requests
         bucket = self._pad_rows(work.bucket)
 
+        vocab = self.config.model.vocab_size
         drafts = []
-        real = []  # rows with an actual lookup hit (stats + fallback)
+        draft_lens = []  # tokens the drafter REALLY proposed per row
         for req in reqs:
             if not self.scheduler.ensure_capacity(req, req.context_len + T):
                 return None
             hist = req.prompt_tokens[: req.prefilled] + req.output_tokens
-            d = self._draft_lookup(hist, self.config.speculative_ngram, K)
-            real.append(bool(d))
-            d = (d + [0] * K)[:K]
-            drafts.append(d)
-        if not any(real):
-            # Nothing to verify: the (K+1)-wide step would cost a full
-            # all-positions-logits forward to emit ~1 token per row.
+            d = []
+            for t in self._drafter.propose(hist, K)[:K]:
+                # Custom drafters are untrusted: an out-of-range id
+                # would silently clamp in the embedding gather AND in
+                # the verify's probability lookup, and could then be
+                # STREAMED to the client.  Truncate at the first bad id
+                # (the suffix after it is conditioned on garbage).
+                if not 0 <= int(t) < vocab:
+                    break
+                d.append(int(t))
+            draft_lens.append(len(d))
+            drafts.append((d + [0] * K)[:K])
+        if not any(draft_lens):
             return None
 
         bs = self.block_size
@@ -804,6 +870,10 @@ class EngineCore:
         positions = np.full((bucket, T), self._pad_position, np.int32)
         seq_lens = np.zeros((bucket,), np.int32)
         bts = np.zeros((bucket, width), np.int32)
+        temp = np.zeros((bucket,), np.float32)
+        top_k = np.zeros((bucket,), np.int32)
+        top_p = np.ones((bucket,), np.float32)
+        draft_arr = np.zeros((bucket, K), np.int32)
         for i, req in enumerate(reqs):
             ctx = req.context_len
             last = (req.output_tokens[-1] if req.output_tokens
@@ -813,34 +883,62 @@ class EngineCore:
             seq_lens[i] = ctx + K  # every fed token's KV is written
             n = min(len(req.pages), width)
             bts[i, :n] = req.pages[:n]
+            temp[i] = req.sampling.temperature
+            top_k[i] = req.sampling.top_k
+            top_p[i] = req.sampling.top_p
+            draft_arr[i] = drafts[i]
 
         # sample_positions=None → logits at EVERY chunk position [B,T,V].
         self.counters.note_dispatch("spec", bucket, T, width)
+        self.counters.spec_dispatches += 1
+        # Effective-bytes model: ONE sweep of each row's KV serves up to
+        # T emitted tokens (tokens tally added below from n_emit).
+        self.counters.note_kv_read(
+            sum(r.context_len + K for r in reqs)
+            * self.cache_cfg.bytes_per_context_token, 0)
         logits, self.cache = self._run_step(
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(seq_lens), jnp.asarray(bts), None)
+        emitted_dev, n_emit_dev = self._spec_verify_fn()(
+            logits, jnp.asarray(draft_arr), jnp.asarray(temp),
+            jnp.asarray(top_k), jnp.asarray(top_p),
+            self._row_keys(reqs, bucket),
+            greedy_only=all(r.sampling.temperature <= 0 for r in reqs))
         self.counters.host_syncs += 1
-        argmax = np.asarray(jax.device_get(
-            jnp.argmax(logits, axis=-1))).astype(np.int32)  # [bucket, T]
+        emitted, n_emit = jax.device_get((emitted_dev, n_emit_dev))
+        emitted = np.asarray(emitted)
+        n_emit = np.asarray(n_emit)
 
         deltas: List[TokenDelta] = []
         stats = self.metrics.spec_decode_stats
         for i, req in enumerate(reqs):
-            accepted = [int(argmax[i, 0])]       # the model's own token
-            for j in range(K):
-                if drafts[i][j] != accepted[-1]:
-                    break  # draft diverged from what the model just chose
-                accepted.append(int(argmax[i, j + 1]))
-            if real[i]:
-                # Padded empty drafts don't skew the acceptance-rate
-                # telemetry consumers use to judge whether PLD pays off.
-                stats.num_drafts += K
-                stats.num_accepted_tokens += len(accepted) - 1
-            for tok in accepted:
+            n = int(n_emit[i])
+            appended = 0
+            for tok in emitted[i, :n]:
                 if req.request_id not in self._requests:
                     break  # finished mid-burst (stop token / max_tokens)
                 self._publish_completed_blocks(req)
-                deltas.append(self._append_token(req, tok))
+                deltas.append(self._append_token(req, int(tok)))
+                appended += 1
+            # Telemetry counts what actually reached the output stream —
+            # a request finishing mid-burst discards the tail, and
+            # phantom tokens would understate effective-bytes and
+            # inflate the gated acceptance rate.  The denominator is the
+            # tokens the drafter REALLY proposed (draft_lens), not the
+            # zero-padded K — a drafter that honestly proposes 1 token
+            # per step at K=4 would otherwise read as 25% acceptance and
+            # spuriously trip the gate floor.
+            self.counters.note_kv_read(0, appended)
+            if draft_lens[i] and stats is not None:
+                stats.num_spec_tokens += draft_lens[i]
+                stats.num_drafts += draft_lens[i]
+                used_accepts = min(n - 1, appended, draft_lens[i])
+                stats.num_accepted_tokens += used_accepts
+                per_pos = stats.num_accepted_tokens_per_pos
+                while len(per_pos) < K:
+                    per_pos.append(0)
+                for j in range(used_accepts):
+                    per_pos[j] += 1
         return deltas
 
     def _window_eligible(self, plan) -> bool:
@@ -1099,6 +1197,12 @@ class EngineCore:
             return []
 
         self.counters.single_step_dispatches += 1
+        # Effective-bytes model: this step's attention reads each live
+        # row's full KV context once (weights excluded — this series
+        # isolates the KV plane the quantized cache halves).
+        self.counters.note_kv_read(
+            sum(r.context_len for r in live)
+            * self.cache_cfg.bytes_per_context_token, len(live))
         zeros = self._zeros_dev.get(bucket)
         if zeros is None:
             zeros = self._zeros_dev[bucket] = self._dev(
@@ -1249,6 +1353,15 @@ class EngineCore:
         self._window_state = st
         self.counters.window_dispatches += 1
         self.counters.note_dispatch("window", greedy_only, bucket, width)
+        # Effective-bytes model, bytes half: window step i of K reads
+        # context shadow+i per row.  The TOKEN half is tallied at sync
+        # time from what actually reaches the output stream — counting
+        # K*rows here would credit the discarded tails of finished
+        # requests and overshoot windows, understating bytes/token
+        # (the spec path makes the same appended-only choice).
+        self.counters.note_kv_read(
+            sum(s * K + K * (K - 1) // 2 for s in shadows)
+            * self.cache_cfg.bytes_per_context_token, 0)
 
         if lag:
             last_tokens = self._inflight[-1]["out"][K - 1]  # device, no sync
@@ -1361,6 +1474,7 @@ class EngineCore:
                     continue  # finished/cancelled mid-window: discard tail
                 self._publish_completed_blocks(req)
                 deltas.append(self._append_token(req, int(tokens[i, col])))
+                self.counters.note_kv_read(0, 1)  # real emission only
         return deltas
 
     def _drain_inflight(self) -> List[TokenDelta]:
@@ -1440,20 +1554,13 @@ class EngineCore:
             top_p = np.asarray([r.sampling.top_p for r in reqs]
                                + [1.0] * (n - len(reqs)), np.float32)
             # One split yields the whole batch's fresh keys (a single
-            # device op); seeded rows then overwrite theirs with
-            # fold_in(seed, index) so a seeded stream depends only on
-            # (seed, token index) — reproducible across batch mixes and
-            # preemption (prior_output keeps the index monotonic).
-            self._rng, sub = jax.random.split(self._rng)
-            keys = jax.random.split(sub, n)
-            for i, r in enumerate(reqs):
-                if r.sampling.seed is not None:
-                    keys = keys.at[i].set(jax.random.fold_in(
-                        jax.random.key(r.sampling.seed),
-                        r.prior_output + len(r.output_tokens)))
+            # device op); seeded rows overwrite theirs so a seeded
+            # stream depends only on (seed, token index) — reproducible
+            # across batch mixes and preemption (prior_output keeps the
+            # index monotonic).  Shared with the spec path (_row_keys).
             tokens_dev = sample(logits, jnp.asarray(temp),
                                 jnp.asarray(top_k), jnp.asarray(top_p),
-                                keys)
+                                self._row_keys(reqs, n))
         lp_dev = chosen_logprobs(logits, tokens_dev) if want_lp else None
 
         def fetch():
@@ -1690,12 +1797,36 @@ class EngineCore:
         output, so that off-thread read stays collective-free.)"""
         return self._extract_jit(self.cache, np.int32(page))
 
+    def _validate_block(self, data) -> None:
+        """Loud mixed-mode guard on every injected block: a bf16 peer's
+        block injected into an int8 cache (or vice versa) would bitcast
+        garbage into live KV pages and corrupt decode silently.  The wire
+        format carries dtype+shape (transfer.encode_block), so a
+        kv-quant-mode mismatch between peers is detectable HERE, before
+        any bytes touch the cache."""
+        want_shape = self.cache_cfg.block_wire_shape
+        got_shape = tuple(data.shape)
+        got_int8 = jnp.dtype(data.dtype) == jnp.dtype(jnp.int8)
+        # Float→float casts stay tolerated (an f32 test cache pulling a
+        # bf16 block is a lossless-enough astype, and pre-quant code
+        # allowed it); int8 packed blocks are NOT castable — only the
+        # exact mode round-trips.
+        if got_shape != want_shape or got_int8 != self.cache_cfg.quantized:
+            raise ValueError(
+                f"KV block format mismatch: peer sent "
+                f"{jnp.dtype(data.dtype)}{list(got_shape)} but this cache "
+                f"stores {jnp.dtype(self.cache_cfg.block_wire_dtype)}"
+                f"{list(want_shape)} (kv_quant={self.cache_cfg.kv_quant!r})"
+                " — prefill and decode workers must run the same "
+                "--kv-quant mode; refusing to inject")
+
     def _inject_block(self, page: int, data) -> None:
         """Host array OR device array → device block (onboard /
         transfer-in).  A pulled device array arrives committed to one
         device; under a mesh it must be re-laid as replicated before the
         sharded inject scatters it into the cache's sharding (the
         tp=x→tp=y relayout's scatter half)."""
+        self._validate_block(data)
         if (self.mesh is not None and isinstance(data, jax.Array)
                 and not self._mh):
             from jax.sharding import NamedSharding, PartitionSpec

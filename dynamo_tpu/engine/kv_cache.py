@@ -33,6 +33,19 @@ array XLA can reason about, so:
 
 The index math (block table → flat slots) runs inside jit on int32 arrays —
 no host round-trip per step.
+
+Quantized mode (`kv_quant="int8"`, ISSUE 6): K/V buffers store int8 with
+per-token-per-head f32 scales in sibling `[S, Hkv]` arrays (`k_scale` /
+`v_scale` in the cache pytree).  Scales are per-TOKEN so the incremental
+scatter write stays a scatter (a per-block scale would have to requantize
+every previously written token in the block when a new token raises the
+block max — impossible in-place under jit); grouped per BLOCK for
+export/import, where a page's `[block_size, Hkv]` scale slice travels
+atomically with its int8 rows inside one packed array (see
+`make_block_ops`).  Decode attention dequantizes INSIDE the kernel's VMEM
+tile after the DMA (ops/pallas/paged_attention.py), so HBM reads ~halve:
+per context token the wire cost drops from `2*F*2` bf16 bytes to
+`2*(F + 4*Hkv)` bytes — a 0.53x ratio at serving geometry (head_dim 64).
 """
 
 from __future__ import annotations
@@ -60,6 +73,18 @@ class KvCacheConfig:
     num_kv_heads: int
     head_dim: int
     dtype: jnp.dtype = jnp.bfloat16
+    # "none" = store K/V at `dtype`; "int8" = int8 pages + per-token
+    # per-head f32 scales (see module docstring).
+    kv_quant: str = "none"
+
+    def __post_init__(self):
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(f"kv_quant must be 'none' or 'int8', "
+                             f"got {self.kv_quant!r}")
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_quant == "int8"
 
     @property
     def num_slots(self) -> int:
@@ -71,14 +96,46 @@ class KvCacheConfig:
         return self.num_kv_heads * self.head_dim
 
     @property
+    def store_dtype(self):
+        """Dtype of the K/V page buffers as stored in HBM."""
+        return jnp.int8 if self.quantized else self.dtype
+
+    @property
+    def bytes_per_context_token(self) -> int:
+        """K+V bytes one decode step reads from HBM per context token,
+        across all layers — INCLUDING quantization scales.  This is the
+        numerator of every bytes/token roofline claim."""
+        if self.quantized:
+            per = self.feature_dim + 4 * self.num_kv_heads  # int8 + f32 scale
+        else:
+            per = self.feature_dim * jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * per
+
+    @property
     def bytes_per_block(self) -> int:
         """K+V bytes for one block across all layers (the unit the block
-        manager and router count in)."""
-        itemsize = jnp.dtype(self.dtype).itemsize
-        return (
-            2 * self.num_layers * self.block_size * self.num_kv_heads
-            * self.head_dim * itemsize
-        )
+        manager, router and dynamo_kv_pool_* / HBM accounting count in).
+        Quantized mode includes the per-token-per-head f32 scales — the
+        tiers store pages+scales together, so reporting bare int8 bytes
+        would understate real residency by 4*Hkv/F (~6% at head_dim 64,
+        25% at head_dim 16)."""
+        return self.block_size * self.bytes_per_context_token
+
+    @property
+    def block_wire_shape(self) -> tuple:
+        """Canonical shape of one exported block (the transfer-plane and
+        tier-storage unit).  bf16 mode: [2, L, bs, F] at `dtype`; int8
+        mode: [2, L, bs, F + 4*Hkv] int8, the trailing 4*Hkv bytes being
+        the page's [bs, Hkv] f32 scales bitcast to bytes so pages and
+        scales ship atomically in ONE array."""
+        feat = self.feature_dim
+        if self.quantized:
+            feat += 4 * self.num_kv_heads
+        return (2, self.num_layers, self.block_size, feat)
+
+    @property
+    def block_wire_dtype(self):
+        return jnp.int8 if self.quantized else self.dtype
 
     @staticmethod
     def for_model(
@@ -86,6 +143,7 @@ class KvCacheConfig:
         num_blocks: int,
         block_size: int = 64,
         dtype: jnp.dtype | None = None,
+        kv_quant: str = "none",
     ) -> "KvCacheConfig":
         return KvCacheConfig(
             num_blocks=num_blocks,
@@ -94,18 +152,38 @@ class KvCacheConfig:
             num_kv_heads=config.num_kv_heads,
             head_dim=config.head_dim,
             dtype=dtype if dtype is not None else config.dtype,
+            kv_quant=kv_quant,
         )
 
 
 def init_cache(cfg: KvCacheConfig) -> dict:
     """Allocate the cache pytree: {'k': [L x [S, F]], 'v': [L x [S, F]]}
     — per-layer 2D buffers, F = num_kv_heads * head_dim head-major (see
-    module docstring for why flat, and why not one stacked array)."""
+    module docstring for why flat, and why not one stacked array).
+
+    Quantized mode adds {'k_scale': [L x [S, Hkv]], 'v_scale': ...} f32
+    sibling buffers; forward steps branch on the presence of these keys
+    (static at trace time), so one factory serves both modes."""
     shape = (cfg.num_slots, cfg.feature_dim)
-    return {
-        "k": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
-        "v": [jnp.zeros(shape, cfg.dtype) for _ in range(cfg.num_layers)],
+    cache = {
+        "k": [jnp.zeros(shape, cfg.store_dtype)
+              for _ in range(cfg.num_layers)],
+        "v": [jnp.zeros(shape, cfg.store_dtype)
+              for _ in range(cfg.num_layers)],
     }
+    if cfg.quantized:
+        sshape = (cfg.num_slots, cfg.num_kv_heads)
+        cache["k_scale"] = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(cfg.num_layers)]
+        cache["v_scale"] = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(cfg.num_layers)]
+    return cache
+
+
+def cache_is_quantized(cache: dict) -> bool:
+    """Static (trace-time) quantization test: the pytree structure IS the
+    mode bit."""
+    return "k_scale" in cache
 
 
 def slots_for_positions(
@@ -166,6 +244,88 @@ def gather_kv(
             v.reshape(B, C, num_kv_heads, D))
 
 
+# ---------------------------------------------------------------------------
+# int8 quantization (kv_quant="int8")
+
+# Smallest per-head scale: heads whose K/V rows are all-zero (padding, the
+# null block) quantize to 0 with a nonzero scale instead of dividing by 0.
+_QUANT_EPS = 1e-8
+
+
+def quantize_kv_rows(
+    x: jax.Array,              # [N, F] rows in compute dtype
+    num_kv_heads: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-token-per-head int8 quantization: returns
+    (int8 [N, F], f32 scales [N, Hkv]) with x ≈ q * scale[..., head]."""
+    N, F = x.shape
+    D = F // num_kv_heads
+    xf = x.astype(jnp.float32).reshape(N, num_kv_heads, D)
+    amax = jnp.max(jnp.abs(xf), axis=-1)                    # [N, Hkv]
+    scale = jnp.maximum(amax, _QUANT_EPS) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8).reshape(N, F), scale
+
+
+def dequantize_rows(
+    q: jax.Array,              # [..., Hkv, D] int8
+    scale: jax.Array,          # [..., Hkv] f32
+    out_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Inverse of quantize_kv_rows on head-split rows: f32 multiply then
+    cast to `out_dtype` — the same dequant numerics as the Pallas
+    kernel's in-VMEM path, so the XLA gather path and the kernel agree
+    bit-for-bit on the dequantized operands."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(out_dtype)
+
+
+def write_kv_quant(
+    cache_layer_k: jax.Array,   # [S, F] int8
+    cache_layer_v: jax.Array,
+    scale_layer_k: jax.Array,   # [S, Hkv] f32
+    scale_layer_v: jax.Array,
+    slots: jax.Array,           # [N] flat slot ids (NULL for pad)
+    k: jax.Array,               # [N, F] unquantized rows
+    v: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Quantize and scatter new K/V rows + their scales into one layer.
+    Same padding discipline as write_kv (pad rows target the null block;
+    `mode="drop"` guards out-of-range)."""
+    H = scale_layer_k.shape[-1]
+    kq, ks = quantize_kv_rows(k, H)
+    vq, vs = quantize_kv_rows(v, H)
+    return (
+        cache_layer_k.at[slots].set(kq, mode="drop"),
+        cache_layer_v.at[slots].set(vq, mode="drop"),
+        scale_layer_k.at[slots].set(ks, mode="drop"),
+        scale_layer_v.at[slots].set(vs, mode="drop"),
+    )
+
+
+def gather_kv_quant(
+    cache_layer_k: jax.Array,   # [S, F] int8
+    cache_layer_v: jax.Array,
+    scale_layer_k: jax.Array,   # [S, Hkv] f32
+    scale_layer_v: jax.Array,
+    slots: jax.Array,           # [B, C]
+    num_kv_heads: int,
+    out_dtype=jnp.bfloat16,
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather + dequantize context K/V: returns [B, C, H, D] in
+    `out_dtype` (the XLA fallback path's read side; prefill attention
+    and non-Pallas decode both come through here in int8 mode)."""
+    B, C = slots.shape
+    F = cache_layer_k.shape[-1]
+    D = F // num_kv_heads
+    kq = jnp.take(cache_layer_k, slots, axis=0, mode="clip")
+    vq = jnp.take(cache_layer_v, slots, axis=0, mode="clip")
+    ks = jnp.take(scale_layer_k, slots, axis=0, mode="clip")
+    vs = jnp.take(scale_layer_v, slots, axis=0, mode="clip")
+    k = dequantize_rows(kq.reshape(B, C, num_kv_heads, D), ks, out_dtype)
+    v = dequantize_rows(vq.reshape(B, C, num_kv_heads, D), vs, out_dtype)
+    return k, v
+
+
 def make_block_ops(block_size: int, mesh=None, cache_specs=None):
     """Jitted whole-block extract/inject against the cache pytree.
 
@@ -184,28 +344,70 @@ def make_block_ops(block_size: int, mesh=None, cache_specs=None):
     Returns (extract, inject):
       extract(cache, page) -> [2, L, block_size, F] (K stacked on V)
       inject(cache, page, data) -> cache' (donated, in-place on device)
+
+    Quantized caches (kv_quant="int8") extract the PACKED wire block
+    [2, L, block_size, F + 4*Hkv] int8: int8 K/V rows with the page's
+    [block_size, Hkv] f32 scales bitcast to trailing bytes — pages and
+    scales move through every tier (G2 host, G3 disk, the kv_blocks wire,
+    eager streaming) as ONE array, so no path can ship one without the
+    other.  Inject unpacks and bitcasts back.  The branch is static: the
+    cache pytree's structure selects it at trace time.
     """
+
+    def _slice_layers(layers, start):
+        return jnp.stack([
+            jax.lax.dynamic_slice_in_dim(layer, start, block_size, axis=0)
+            for layer in layers])
 
     def extract(cache: dict, page: jax.Array) -> jax.Array:
         start = page * block_size
-        k = jnp.stack([
-            jax.lax.dynamic_slice_in_dim(layer, start, block_size, axis=0)
-            for layer in cache["k"]])
-        v = jnp.stack([
-            jax.lax.dynamic_slice_in_dim(layer, start, block_size, axis=0)
-            for layer in cache["v"]])
-        return jnp.stack([k, v])
+        k = _slice_layers(cache["k"], start)
+        v = _slice_layers(cache["v"], start)
+        if not cache_is_quantized(cache):
+            return jnp.stack([k, v])
+
+        ks = _slice_layers(cache["k_scale"], start)  # [L, bs, Hkv] f32
+        vs = _slice_layers(cache["v_scale"], start)
+
+        def pack(q, s):
+            # f32 [L, bs, Hkv] -> int8 [L, bs, Hkv, 4] -> [L, bs, 4*Hkv]
+            sb = jax.lax.bitcast_convert_type(s, jnp.int8)
+            sb = sb.reshape(s.shape[0], s.shape[1], -1)
+            return jnp.concatenate([q, sb], axis=-1)
+
+        return jnp.stack([pack(k, ks), pack(v, vs)])
 
     def inject(cache: dict, page: jax.Array, data: jax.Array) -> dict:
         start = page * block_size
-        data = data.astype(cache["k"][0].dtype)
+        upd = jax.lax.dynamic_update_slice_in_dim
+        if not cache_is_quantized(cache):
+            data = data.astype(cache["k"][0].dtype)
+            return {
+                "k": [upd(layer, data[0, i], start, axis=0)
+                      for i, layer in enumerate(cache["k"])],
+                "v": [upd(layer, data[1, i], start, axis=0)
+                      for i, layer in enumerate(cache["v"])],
+            }
+        F = cache["k"][0].shape[-1]
+        H = cache["k_scale"][0].shape[-1]
+        data = data.astype(jnp.int8)  # packed wire block (validated host-side)
+
+        def unpack(d):  # [L, bs, F + 4H] -> (int8 [L, bs, F], f32 [L, bs, H])
+            q = d[..., :F]
+            sb = d[..., F:].reshape(d.shape[0], d.shape[1], H, 4)
+            return q, jax.lax.bitcast_convert_type(sb, jnp.float32)
+
+        kq, ks = unpack(data[0])
+        vq, vs = unpack(data[1])
         return {
-            "k": [jax.lax.dynamic_update_slice_in_dim(
-                      layer, data[0, i], start, axis=0)
+            "k": [upd(layer, kq[i], start, axis=0)
                   for i, layer in enumerate(cache["k"])],
-            "v": [jax.lax.dynamic_update_slice_in_dim(
-                      layer, data[1, i], start, axis=0)
+            "v": [upd(layer, vq[i], start, axis=0)
                   for i, layer in enumerate(cache["v"])],
+            "k_scale": [upd(layer, ks[i], start, axis=0)
+                        for i, layer in enumerate(cache["k_scale"])],
+            "v_scale": [upd(layer, vs[i], start, axis=0)
+                        for i, layer in enumerate(cache["v_scale"])],
         }
 
     if mesh is None:

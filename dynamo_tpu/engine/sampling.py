@@ -48,22 +48,17 @@ def chosen_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     return picked - logz
 
 
-def sample(
+def _filtered_logits(
     logits: jax.Array,        # [B, V] float32
     temperature: jax.Array,   # [B]
     top_k: jax.Array,         # [B] int32, 0 = off
     top_p: jax.Array,         # [B] float32, 1.0 = off
-    key: jax.Array,           # PRNG key, single or [B] batch of keys
 ) -> jax.Array:
-    """Sample one token per row.  Greedy where temperature == 0.
-
-    `key` may be a batch of per-row keys (shape [B] of typed keys): seeded
-    requests get reproducible streams independent of which other requests
-    share the batch (the engine folds request seed + step index per row).
-    """
+    """Temperature-scaled logits with top-k/top-p survivors kept and the
+    rest at -inf — the distribution both `sample` and the speculative
+    accept/resample draw from (one shared implementation, so spec decode
+    is lossless against exactly what `sample` would have drawn)."""
     B, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
-
     safe_temp = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_temp[:, None]
 
@@ -90,14 +85,147 @@ def sample(
     cutoff_idx = jnp.argmax(cumprobs >= top_p[:, None], axis=-1)
     cutoff_logit = jnp.take_along_axis(sorted_masked, cutoff_idx[:, None], axis=1)
     top_p_on = (top_p < 1.0)[:, None]
-    scaled = jnp.where(top_p_on & (scaled < cutoff_logit), -jnp.inf, scaled)
+    return jnp.where(top_p_on & (scaled < cutoff_logit), -jnp.inf, scaled)
 
+
+def sample(
+    logits: jax.Array,        # [B, V] float32
+    temperature: jax.Array,   # [B]
+    top_k: jax.Array,         # [B] int32, 0 = off
+    top_p: jax.Array,         # [B] float32, 1.0 = off
+    key: jax.Array,           # PRNG key, single or [B] batch of keys
+) -> jax.Array:
+    """Sample one token per row.  Greedy where temperature == 0.
+
+    `key` may be a batch of per-row keys (shape [B] of typed keys): seeded
+    requests get reproducible streams independent of which other requests
+    share the batch (the engine folds request seed + step index per row).
+    """
+    greedy_tok = jnp.argmax(logits, axis=-1)
+    scaled = _filtered_logits(logits, temperature, top_k, top_p)
     if key.ndim > 0:
         sampled = jax.vmap(jax.random.categorical)(key, scaled)
     else:
         sampled = jax.random.categorical(key, scaled, axis=-1)
-    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy_tok).astype(jnp.int32)
 
 
 def greedy(logits: jax.Array) -> jax.Array:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def speculative_verify(
+    logits: jax.Array,        # [B, K+1, V] f32: verify-step logits, where
+                              # position j is the model's distribution for
+                              # the token FOLLOWING draft prefix d_0..d_{j-1}
+    drafts: jax.Array,        # [B, K] int32 drafted tokens
+    temperature: jax.Array,   # [B]
+    top_k: jax.Array,         # [B] int32, 0 = off
+    top_p: jax.Array,         # [B] float32, 1.0 = off
+    keys: jax.Array,          # [B] typed PRNG keys (ignored by greedy rows)
+    *,
+    greedy_only: bool = False,  # STATIC: all-greedy batch fast path
+) -> tuple:
+    """Batched draft verification with rejection-sampling fallback
+    (Leviathan et al. 2023, specialised to a DETERMINISTIC drafter whose
+    proposal q is a point mass at d_j):
+
+    - greedy rows (temperature <= 0): accept d_j while it equals the
+      model's argmax; the emitted stream is the argmax chain — BYTE
+      IDENTICAL to non-speculative greedy decode by construction;
+    - stochastic rows: accept d_j with probability p_j(d_j) under the
+      temperature/top-k/top-p-filtered distribution (q(d_j) = 1, so the
+      min(1, p/q) acceptance test is just a uniform draw against p); on
+      the first rejection, resample from the residual
+      norm(max(p - q, 0)) = p with d_j removed and renormalised — the
+      emitted marginal at every position is exactly `sample`'s, so a
+      server-side --spec-decode flag never changes the output
+      distribution (lossless by construction);
+    - all K accepted: one bonus token samples normally from position K's
+      distribution (the verify forward already paid for it).
+
+    Returns (emitted [B, K+1] int32, n_emit [B] int32 in [1, K+1]):
+    row b's step output is emitted[b, :n_emit[b]].
+
+    `greedy_only` (static, the dominant serving case): skips the
+    stochastic machinery entirely — no full-vocab sort, no softmax, no
+    categorical draws; one argmax and an accept scan.  XLA can't DCE
+    the stochastic branch on its own because temperature is traced.
+    """
+    B, T, V = logits.shape
+    K = T - 1
+    if greedy_only:
+        argmax_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+        if K > 0:
+            accept = drafts == argmax_tok[:, :K]
+            n_accept = jnp.sum(jnp.cumprod(
+                accept.astype(jnp.int32), axis=1), axis=1)
+        else:
+            n_accept = jnp.zeros((B,), jnp.int32)
+        # At the first rejection argmax != draft, and the bonus position
+        # has no draft — plain argmax IS the fallback everywhere.
+        pos = jnp.arange(T)[None, :]
+        emitted = jnp.where(
+            pos < n_accept[:, None],
+            jnp.concatenate([drafts, jnp.zeros((B, 1), drafts.dtype)],
+                            axis=1),
+            argmax_tok).astype(jnp.int32)
+        return emitted, (n_accept + 1).astype(jnp.int32)
+
+    flat = _filtered_logits(
+        logits.reshape(B * T, V),
+        jnp.repeat(temperature, T), jnp.repeat(top_k, T),
+        jnp.repeat(top_p, T)).reshape(B, T, V)
+    argmax_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, T]
+
+    # Per-(row, position) keys: one fold per position from the row's base
+    # key, split into an accept-draw stream and a resample stream, so a
+    # seeded request's spec stream is a pure function of (seed, step).
+    def row_keys(key):
+        a, r = jax.random.split(key, 2)
+        ak = jax.vmap(lambda j: jax.random.fold_in(a, j))(jnp.arange(K))
+        rk = jax.vmap(lambda j: jax.random.fold_in(r, j))(jnp.arange(T))
+        return ak, rk
+
+    akeys, rkeys = jax.vmap(row_keys)(keys)      # [B, K], [B, T]
+
+    if K > 0:
+        probs = jax.nn.softmax(flat[:, :K], axis=-1)          # [B, K, V]
+        p_draft = jnp.take_along_axis(
+            probs, drafts[:, :, None], axis=-1)[..., 0]       # [B, K]
+        u = jax.vmap(jax.vmap(jax.random.uniform))(akeys)     # [B, K]
+        stochastic = (temperature > 0)[:, None]
+        accept = jnp.where(stochastic, u < p_draft,
+                           drafts == argmax_tok[:, :K])       # [B, K]
+        n_accept = jnp.sum(jnp.cumprod(
+            accept.astype(jnp.int32), axis=1), axis=1)        # [B]
+    else:
+        n_accept = jnp.zeros((B,), jnp.int32)
+
+    # Fallback token per position: the residual draw.  Positions j < K
+    # mask the (rejected) draft column out of the filtered logits —
+    # categorical over the rest IS norm(max(p - q, 0)); greedy rows take
+    # argmax of the same masked logits (rejection implies the argmax
+    # differs from the draft, so masking never changes it).  The bonus
+    # position K stays unmasked: nothing was proposed there.
+    col = jnp.arange(V)[None, None, :]
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.full((B, 1), -1, drafts.dtype)], axis=1)  # [B, T]
+    masked = jnp.where(col == drafts_pad[:, :, None], -jnp.inf, flat)
+    resampled = jax.vmap(jax.vmap(jax.random.categorical))(
+        rkeys, masked).astype(jnp.int32)                       # [B, T]
+    masked_argmax = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+    bonus_or_greedy = jnp.where((temperature > 0)[:, None],
+                                resampled, masked_argmax)
+    # Bonus position must NOT use the draft-masked distribution for
+    # greedy (masked == flat there anyway since drafts_pad[:, K] = -1,
+    # an id no vocab column matches) — masked_argmax[K] == argmax[K].
+
+    pos = jnp.arange(T)[None, :]
+    emitted = jnp.where(pos < n_accept[:, None],
+                        jnp.concatenate(
+                            [drafts, jnp.zeros((B, 1), drafts.dtype)],
+                            axis=1),
+                        bonus_or_greedy).astype(jnp.int32)
+    n_emit = (n_accept + 1).astype(jnp.int32)
+    return emitted, n_emit

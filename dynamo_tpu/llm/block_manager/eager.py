@@ -128,7 +128,20 @@ class EagerPuller:
                 self._ready[lo + j] = blocks[h]
             self.streamed_blocks += len(blocks)
             self.streamed_bytes += sum(a.nbytes for a in blocks.values())
-            await self._inject_ready()
+            try:
+                await self._inject_ready()
+            except ValueError as e:
+                # Un-injectable blocks (kv-quant-mode mismatch between
+                # peers): stop streaming NOW with a pointed log — every
+                # further block would fail identically, and the residual
+                # pull in finish() re-raises so the caller falls back to
+                # local prefill instead of serving corrupt KV.
+                logger.error("eager pull from %s aborted — peer KV "
+                             "blocks are not injectable here: %s",
+                             address, e)
+                self._closed = True
+                self._ready.clear()
+                return
 
     async def _inject_ready(self) -> None:
         """Inject the longest new contiguous run into the engine's prefix

@@ -230,7 +230,14 @@ class KvBlockManager:
             if data is None:
                 break
             [gslot] = self.device.allocate(1)
-            self.inject_fn(gslot, data)
+            try:
+                self.inject_fn(gslot, data)
+            except Exception:
+                # Un-injectable bytes (e.g. a kv-quant-mode mismatch from
+                # a remote peer): release the fresh slot and stop the
+                # prefix here — never leave a pinned slot with junk.
+                self.device.release([gslot])
+                raise
             self.device.register(gslot, h)
             ids.append(gslot)
             n += 1
@@ -277,7 +284,11 @@ class KvBlockManager:
         if self.inject_fn is None or not self.device.can_allocate(1):
             return False
         [slot] = self.device.allocate(1)
-        self.inject_fn(slot, data)
+        try:
+            self.inject_fn(slot, data)
+        except Exception:
+            self.device.release([slot])  # mode-mismatch etc: no junk slot
+            raise
         if not self.device.register(slot, block_hash):
             self.device.release([slot])
             return False

@@ -13,6 +13,14 @@ Wire format (one RPC delta per block, binary-safe msgpack):
     request:  {"hashes": [int, ...]}
     delta:    {"hash": int, "data": bytes, "dtype": str, "shape": [int]}
 
+Quantized caches (kv_quant="int8") ship the PACKED block the engine's
+extract produces — int8 [2, L, bs, F + 4*Hkv] with the page's f32 scales
+bitcast into the trailing bytes (kv_cache.make_block_ops) — so pages and
+scales cross the wire atomically with no format change here.  The
+dtype+shape fields make a kv-quant-mode mismatch between peers visible
+at the destination: the engine's inject validation refuses the block
+with a clear error instead of casting garbage into live pages.
+
 A native ICI/DCN device-to-device path (pallas make_async_remote_copy)
 slots in behind the same interface when multi-chip topology is available;
 the host-staged path stays as the cross-slice / DCN fallback, mirroring

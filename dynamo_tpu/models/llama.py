@@ -149,11 +149,23 @@ def _attention_block(
     sp_mesh=None,            # mesh → ring attention over its sp axis
     pallas_mesh=None,        # mesh → shard_map the decode kernel (dp, tp)
     dp_local_mesh=None,      # mesh → device-local dp-attention decode
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (attn_out, k_cache', v_cache').  The layer cache buffers are
-    standalone arrays (not slices of a stacked cache) so the scatter in
-    `write_kv` aliases in place under donation / loop carries."""
+    k_scale_cache=None,      # [S, Hkv] f32 (int8 cache) or None
+    v_scale_cache=None,
+) -> Tuple:
+    """Returns (attn_out, k_cache', v_cache', k_scale', v_scale') — the
+    scale entries are None for unquantized caches.  The layer cache
+    buffers are standalone arrays (not slices of a stacked cache) so the
+    scatter in `write_kv` aliases in place under donation / loop carries."""
     B, T, _ = x.shape
+    quant = k_scale_cache is not None
+    if quant and (sp_mesh is not None or pallas_mesh is not None
+                  or dp_local_mesh is not None):
+        # The sharded shard_map bodies don't thread scale buffers yet;
+        # the engine gates kv_quant to meshless serving (worker flag
+        # rejects the combination with a clear error).
+        raise ValueError("kv_quant=int8 is not wired for sharded "
+                         "attention paths (sp ring / sharded pallas / "
+                         "dp-local); run the quantized cache unsharded")
     q = (x @ p_attn["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
     k = (x @ p_attn["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     v = (x @ p_attn["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
@@ -210,15 +222,23 @@ def _attention_block(
             check_vma=False,
         )(q, k, v, k_cache, v_cache, block_tables, positions, seq_lens)
         out = out.reshape(B, T, cfg.q_size) @ p_attn["wo"]
-        return out, k_layer, v_layer
+        return out, k_layer, v_layer, None, None
 
-    k_layer, v_layer = kvc.write_kv(
-        k_cache,
-        v_cache,
-        write_slots,
-        k.reshape(B * T, cfg.kv_size),
-        v.reshape(B * T, cfg.kv_size),
-    )
+    if quant:
+        k_layer, v_layer, ks_layer, vs_layer = kvc.write_kv_quant(
+            k_cache, v_cache, k_scale_cache, v_scale_cache, write_slots,
+            k.reshape(B * T, cfg.kv_size),
+            v.reshape(B * T, cfg.kv_size),
+        )
+    else:
+        k_layer, v_layer = kvc.write_kv(
+            k_cache,
+            v_cache,
+            write_slots,
+            k.reshape(B * T, cfg.kv_size),
+            v.reshape(B * T, cfg.kv_size),
+        )
+        ks_layer = vs_layer = None
 
     if sp_mesh is not None:
         # Sequence-parallel full-prompt prefill: the chunk IS the whole
@@ -273,7 +293,19 @@ def _attention_block(
                 q[:, 0], k_layer, v_layer, block_tables, seq_lens,
                 block_size=block_size, scale=cfg.query_scale,
                 soft_cap=cfg.attn_soft_cap, interpret=interp,
+                k_scale=ks_layer, v_scale=vs_layer,
             )[:, None]
+    elif quant:
+        # Gather + in-register dequant (prefill attention and the
+        # non-Pallas decode fallback): same dequant numerics as the
+        # kernel's VMEM path (kv_cache.dequantize_rows), cast to q's
+        # compute dtype.
+        k_ctx, v_ctx = kvc.gather_kv_quant(
+            k_layer, v_layer, ks_layer, vs_layer, ctx_slots,
+            cfg.num_kv_heads, out_dtype=q.dtype)
+        out = paged_attention(q, k_ctx, v_ctx, positions, kv_positions,
+                              seq_lens, scale=cfg.query_scale,
+                              soft_cap=cfg.attn_soft_cap)
     else:
         k_ctx, v_ctx = kvc.gather_kv(k_layer, v_layer, ctx_slots,
                                      cfg.num_kv_heads)
@@ -281,7 +313,7 @@ def _attention_block(
                               seq_lens, scale=cfg.query_scale,
                               soft_cap=cfg.attn_soft_cap)
     out = out.reshape(B, T, cfg.q_size) @ p_attn["wo"]
-    return out, k_layer, v_layer
+    return out, k_layer, v_layer, ks_layer, vs_layer
 
 
 def _dense_mlp(p: Params, x: jax.Array,
@@ -495,10 +527,19 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
             x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
         k_layers = list(cache["k"])
         v_layers = list(cache["v"])
+        # int8 cache: sibling per-layer scale buffers ride the same pytree
+        # (kv_cache.init_cache) — their presence selects the quantized
+        # write/read paths statically at trace time.
+        quant = kvc.cache_is_quantized(cache)
+        ks_layers = (list(cache["k_scale"]) if quant
+                     else [None] * cfg.num_layers)
+        vs_layers = (list(cache["v_scale"]) if quant
+                     else [None] * cfg.num_layers)
         expert_load = jnp.zeros((max(cfg.num_experts, 1),), jnp.int32)
         off = cfg.rms_offset
         for i, layer in enumerate(params["layers"]):
-            attn_out, k_layers[i], v_layers[i] = _attention_block(
+            (attn_out, k_layers[i], v_layers[i],
+             ks_layers[i], vs_layers[i]) = _attention_block(
                 cfg, layer["attn"],
                 rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps, off),
                 positions, seq_lens, write_slots, ctx_slots, ctx_positions,
@@ -509,6 +550,7 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                                       and mesh is not None) else None),
                 dp_local_mesh=(mesh if (dp_local and T == 1
                                         and mesh is not None) else None),
+                k_scale_cache=ks_layers[i], v_scale_cache=vs_layers[i],
             )
             if cfg.post_norms:
                 attn_out = rms_norm(attn_out, layer["post_attn_norm"],
@@ -537,6 +579,9 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                 x, sample_positions[:, None, None].astype(jnp.int32), axis=1
             )[:, 0]
         new_cache = {"k": k_layers, "v": v_layers}
+        if quant:
+            new_cache["k_scale"] = ks_layers
+            new_cache["v_scale"] = vs_layers
         if return_hidden:
             # Embeddings path: the last-token final-norm hidden state IS
             # the embedding (causal-LM convention, e5-mistral-style); the

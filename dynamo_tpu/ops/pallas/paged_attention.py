@@ -61,16 +61,23 @@ from jax.experimental.pallas import tpu as pltpu
 # Auto `pair` sizing targets: tiles of ~256 tokens keep the MXU's
 # F-contraction efficiency while cutting per-tile fixed costs, bounded so
 # the K+V scratch (2 buffers x 3 slots x tile x F) leaves most of the
-# ~16 MB VMEM for the compiler's own staging.
+# ~16 MB VMEM for the compiler's own staging.  int8 tiles halve the
+# scratch bytes per token, so the quantized kernel targets 2x the tile —
+# same VMEM budget, half the per-tile fixed costs per byte moved.
 _TARGET_TILE = 256
+_TARGET_TILE_INT8 = 512
 _SCRATCH_BUDGET = 4 * 1024 * 1024
 
 
-def auto_pair(block_size: int, feat: int, itemsize: int = 2) -> int:
+def auto_pair(block_size: int, feat: int, itemsize: int = 2,
+              target: Optional[int] = None) -> int:
     """Pages per DMA tile for a (block_size, feature-width) geometry:
-    grow toward `_TARGET_TILE` tokens, halve while the two 3-slot
+    grow toward the target tile tokens (`_TARGET_TILE`, doubled for int8
+    caches whose bytes/token halve), halve while the two 3-slot
     double-buffer scratch arrays would exceed `_SCRATCH_BUDGET`."""
-    pair = max(1, _TARGET_TILE // block_size)
+    if target is None:
+        target = _TARGET_TILE_INT8 if itemsize == 1 else _TARGET_TILE
+    pair = max(1, target // block_size)
     while pair > 1 and (2 * 3 * pair * block_size * feat * itemsize
                         > _SCRATCH_BUDGET):
         pair //= 2
@@ -78,12 +85,21 @@ def auto_pair(block_size: int, feat: int, itemsize: int = 2) -> int:
 
 
 def _decode_kernel(block_size: int, pair: int, n_kv: int,
-                   soft_cap: Optional[float],
+                   soft_cap: Optional[float], quant: bool,
                    # refs
                    bt_ref, len_ref,          # scalar-prefetch (SMEM)
                    q_ref, k_hbm, v_hbm,      # q [1, Hq, D]; 2D cache views
-                   o_ref,                    # output [1, Hq, D]
-                   k_vmem, v_vmem, sem):     # scratch [3, pair*bs, F]
+                   *rest):
+    if quant:
+        # int8 cache: per-token-per-head f32 scales ride their own HBM
+        # arrays [S, Hkv] and DMA alongside the int8 pages; dequant
+        # happens here on the VMEM-resident tile, AFTER the fetch — HBM
+        # moves ~half the bytes, VMEM holds int8 + a tiny scale tile.
+        (ks_hbm, vs_hbm, o_ref, k_vmem, v_vmem,
+         ks_vmem, vs_vmem, sem) = rest
+    else:
+        o_ref, k_vmem, v_vmem, sem = rest
+        ks_hbm = vs_hbm = ks_vmem = vs_vmem = None
     b = pl.program_id(0)
     nb = pl.num_programs(0)
     seq_len = len_ref[b]
@@ -105,6 +121,15 @@ def _decode_kernel(block_size: int, pair: int, n_kv: int,
     qp = jnp.where(band, jnp.concatenate([q] * n_kv, axis=1),
                    jnp.zeros((Hq, F), q.dtype))
 
+    def dequant(tile_i8, scale_tile):
+        # [W, F] int8 x [W, Hkv] f32 -> [W, F] in q's dtype: each column
+        # band h multiplies by its head's per-token scale (static concat
+        # of per-head broadcasts — Mosaic has no 3D reshape-broadcast).
+        mult = jnp.concatenate(
+            [jnp.broadcast_to(scale_tile[:, h:h + 1], (W, D))
+             for h in range(n_kv)], axis=1)
+        return (tile_i8.astype(jnp.float32) * mult).astype(qp.dtype)
+
     m0 = jnp.full((Hq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((Hq, 1), jnp.float32)
     a0 = jnp.zeros((Hq, F), jnp.float32)
@@ -120,15 +145,22 @@ def _decode_kernel(block_size: int, pair: int, n_kv: int,
             buf.at[slot, pl.ds(j * block_size, block_size)],
             sem.at[slot, j, kv])
 
+    # (buffer, hbm array, semaphore lane) per DMA stream: K, V, then the
+    # two tiny scale streams in quant mode (their tiles are [W, Hkv] f32 —
+    # ~3% of the K+V bytes at serving geometry).
+    streams = [(k_vmem, k_hbm, 0), (v_vmem, v_hbm, 1)]
+    if quant:
+        streams += [(ks_vmem, ks_hbm, 2), (vs_vmem, vs_hbm, 3)]
+
     def start_tile(slot, seq, t):
         for j in range(pair):
-            fetch(k_vmem, k_hbm, slot, seq, t, j, 0).start()
-            fetch(v_vmem, v_hbm, slot, seq, t, j, 1).start()
+            for buf, hbm, lane in streams:
+                fetch(buf, hbm, slot, seq, t, j, lane).start()
 
     def wait_tile(slot, seq, t):
         for j in range(pair):
-            fetch(k_vmem, k_hbm, slot, seq, t, j, 0).wait()
-            fetch(v_vmem, v_hbm, slot, seq, t, j, 1).wait()
+            for buf, hbm, lane in streams:
+                fetch(buf, hbm, slot, seq, t, j, lane).wait()
 
     # Tile 0 lives in slot 2: the PREVIOUS program prefetched it during its
     # last tile's compute (see below) iff it had 2+ tiles itself (a
@@ -167,8 +199,12 @@ def _decode_kernel(block_size: int, pair: int, n_kv: int,
 
         wait_tile(slot, b, t)
 
-        k = k_vmem[slot]                              # [W, F] bf16
-        v = v_vmem[slot]
+        if quant:
+            k = dequant(k_vmem[slot], ks_vmem[slot])  # [W, F] deq in-VMEM
+            v = dequant(v_vmem[slot], vs_vmem[slot])
+        else:
+            k = k_vmem[slot]                          # [W, F] bf16
+            v = v_vmem[slot]
         # Zero bands in qp make this the per-KV-head score despite the
         # full-F contraction: [Hq, F] x [W, F] -> [Hq, W].
         s = jax.lax.dot_general(
@@ -218,6 +254,8 @@ def paged_decode_attention(
     soft_cap: Optional[float] = None,
     interpret: bool = False,
     pair: Optional[int] = None,
+    k_scale: Optional[jax.Array] = None,  # [S, Hkv] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode-step attention over the paged cache; returns [B, Hq, D].
 
@@ -227,17 +265,33 @@ def paged_decode_attention(
     masked gather path for T=1 (the decode query at position seq_len-1
     sees exactly slots pos < seq_len): bf16 MXU passes with f32
     accumulation on both paths.
+
+    Quantized variant: pass an int8 cache with `k_scale`/`v_scale`
+    ([S, Hkv] f32, kv_cache.init_cache's `k_scale`/`v_scale` buffers).
+    Pages AND scales stream HBM→VMEM; dequantization happens on the
+    VMEM-resident tile (kv_cache.dequantize_rows numerics), so the HBM
+    read per context token drops from 2*F*2 to 2*(F + 4*Hkv) bytes and
+    the auto tile target doubles (auto_pair int8 path).
     """
     B, Hq, D = q.shape
     S, Fc = k_cache.shape
     Hkv = Fc // D
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
+    if quant and k_cache.dtype != jnp.int8:
+        raise ValueError(
+            f"scales imply an int8 cache; got {k_cache.dtype}")
     if Fc % D or Hq % Hkv:
         raise ValueError(f"bad geometry: q {q.shape}, cache {k_cache.shape}")
     if not interpret and (Fc % 128 or block_size % 8):
         # Mosaic DMA tiling: the cache's lane dim must be 128-aligned and
         # the sublane (block) dim 8-aligned, or compilation dies deep in
         # the DMA lowering.  Callers (engine auto-selection) should fall
-        # back to the gather path for such geometries.
+        # back to the gather path for such geometries.  (The quant scale
+        # arrays' Hkv lane dim is exempt from the 128 rule: Mosaic pads
+        # small-lane DMAs, and at [W, Hkv] f32 the padded burst is still
+        # ~3% of the K+V bytes.)
         raise ValueError(
             f"pallas paged decode needs F % 128 == 0 and block_size % 8 "
             f"== 0; got F={Fc}, block_size={block_size} (use the XLA "
@@ -252,28 +306,41 @@ def paged_decode_attention(
     if scale is None:
         scale = D ** -0.5
 
-    q_scaled = (q.astype(jnp.float32) * scale).astype(k_cache.dtype)
+    # int8 caches must not drag q down to int8 — the dequantized tiles
+    # come back in q's dtype (see _decode_kernel.dequant), so contract
+    # in q's dtype; bf16 caches keep the original cast-to-cache-dtype.
+    q_scaled = (q.astype(jnp.float32) * scale).astype(
+        q.dtype if quant else k_cache.dtype)
 
     kernel = functools.partial(_decode_kernel, block_size, pair, Hkv,
-                               soft_cap)
+                               soft_cap, quant)
+    in_specs = [
+        pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),   # K stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),   # V stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((3, pair * block_size, F), k_cache.dtype),
+        pltpu.VMEM((3, pair * block_size, F), v_cache.dtype),
+    ]
+    inputs = [block_tables, seq_lens, q_scaled, k_cache, v_cache]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),  # k scales
+                     pl.BlockSpec(memory_space=pltpu.ANY)]  # v scales
+        scratch += [pltpu.VMEM((3, pair * block_size, Hkv), jnp.float32),
+                    pltpu.VMEM((3, pair * block_size, Hkv), jnp.float32)]
+        inputs += [k_scale, v_scale]
+    scratch.append(pltpu.SemaphoreType.DMA((3, pair, 4 if quant else 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # K stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),   # V stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, bt, sl: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((3, pair * block_size, F), k_cache.dtype),
-            pltpu.VMEM((3, pair * block_size, F), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((3, pair, 2)),
-        ],
+        scratch_shapes=scratch,
     )
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(block_tables, seq_lens, q_scaled, k_cache, v_cache)
+    )(*inputs)

@@ -182,7 +182,9 @@ def make_pp_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
 
             def layer_fn(x, scanned):
                 layer, k_l, v_l = scanned
-                attn_out, k_l, v_l = _attention_block(
+                # (kv_quant is meshless-only; the trailing scale slots
+                # are always None on the pp path.)
+                attn_out, k_l, v_l, _, _ = _attention_block(
                     cfg, layer["attn"],
                     rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps),
                     positions_mb, seq_lens_mb, write_slots, ctx_slots,

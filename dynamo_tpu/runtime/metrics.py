@@ -233,8 +233,16 @@ class EngineStepCounters:
       WOULD miss jax's in-process cache, including hits served by the
       persistent compilation cache on disk.
     - dispatch tallies (`window_dispatches`, `single_step_dispatches`,
-      `prefill_dispatches`, `h2d_uploads`) — denominators for the two
-      above (syncs *per window*, uploads *per dispatch*).
+      `prefill_dispatches`, `spec_dispatches`, `h2d_uploads`) —
+      denominators for the two above (syncs *per window*, uploads *per
+      dispatch*).
+    - `kv_read_bytes_modeled` / `decode_tokens_emitted` (via
+      `note_kv_read`) — the MODELED KV bytes decode attention swept from
+      HBM and the tokens those sweeps emitted.  Their ratio,
+      `effective_bytes_per_token`, is the decode-bandwidth-wall series
+      (ISSUE 6): int8 KV roughly halves the numerator, speculative
+      decoding grows the denominator per sweep — both show up here
+      without a TPU in the loop.
     """
 
     def __init__(self) -> None:
@@ -244,7 +252,10 @@ class EngineStepCounters:
         self.window_syncs = 0
         self.single_step_dispatches = 0
         self.prefill_dispatches = 0
+        self.spec_dispatches = 0
         self.h2d_uploads = 0
+        self.kv_read_bytes_modeled = 0
+        self.decode_tokens_emitted = 0
         self._seen_shapes: set = set()
 
     def note_dispatch(self, tag: str, *sig) -> None:
@@ -255,6 +266,20 @@ class EngineStepCounters:
             self._seen_shapes.add(key)
             self.xla_cache_misses += 1
 
+    def note_kv_read(self, nbytes: int, tokens: int) -> None:
+        """Tally modeled decode KV traffic (bytes swept) and the tokens
+        it emitted; host-int arithmetic only."""
+        self.kv_read_bytes_modeled += int(nbytes)
+        self.decode_tokens_emitted += int(tokens)
+
+    @property
+    def effective_bytes_per_token(self) -> float:
+        """Modeled KV HBM bytes per emitted decode token (0 before any
+        decode work)."""
+        if not self.decode_tokens_emitted:
+            return 0.0
+        return self.kv_read_bytes_modeled / self.decode_tokens_emitted
+
     def to_dict(self) -> Dict[str, int]:
         return {
             "host_syncs": self.host_syncs,
@@ -263,7 +288,10 @@ class EngineStepCounters:
             "window_syncs": self.window_syncs,
             "single_step_dispatches": self.single_step_dispatches,
             "prefill_dispatches": self.prefill_dispatches,
+            "spec_dispatches": self.spec_dispatches,
             "h2d_uploads": self.h2d_uploads,
+            "kv_read_bytes_modeled": self.kv_read_bytes_modeled,
+            "decode_tokens_emitted": self.decode_tokens_emitted,
         }
 
     def snapshot(self) -> "EngineStepCounters":
@@ -421,6 +449,25 @@ class KvCacheMetrics:
             "hbm_used_bytes", "Accelerator memory in use")
         self.hbm_limit = registry.gauge(
             "hbm_limit_bytes", "Accelerator memory capacity")
+        # Decode-bandwidth-wall series (ISSUE 6): KV bytes per block as
+        # actually stored (incl. int8 scales), modeled KV bytes swept per
+        # emitted token, and the speculative-decoding accept telemetry.
+        self.kv_bytes_per_block = registry.gauge(
+            "kv_bytes_per_block",
+            "True bytes of one KV block across layers, including "
+            "quantization scales in int8 mode")
+        self.kv_effective_bytes_per_token = registry.gauge(
+            "kv_effective_bytes_per_token",
+            "Modeled decode-attention HBM bytes per emitted token")
+        self.spec_drafted = registry.counter(
+            "spec_decode_drafted_tokens_total",
+            "Draft tokens proposed to the batched verify step")
+        self.spec_accepted = registry.counter(
+            "spec_decode_accepted_tokens_total",
+            "Draft tokens the verify step accepted")
+        self.spec_acceptance_rate = registry.gauge(
+            "spec_decode_acceptance_rate",
+            "Cumulative accepted/drafted ratio (0 when spec decode off)")
         # Cumulative-source high-water marks: counters can only inc, so
         # sampled monotonic ints (pool.evictions, scheduler token
         # counters) convert to increments by delta from the last sample.
@@ -478,6 +525,23 @@ class KvCacheMetrics:
                          getattr(sched, "prefix_hit_tokens", 0))
             self._inc_to(self.prefix_misses, labels,
                          getattr(sched, "prefix_miss_tokens", 0))
+        cache_cfg = getattr(core, "cache_cfg", None)
+        if cache_cfg is not None:
+            self.kv_bytes_per_block.set(
+                cache_cfg.bytes_per_block,
+                labels={"kv_quant": cache_cfg.kv_quant})
+        counters = getattr(core, "counters", None)
+        if counters is not None:
+            self.kv_effective_bytes_per_token.set(
+                counters.effective_bytes_per_token)
+        stats = getattr(getattr(core, "metrics", None),
+                        "spec_decode_stats", None)
+        if stats is not None:
+            self._inc_to(self.spec_drafted, {}, stats.num_drafts)
+            self._inc_to(self.spec_accepted, {}, stats.num_accepted_tokens)
+            self.spec_acceptance_rate.set(
+                stats.num_accepted_tokens / stats.num_drafts
+                if stats.num_drafts else 0.0)
 
 
 class HbmPoller:

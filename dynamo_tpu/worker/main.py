@@ -104,6 +104,23 @@ def parse_args(argv=None):
                         "gloo collectives in this process")
     p.add_argument("--decode-window", type=int, default=8,
                    help="fused decode window length (1 disables)")
+    p.add_argument("--kv-quant", choices=("none", "int8"), default="none",
+                   help="KV-cache storage mode: 'int8' stores pages as "
+                        "int8 with per-token-per-head f32 scales and "
+                        "dequantizes inside the decode kernel — ~0.53x "
+                        "the HBM bytes per context token at serving "
+                        "geometry.  Meshless engines only; prefill and "
+                        "decode workers of one disagg pair must match "
+                        "(mismatched peers refuse block transfer loudly)")
+    p.add_argument("--spec-decode", type=int, default=0, metavar="K",
+                   help="self-speculative decoding: draft K tokens per "
+                        "decode step (prompt-lookup n-gram drafter) and "
+                        "verify them in one batched forward.  Greedy "
+                        "output is byte-identical to K=0; stochastic "
+                        "requests keep their exact sampling distribution "
+                        "(rejection-sampling fallback).  0 disables")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="n-gram length for the prompt-lookup drafter")
     p.add_argument("--speedup-ratio", type=float, default=10.0)
     p.add_argument("--metrics-interval", type=float, default=1.0)
     p.add_argument("--health-port", type=int, default=0,
@@ -239,6 +256,9 @@ async def build_engine(args, kv_event_sink):
                      mesh=mesh,
                      dp_attention=args.dp_attention,
                      decode_window=args.decode_window,
+                     kv_quant=getattr(args, "kv_quant", "none"),
+                     speculative_tokens=getattr(args, "spec_decode", 0),
+                     speculative_ngram=getattr(args, "spec_ngram", 3),
                      scheduler=SchedulerConfig(
                          block_size=args.block_size,
                          max_prefill_chunk=args.max_prefill_chunk)),

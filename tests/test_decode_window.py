@@ -259,6 +259,7 @@ def test_counters_expose_dict():
     assert set(d) == {"host_syncs", "xla_cache_misses",
                       "window_dispatches", "window_syncs",
                       "single_step_dispatches", "prefill_dispatches",
-                      "h2d_uploads"}
+                      "spec_dispatches", "h2d_uploads",
+                      "kv_read_bytes_modeled", "decode_tokens_emitted"}
     assert d["prefill_dispatches"] >= 1
     assert d["xla_cache_misses"] >= 1  # cold engine must compile

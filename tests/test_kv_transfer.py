@@ -167,6 +167,69 @@ def test_g4_remote_tier_onboards_peer_blocks():
     assert len(fetches) == 3
 
 
+def test_quantized_block_roundtrip_over_wire():
+    """ISSUE 6 satellite: export→hash-chain→wire→import of PACKED int8
+    blocks (pages + scales in one array) between two kv_quant=int8
+    engines preserves bytes exactly and the puller serves the prompt
+    with identical output and a prefix hit."""
+    prompt = list(range(1, 25))  # 3 sealed blocks
+
+    a = _core(kv_quant="int8")
+    out_a = _run(a, "a", prompt)
+    hashes = compute_block_hashes(prompt, BS)
+    blocks = a.export_blocks(hashes)
+    assert len(blocks) == 3
+    # The packed wire block: int8, trailing dim F + 4*Hkv (scale bytes).
+    for data in blocks.values():
+        assert data.dtype == np.int8
+        assert tuple(data.shape) == a.cache_cfg.block_wire_shape
+
+    # Wire encode/decode is byte-exact for the packed format.
+    for h, data in blocks.items():
+        h2, back = decode_block(encode_block(h, data))
+        assert h2 == h
+        np.testing.assert_array_equal(back, data)
+
+    b = _core(kv_quant="int8")
+    assert b.import_blocks(blocks) == 3
+    # Injected pages AND scales round-trip byte-identically: re-export
+    # from B and compare raw arrays.
+    blocks_b = b.export_blocks(hashes)
+    for h in hashes:
+        np.testing.assert_array_equal(blocks_b[h], blocks[h])
+    hits_before = b.allocator.manager.device.hits
+    out_b = _run(b, "b", prompt)
+    assert out_b == out_a
+    assert b.allocator.manager.device.hits > hits_before
+
+
+def test_mixed_kv_quant_peers_fail_loudly():
+    """A bf16 puller importing an int8 source's blocks (or vice versa)
+    must surface a clear error — NOT cast garbage into live KV pages."""
+    prompt = list(range(1, 25))
+
+    src8 = _core(kv_quant="int8")
+    _run(src8, "a", prompt)
+    hashes = compute_block_hashes(prompt, BS)
+    blocks8 = src8.export_blocks(hashes)
+
+    dst16 = _core()
+    with pytest.raises(ValueError, match="kv-quant|KV block format"):
+        dst16.import_blocks(blocks8)
+    # Nothing was registered: the bad blocks are not matchable, and no
+    # slot leaked (inject failure releases the fresh slot).
+    assert dst16.allocator.manager.device.registry.by_hash == {}
+    assert dst16.allocator.manager.device.active_slots == 0
+
+    # Reverse direction (bf16 source → int8 puller): same refusal, no
+    # engine run needed — a wire-shaped float block is enough.
+    dst8 = _core(kv_quant="int8")
+    fake16 = {hashes[0]: np.zeros(dst16.cache_cfg.block_wire_shape,
+                                  np.float32)}
+    with pytest.raises(ValueError, match="kv-quant|KV block format"):
+        dst8.import_blocks(fake16)
+
+
 def test_async_offload_waits_for_inflight_bytes():
     """Eviction dispatches the extract and returns; a G2 reader arriving
     before the host copy lands must wait for THAT block's future (the

@@ -208,6 +208,62 @@ def telemetry_overhead_checks() -> dict:
     }
 
 
+def decode_wall_checks() -> dict:
+    """ISSUE 6 smoke: the decode-bandwidth-wall features measured on CPU
+    with the tiny model —
+
+    - int8-KV traffic model at SERVING geometry (llama-3-1b, head_dim
+      64): ratio <= 0.55 (the floor TPU rounds gate on; the formula is
+      the same bytes_per_block accounting the block manager reports);
+    - greedy quality pin: tiny-model greedy decode token-exact between
+      bf16 and int8 KV caches;
+    - speculative decoding on the repetitive workload: acceptance >=
+      0.6, modeled sweep speedup >= 1.3, and output byte-identical to
+      the non-spec baseline (lossless by construction, measured here)."""
+    from dynamo_tpu.bench.decode_wall import (
+        kv_quant_traffic, measure_spec_acceptance)
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models import config as mcfg
+
+    serving = kv_quant_traffic(mcfg.get_config("llama-3-1b"))
+
+    def greedy_tokens(kv_quant: str):
+        core = EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=64,
+            kv_quant=kv_quant, enable_prefix_cache=False,
+            scheduler=SchedulerConfig(
+                max_seqs=8, block_size=8, max_pages_per_seq=8,
+                max_prefill_chunk=16, decode_buckets=(1, 2, 4, 8),
+                prefill_buckets=(8, 16))))
+        core.add_request("q", list(range(1, 30)),
+                         SamplingParams(max_tokens=24))
+        out = []
+        for _ in range(500):
+            for d in core.step():
+                out.extend(d.token_ids)
+            if not core._requests:
+                break
+        return out
+
+    pin_bf16 = greedy_tokens("none")
+    pin_int8 = greedy_tokens("int8")
+
+    spec = measure_spec_acceptance(mcfg.get_config("tiny-test"))
+
+    return {
+        "kv_quant_traffic_ratio": serving["traffic_ratio"],
+        "kv_quant_ratio_ok": serving["traffic_ratio"] <= 0.55,
+        "kv_quant_greedy_pin": pin_bf16 == pin_int8 and len(pin_bf16) == 24,
+        "spec_acceptance_rate": spec["acceptance_rate"],
+        "spec_acceptance_ok": spec["acceptance_rate"] >= 0.6,
+        "spec_modeled_speedup": spec["modeled_decode_speedup"],
+        "spec_speedup_ok": spec["modeled_decode_speedup"] >= 1.3,
+        "spec_output_identical": spec["output_identical_to_baseline"],
+    }
+
+
 def run_smoke(args) -> int:
     """Mocker-backed smoke of the whole measurement loop — CPU-only, no
     JAX device work, fast enough for tier-1.
@@ -224,7 +280,12 @@ def run_smoke(args) -> int:
        the transfer behind prefill (transfer_overlap_ratio >= 0.5) and
        land TTFT near max(prefill, transfer) + tail, not their sum;
     7. bound KV/HBM telemetry overhead: per-step memory-plane sampling
-       adds 0 host syncs and 0 dispatches to the steady decode window.
+       adds 0 host syncs and 0 dispatches to the steady decode window;
+    8. decode-bandwidth-wall features (ISSUE 6): int8-KV traffic ratio
+       <= 0.55 at serving geometry, tiny-model greedy pin bf16 == int8,
+       spec-decode acceptance >= 0.6 + modeled sweep speedup >= 1.3 on
+       the repetitive workload with byte-identical output, and the new
+       gate floors verified to fail fabricated bad runs.
     """
     import asyncio
 
@@ -274,10 +335,19 @@ def run_smoke(args) -> int:
     # Absolute TPU floors: a run below the MBU / interference floor fails
     # even against a baseline that already regressed there.
     tpu_good = dict(good, device="TPU v5 lite0", mbu=0.82,
-                    mixed_prefill_decode={"interference_ratio": 0.88})
+                    mixed_prefill_decode={"interference_ratio": 0.88},
+                    kv_quant={"traffic_ratio": 0.531},
+                    spec_decode={"acceptance_rate": 0.9,
+                                 "modeled_decode_speedup": 1.9})
     tpu_low_mbu = dict(tpu_good, mbu=0.60)
     tpu_interfered = dict(
         tpu_good, mixed_prefill_decode={"interference_ratio": 0.70})
+    # New ISSUE-6 floors: a fat quantized cache (scales forgotten or
+    # stored wide) and a collapsed acceptance rate must each fail.
+    tpu_fat_quant = dict(tpu_good, kv_quant={"traffic_ratio": 0.80})
+    tpu_low_accept = dict(
+        tpu_good, spec_decode={"acceptance_rate": 0.3,
+                               "modeled_decode_speedup": 1.9})
 
     from dynamo_tpu.bench.disagg import run_disagg_ttft_model
 
@@ -295,6 +365,10 @@ def run_smoke(args) -> int:
         "low_mbu_fails": not gate.compare(tpu_low_mbu, tpu_low_mbu).ok,
         "interference_fails": not gate.compare(tpu_interfered,
                                                tpu_interfered).ok,
+        "fat_quant_fails": not gate.compare(tpu_fat_quant,
+                                            tpu_fat_quant).ok,
+        "low_acceptance_fails": not gate.compare(tpu_low_accept,
+                                                 tpu_low_accept).ok,
         "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),
         "disagg_ttft_streamed_ms": round(
             disagg["ttft_streamed_s"] * 1e3, 1),
@@ -304,6 +378,7 @@ def run_smoke(args) -> int:
         "disagg_ttft_near_max_bound": disagg["ttft_near_max_bound"],
         **tracing_overhead_checks(),
         **telemetry_overhead_checks(),
+        **decode_wall_checks(),
     }
     ok = all(v is not False for v in checks.values())
     print(json.dumps({"smoke": "pass" if ok else "fail", **checks},
