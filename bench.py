@@ -453,6 +453,17 @@ def main():
     spec_decode = measure_spec_acceptance(
         cfg, params=params, k=4, n_requests=8, n_out=64, prompt_len=64,
         period=8, block_size=BLOCK)
+
+    # Fleet-wide prefix reuse (ISSUE 7): prefix-dedup study on the
+    # shared-prefix data_generator workload — real router + donor hints
+    # over a modeled busy fleet, plus a measured PrefixFetcher pull over
+    # the mocked wire (gate floor: remote_hit_rate >= 0.2).
+    import asyncio as _asyncio
+
+    from dynamo_tpu.bench.prefix_fleet import run_prefix_fleet
+
+    prefix_fleet = _asyncio.run(
+        _asyncio.wait_for(run_prefix_fleet(), 120))
     serving_tok_s = sorted(serving_runs)[len(serving_runs) // 2]
     prefill_cold = prefill_runs[0]
     prefill_steady = max(prefill_runs[1:])
@@ -516,6 +527,7 @@ def main():
         "mixed_prefill_decode": mixed,
         "kv_quant": kv_quant,
         "spec_decode": spec_decode,
+        "prefix_fleet": prefix_fleet,
         "peak_flops_nominal": round(peak / 1e12, 1),
         "peak_flops_measured": round(peak_measured / 1e12, 1),
         "hbm_bw_nominal_gbs": round(hbm_bw / 1e9, 1),

@@ -85,12 +85,19 @@ class FloorSpec:
 #   (baseline sweeps / spec sweeps / 1.1 verify surcharge) must clear
 #   1.3x on the acceptance-friendly workload, the gate behind the
 #   combined >= 1.5x tok/s/chip target for the next TPU round.
+# - prefix_fleet.remote_hit_rate >= 0.2 — ISSUE 7: on the synthetic
+#   shared-prefix workload (bench/prefix_fleet.py: 8 roots over a busy
+#   6-worker modeled fleet) the router must spill popular prefixes AND
+#   hand out remote-prefix hints for them; measures ~0.34, so 0.2
+#   catches a broken donor policy (hints never attached, dead-donor
+#   leakage filtering everything out) without flaking on routing noise.
 TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("mbu", minimum=0.75),
     FloorSpec("mixed_prefill_decode.interference_ratio", minimum=0.80),
     FloorSpec("kv_quant.traffic_ratio", maximum=0.55),
     FloorSpec("spec_decode.acceptance_rate", minimum=0.6),
     FloorSpec("spec_decode.modeled_decode_speedup", minimum=1.3),
+    FloorSpec("prefix_fleet.remote_hit_rate", minimum=0.2),
 )
 
 

@@ -1769,6 +1769,27 @@ class EngineCore:
                 out[h] = data
         return out
 
+    def resident_prefix_blocks(self, hashes) -> int:
+        """Length of the contiguous prefix of `hashes` already resident
+        in ANY local tier (G1/G2/G3) — host-dict lookups only, no device
+        work.  The fleet prefix-share pull consults this so blocks a
+        repeat request (or an earlier pull) already landed are never
+        re-fetched over the wire."""
+        if not self._managed_cache:
+            return 0
+        mgr = self.allocator.manager
+        n = 0
+        for h in hashes:
+            if (mgr.device.registry.lookup(h) is not None
+                    or (mgr.host is not None
+                        and mgr.host.registry.lookup(h) is not None)
+                    or (mgr.disk is not None
+                        and mgr.disk.registry.lookup(h) is not None)):
+                n += 1
+            else:
+                break
+        return n
+
     def import_blocks(self, blocks: Dict[int, np.ndarray]) -> int:
         """Inject fetched blocks into G1 as registered prefix-cache entries;
         a subsequent add_request with the matching prompt prefix skips
@@ -2079,6 +2100,10 @@ class InferenceEngine:
     async def import_blocks(self, blocks) -> int:
         return await self.run_in_engine(
             lambda: self.core.import_blocks(blocks))
+
+    async def resident_prefix_blocks(self, hashes) -> int:
+        return await self.run_in_engine(
+            lambda: self.core.resident_prefix_blocks(hashes))
 
     async def export_blocks_device(self, hashes) -> Dict[int, object]:
         return await self.run_in_engine(

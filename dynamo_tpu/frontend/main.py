@@ -369,6 +369,12 @@ async def run(args) -> None:
     await native.warmup()  # build the C++ hasher off the event loop
     models = ModelManager()
     shutdowns = []
+    # One registry for the whole frontend process: HTTP request series
+    # AND router-side series (remote-prefix route counter) share one
+    # /metrics exposition.
+    from dynamo_tpu.runtime.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
 
     cp_server = None
     if args.serve_control_plane:
@@ -403,7 +409,8 @@ async def run(args) -> None:
         await cp.start()
         runtime = DistributedRuntime(cp)
         watcher = ModelWatcher(runtime, models, router_mode=args.router_mode,
-                               migration_limit=args.migration_limit)
+                               migration_limit=args.migration_limit,
+                               registry=registry)
         await watcher.start()
         shutdowns += [watcher.stop, runtime.shutdown, cp.close]
         cp_client = cp
@@ -447,7 +454,7 @@ async def run(args) -> None:
         else:
             from dynamo_tpu.runtime.slo import monitor_from_args
 
-            svc = HttpService(models)
+            svc = HttpService(models, registry=registry)
             # SLO burn-rate monitor over this frontend's request
             # histograms (--slo-* flags; /debug/slo + dynamo_slo_*
             # gauges on /metrics).

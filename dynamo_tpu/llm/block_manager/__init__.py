@@ -11,7 +11,16 @@ tier transfers are slot-indexed gathers/scatters executed by donated jit
 functions (in-place on HBM), and the pool tracks slot ids, not pointers.
 """
 
-from dynamo_tpu.llm.block_manager.pool import BlockPool, BlockRegistry
+from dynamo_tpu.llm.block_manager.pool import (
+    BlockPool,
+    BlockRegistry,
+    slo_eviction_bias,
+)
 from dynamo_tpu.llm.block_manager.manager import KvBlockManager, TieredConfig
+from dynamo_tpu.llm.block_manager.prefix_share import (
+    PrefixFetcher,
+    PrefixShareClient,
+)
 
-__all__ = ["BlockPool", "BlockRegistry", "KvBlockManager", "TieredConfig"]
+__all__ = ["BlockPool", "BlockRegistry", "KvBlockManager", "TieredConfig",
+           "PrefixFetcher", "PrefixShareClient", "slo_eviction_bias"]

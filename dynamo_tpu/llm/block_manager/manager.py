@@ -296,6 +296,17 @@ class KvBlockManager:
         self.onboarded_blocks += 1
         return True
 
+    def set_eviction_bias(self, fn, scan: int = 8) -> None:
+        """Install the eviction-bias hook on every demoting tier: G1
+        eviction chooses what rides down to G2, G2 eviction what spills
+        to G3 — biasing both keeps hot prefixes as high in the
+        hierarchy as capacity allows (the SLO-aware hook,
+        `pool.slo_eviction_bias`).  G3 has nowhere to demote to, so it
+        stays pure LRU."""
+        self.device.set_eviction_bias(fn, scan)
+        if self.host is not None:
+            self.host.set_eviction_bias(fn, scan)
+
     def close(self) -> None:
         """Settle outstanding offloads and stop the worker thread (a
         manager per discarded engine would otherwise leak its thread)."""

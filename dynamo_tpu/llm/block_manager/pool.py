@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 from collections import OrderedDict
 from dataclasses import dataclass
+from itertools import islice
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger(__name__)
@@ -32,6 +33,11 @@ class Slot:
     index: int
     block_hash: Optional[int] = None
     ref_count: int = 0
+    # Prefix-cache matches served by this slot since allocation — the
+    # hotness signal the SLO-aware eviction bias reads (a block that
+    # keeps saving prefill is the one to keep on-device while the error
+    # budget burns).
+    hits: int = 0
 
 
 class BlockRegistry:
@@ -77,6 +83,14 @@ class BlockPool:
         # KvCacheMetrics samples this; admin clear_inactive flushes are
         # deliberate drops, not pressure, and don't count).
         self.evictions = 0
+        # Eviction-bias hook (SLO-aware tier demotion): a callable
+        # `bias(slot) -> float` protection score — 0.0 means "evict
+        # first", higher means "keep longer".  None = pure LRU.
+        self.eviction_bias: Optional[Callable[[Slot], float]] = None
+        self.bias_scan = 8
+        # Evictions where the bias skipped over >= 1 protected block
+        # (observability for the SLO hook's effect).
+        self.bias_protected = 0
 
     # -- views ------------------------------------------------------------
 
@@ -116,6 +130,7 @@ class BlockPool:
             if slot.ref_count == 0:
                 self.registry.inactive.pop(slot.block_hash, None)
             slot.ref_count += 1
+            slot.hits += 1
             ids.append(slot.index)
             self.hits += 1
         return ids
@@ -141,8 +156,34 @@ class BlockPool:
             self.misses += 1
         return out
 
+    def set_eviction_bias(self, fn: Optional[Callable[[Slot], float]],
+                          scan: int = 8) -> None:
+        """Install (or clear, fn=None) the eviction-bias hook.  `scan`
+        bounds how far past the LRU head `_evict_one` searches for an
+        unprotected victim — O(scan) per eviction, never a full-registry
+        sweep."""
+        self.eviction_bias = fn
+        self.bias_scan = max(1, scan)
+
     def _evict_one(self) -> None:
-        h, slot = self.registry.inactive.popitem(last=False)  # LRU
+        h, slot = next(iter(self.registry.inactive.items()))  # LRU head
+        if self.eviction_bias is not None:
+            # SLO-aware demotion: scan a bounded LRU window for the
+            # least-protected block.  When the bias sits at 0 for
+            # everything (error budget healthy) the LRU head wins
+            # outright and this degenerates to pure LRU.
+            best_score = self.eviction_bias(slot)
+            if best_score > 0.0:
+                for h2, s2 in list(islice(
+                        self.registry.inactive.items(), 1, self.bias_scan)):
+                    score = self.eviction_bias(s2)
+                    if score < best_score:
+                        h, slot, best_score = h2, s2, score
+                    if best_score <= 0.0:
+                        break
+                if h != next(iter(self.registry.inactive)):
+                    self.bias_protected += 1
+        del self.registry.inactive[h]
         del self.registry.by_hash[h]
         del self._slots[slot.index]
         self._free.append(slot.index)
@@ -215,3 +256,30 @@ class BlockPool:
             self._free.append(slot.index)
             dropped.append(h)
         return dropped
+
+
+def slo_eviction_bias(burn_fn: Callable[[], float], *,
+                      hot_hits: int = 1,
+                      burn_threshold: float = 1.0,
+                      ) -> Callable[[Slot], float]:
+    """SLO-aware eviction bias: while the error budget is burning
+    (`burn_fn()` — e.g. the SLO monitor's worst fast-window burn rate —
+    at or above `burn_threshold`), protect hot prefix blocks (>=
+    `hot_hits` cache hits) from demotion so warm prefixes keep
+    absorbing prefill load exactly when latency is already suffering.
+    Below the threshold every block scores 0 and the pool is pure LRU.
+
+    Wire with `BlockPool.set_eviction_bias` /
+    `KvBlockManager.set_eviction_bias`; the worker installs it when an
+    SLO monitor is configured (`runtime/slo.py` `last_max_burn`)."""
+
+    def bias(slot: Slot) -> float:
+        try:
+            burn = burn_fn()
+        except Exception:
+            return 0.0  # a broken signal must not wedge eviction
+        if burn is None or burn < burn_threshold:
+            return 0.0
+        return float(slot.hits) if slot.hits >= hot_hits else 0.0
+
+    return bias

@@ -1,0 +1,368 @@
+"""Fleet-wide prefix reuse: pull a peer worker's sealed prefix blocks.
+
+The KV router computes per-worker prefix overlap for every request
+(`kv_router/indexer.py`), but until this module a prefix cached on
+worker A was recomputed from scratch whenever load spilled the request
+onto worker B — the multi-tier KV hierarchy stopped at one node.  Now
+the router's scheduler, when the selected worker's local overlap is
+poor but a peer's is deep, attaches a *remote-prefix hint* to the
+routed request (`kv_router/scheduler.py pick_donor`): the donor's RPC
+address plus its covered-token high-water mark, both derived from the
+indexer's stored-block events.  The serving worker consumes the hint
+HERE, before admission:
+
+- `PrefixFetcher` pulls the donor's sealed blocks peer-to-peer over the
+  existing `kv_blocks` plane (`transfer.fetch_blocks`) in bounded
+  in-flight batches, injects contiguous runs incrementally via
+  `engine.import_blocks`, and mops up stragglers with
+  `pull_prefix(covered_tokens=...)` residual semantics;
+- `PrefixShareClient` wraps the worker's serving EngineClient: hint →
+  pull → delegate.  The engine's admission prefix-match then skips
+  prefill for every pulled token, so only the residual prefills.
+
+Failure semantics mirror the eager-streaming discipline (PR 4): a dead
+donor, a hash-chain gap, or a timeout leaves whatever contiguous prefix
+landed injected and falls back to plain local prefill — prefix sharing
+is an optimisation, never a correctness dependency.  A kv-quant-mode
+mismatch between peers is refused LOUDLY at inject time (the engine's
+`_validate_block`): the pull aborts with a pointed error log instead of
+bitcasting a bf16 peer's bytes into an int8 cache.
+
+Any worker with a real engine serves `kv_blocks` (worker/main.py), so
+every worker is a donor — disaggregation is not required.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from dynamo_tpu.llm.block_manager.transfer import (
+    EXPORT_BATCH_BLOCKS,
+    fetch_blocks,
+    pull_prefix,
+    sealed_hashes,
+)
+from dynamo_tpu.runtime.rpc import RpcError
+
+logger = logging.getLogger(__name__)
+
+# Annotation key the router sets and the worker consumes.  Riding the
+# request's annotations dict keeps the wire codec unchanged: old
+# workers ignore the key, old routers simply never set it.
+HINT_ANNOTATION = "remote_prefix"
+
+
+def encode_hint(address: str, covered_tokens: int,
+                worker_id=None) -> str:
+    """Router-side: serialize a remote-prefix hint for the annotations
+    dict (string-valued)."""
+    d = {"address": address, "covered_tokens": int(covered_tokens)}
+    if worker_id is not None:
+        d["worker"] = str(worker_id)
+    return json.dumps(d)
+
+
+def decode_hint(raw: Optional[str]) -> Optional[dict]:
+    """Worker-side: parse the hint; malformed hints (version-skewed
+    router) decode to None — never fail a request over telemetry."""
+    if not raw:
+        return None
+    try:
+        d = json.loads(raw)
+        address = d.get("address")
+        covered = int(d.get("covered_tokens", 0))
+        if not address or covered <= 0:
+            return None
+        return {"address": address, "covered_tokens": covered,
+                "worker": d.get("worker")}
+    except (ValueError, TypeError, AttributeError):
+        logger.warning("ignoring malformed remote_prefix hint: %r", raw)
+        return None
+
+
+def attach_hint(request, address: str, covered_tokens: int,
+                worker_id=None) -> None:
+    """Attach a remote-prefix hint to a PreprocessedRequest (the router
+    side of the handshake; shared with tests so both ends agree by
+    construction)."""
+    request.annotations[HINT_ANNOTATION] = encode_hint(
+        address, covered_tokens, worker_id)
+
+
+class PrefixFetcher:
+    """Pulls a peer's sealed prefix blocks into the local engine.
+
+    One fetcher per worker (not per request): it owns the cumulative
+    counters `KvCacheMetrics.observe_prefix_share` samples into
+    `dynamo_prefix_remote_{hits,pulled_blocks,fallbacks}_total`.
+
+    `rpc_for(address)` returns a (cached) RpcClient — the runtime's
+    `client_for` on a real worker, a stub in tests/bench.
+    """
+
+    def __init__(self, engine, rpc_for: Callable[[str], object],
+                 block_size: int, *,
+                 max_inflight: int = 2,
+                 batch_blocks: int = EXPORT_BATCH_BLOCKS,
+                 pull_timeout: Optional[float] = None) -> None:
+        """`pull_timeout`: hard per-pull budget in seconds.  Default
+        (None) scales with the pull size — ~2 s floor + 50 ms/block,
+        capped at 30 s — so an alive-but-trickling donor cannot stall
+        TTFT far past what simply prefilling locally would have cost
+        (the pull sits on the admission path)."""
+        self.engine = engine
+        self._rpc_for = rpc_for
+        self.block_size = block_size
+        self.max_inflight = max(1, max_inflight)
+        self.batch_blocks = max(1, batch_blocks)
+        self.pull_timeout = pull_timeout
+        # One pull per prefix head at a time: a burst of requests
+        # sharing a root must not fetch the identical blocks N times —
+        # later pulls wait, re-check residency, and skip the wire.
+        self._inflight: Dict[int, List] = {}   # head hash → [lock, refs]
+        # Cumulative accounting (monotonic; sampled by KvCacheMetrics).
+        self.remote_hits = 0        # pulls that covered >= 1 new block
+        self.pulled_blocks = 0      # blocks injected from peers
+        self.pulled_tokens = 0
+        self.fallbacks = 0          # failed/refused pulls (local prefill)
+
+    def _timeout_for(self, blocks: int) -> float:
+        if self.pull_timeout is not None:
+            return self.pull_timeout
+        return min(30.0, 2.0 + 0.05 * blocks)
+
+    async def pull(self, prompt_tokens: List[int], address: str,
+                   covered_tokens: int = 0) -> int:
+        """Pull up to `covered_tokens` (the donor's high-water mark; <=0
+        means every sealed block) of the prompt's sealed prefix from the
+        peer at `address`.  Returns tokens now locally covered.  Never
+        raises: transfer errors, donor death and kv-quant refusals count
+        a fallback and return whatever contiguous prefix landed — the
+        caller's local prefill covers the rest."""
+        hashes = sealed_hashes(list(prompt_tokens), self.block_size)
+        want_blocks = len(hashes)
+        if covered_tokens > 0:
+            want_blocks = min(want_blocks,
+                              covered_tokens // self.block_size)
+        if want_blocks <= 0:
+            return 0
+        hashes = hashes[:want_blocks]
+        # Serialize pulls that share a prefix head: the burst case is N
+        # spilled requests with the SAME hint — the first pull does the
+        # wire work, the rest find the blocks resident below.
+        entry = self._inflight.get(hashes[0])
+        if entry is None:
+            entry = self._inflight[hashes[0]] = [asyncio.Lock(), 0]
+        entry[1] += 1
+        try:
+            async with entry[0]:
+                return await self._pull_locked(prompt_tokens, address,
+                                               hashes, want_blocks)
+        finally:
+            entry[1] -= 1
+            if entry[1] == 0:
+                self._inflight.pop(hashes[0], None)
+
+    async def _pull_locked(self, prompt_tokens, address: str,
+                           hashes: List[int], want_blocks: int) -> int:
+        from dynamo_tpu.runtime import tracing
+
+        # Locally resident prefix needs no wire work (a repeat request,
+        # a prefix an earlier pull landed, or — on disagg decode — the
+        # blocks a remote prefill already onboarded).
+        local = await self._resident_blocks(hashes)
+        if local >= want_blocks:
+            return local * self.block_size
+        # The inject frontier survives a failed pull: blocks that landed
+        # before a donor death stay injected + registered, so the local
+        # prefill fallback prefix-matches them (landed-prefix reuse, the
+        # PR-4 discipline).
+        progress = {"frontier": local}
+        with tracing.get_tracer().start_span(
+                "kv.prefix_share",
+                attrs={"donor": address, "blocks_wanted": want_blocks,
+                       "blocks_local": local}) as span:
+            try:
+                covered = await asyncio.wait_for(
+                    self._pull_batches(hashes, local, address,
+                                       list(prompt_tokens), progress),
+                    self._timeout_for(want_blocks - local))
+            except (asyncio.TimeoutError, ConnectionError, OSError,
+                    RpcError) as e:
+                self.fallbacks += 1
+                covered = progress["frontier"] * self.block_size
+                span.set_attr(fallback="local", error=type(e).__name__)
+                logger.warning(
+                    "remote-prefix pull from %s failed (%s); prefilling "
+                    "locally%s", address, e,
+                    f" (reusing {covered} landed tokens)" if covered
+                    else "")
+            except ValueError as e:
+                # Un-injectable blocks: a kv-quant-mode mismatch between
+                # peers (engine _validate_block).  Every block would fail
+                # identically — refuse the donor loudly and prefill
+                # locally rather than serve corrupt KV.
+                self.fallbacks += 1
+                covered = progress["frontier"] * self.block_size
+                span.set_attr(fallback="local", error="kv_mode_mismatch")
+                logger.error(
+                    "remote-prefix pull from %s REFUSED — peer KV blocks "
+                    "are not injectable here (mixed --kv-quant modes?): "
+                    "%s", address, e)
+            gained = covered // self.block_size - local
+            if gained > 0:
+                self.remote_hits += 1
+                self.pulled_blocks += gained
+                self.pulled_tokens += gained * self.block_size
+            span.set_attr(blocks_pulled=max(0, gained),
+                          tokens_covered=covered)
+            return covered
+
+    async def _resident_blocks(self, hashes) -> int:
+        fn = getattr(self.engine, "resident_prefix_blocks", None)
+        if fn is None:
+            return 0
+        try:
+            return int(await fn(hashes))
+        except Exception:
+            return 0
+
+    async def _pull_batches(self, hashes: List[int], local: int,
+                            address: str, prompt_tokens: List[int],
+                            progress: Dict[str, int]) -> int:
+        """Bounded in-flight batch pulls over [local, len(hashes)), with
+        an ordered inject frontier; gaps failed batches left are
+        refetched gap-only (post-gap blocks already on hand are reused,
+        not re-pulled), and a final `pull_prefix` residual pass mops up
+        whatever remains.  Returns covered tokens and mirrors the
+        frontier into `progress` (what the caller keeps when this
+        raises).  kv-quant ValueErrors and terminal transfer errors
+        propagate."""
+        sem = asyncio.Semaphore(self.max_inflight)
+        ready: Dict[int, np.ndarray] = {}
+        inject_lock = asyncio.Lock()
+        frontier = local              # contiguous blocks injected so far
+        refused: List[ValueError] = []
+        stalled = [False]             # device pool refused injects
+        rpc = self._rpc_for(address)
+
+        async def inject_ready():
+            nonlocal frontier
+            async with inject_lock:
+                run: Dict[int, np.ndarray] = {}
+                i = frontier
+                while i in ready:
+                    run[hashes[i]] = ready.pop(i)
+                    i += 1
+                if not run:
+                    return
+                injected = await self.engine.import_blocks(run)
+                if injected == len(run):
+                    frontier = i
+                else:
+                    # Short inject: the device pool is pinned full (or a
+                    # concurrent request raced the same blocks in).  The
+                    # honest frontier is what is actually RESIDENT —
+                    # claiming coverage that never landed would report
+                    # remote hits for prefill the engine still pays.
+                    resident = await self._resident_blocks(hashes)
+                    frontier = max(frontier, min(i, resident))
+                    if frontier < i:
+                        stalled[0] = True   # no capacity: stop pulling
+                progress["frontier"] = frontier
+
+        async def pull_batch(lo: int, hi: int):
+            async with sem:
+                if refused or stalled[0]:
+                    return
+                try:
+                    blocks = await fetch_blocks(rpc, hashes[lo:hi],
+                                                batch=self.batch_blocks)
+                except (ConnectionError, OSError, RpcError) as e:
+                    logger.warning("prefix-share batch [%d, %d) from %s "
+                                   "failed: %s", lo, hi, address, e)
+                    return  # gap: the gap-refetch pass covers it
+                for j, h in enumerate(hashes[lo:hi]):
+                    if h not in blocks:
+                        break  # hash-chain gap inside the batch
+                    ready[lo + j] = blocks[h]
+                try:
+                    await inject_ready()
+                except ValueError as e:
+                    refused.append(e)
+                    ready.clear()
+
+        tasks = [asyncio.ensure_future(pull_batch(
+                    lo, min(lo + self.batch_blocks, len(hashes))))
+                 for lo in range(local, len(hashes), self.batch_blocks)]
+        if tasks:
+            await asyncio.gather(*tasks)
+        if refused:
+            raise refused[0]
+        # Gap refetch: a failed batch mid-prefix must not force
+        # re-pulling the post-gap blocks that DID arrive — fetch only
+        # the missing ranges and let the frontier run through the held
+        # islands.  Progress-guarded: a donor that no longer holds the
+        # gap head ends the pass.
+        while frontier < len(hashes) and not stalled[0]:
+            gap_end = frontier
+            while gap_end < len(hashes) and gap_end not in ready:
+                gap_end += 1
+            before = frontier
+            if gap_end > frontier:
+                try:
+                    blocks = await fetch_blocks(
+                        rpc, hashes[frontier:gap_end],
+                        batch=self.batch_blocks)
+                except (ConnectionError, OSError, RpcError):
+                    break   # donor gone: pull_prefix below is the judge
+                for j, h in enumerate(hashes[frontier:gap_end]):
+                    if h not in blocks:
+                        break
+                    ready[frontier + j] = blocks[h]
+            await inject_ready()
+            if frontier <= before:
+                break       # no progress: donor lost the gap head
+        ready.clear()
+        if stalled[0] or frontier >= len(hashes):
+            return frontier * self.block_size
+        # Terminal residual: one ordered pull_prefix pass resuming from
+        # the contiguous frontier.  It stops on its own at whatever the
+        # donor no longer holds — and a dead donor raises HERE, which is
+        # what turns the pull into a counted local-prefill fallback.
+        return await pull_prefix(
+            self.engine, rpc,
+            prompt_tokens[: len(hashes) * self.block_size],
+            self.block_size, covered_tokens=frontier * self.block_size)
+
+
+class PrefixShareClient:
+    """EngineClient wrapper: consume the routed request's remote-prefix
+    hint before delegating to the inner client.  worker/main.py installs
+    it INNERMOST — directly in front of the local engine, inside any
+    disagg decode client — so on decode-role workers the pull runs after
+    a remote-prefill onboard (those blocks are then locally resident and
+    the fetcher's residency check skips the wire) while local-prefill
+    paths still pull the donor's prefix.
+
+    The pull happens-before engine admission, so the scheduler's
+    prefix-match sees the pulled blocks and prefills only the residual
+    tokens — observable in `Scheduler.prefix_{hit,miss}_tokens`.
+    """
+
+    def __init__(self, inner, fetcher: PrefixFetcher) -> None:
+        self.inner = inner
+        self.fetcher = fetcher
+
+    async def generate(self, request):
+        hint = decode_hint(request.annotations.get(HINT_ANNOTATION))
+        if hint is not None:
+            await self.fetcher.pull(request.token_ids, hint["address"],
+                                    hint["covered_tokens"])
+        async for delta in self.inner.generate(request):
+            yield delta

@@ -324,11 +324,15 @@ class ModelWatcher:
     def __init__(self, runtime: DistributedRuntime,
                  manager: ModelManager,
                  router_mode: str = "round_robin",
-                 migration_limit: int = 3) -> None:
+                 migration_limit: int = 3,
+                 registry=None) -> None:
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
         self.migration_limit = migration_limit
+        # Frontend MetricsRegistry: router-side series (e.g. the
+        # remote-prefix route counter) land on the frontend's /metrics.
+        self.registry = registry
         self._instances: Dict[str, set] = {}       # model → instance ids
         self._clients: Dict[str, Client] = {}
         self._kv_clients: Dict[str, object] = {}   # model → KvRoutedEngineClient
@@ -404,7 +408,8 @@ class ModelWatcher:
             KvRouterOp, MigrationOp, Pipeline, RemoteOp)
 
         router_op = (KvRouterOp(self.runtime,
-                                block_size=card.kv_block_size)
+                                block_size=card.kv_block_size,
+                                registry=self.registry)
                      if self.router_mode == "kv" else RemoteOp())
         pipeline = Pipeline([
             MigrationOp(limit=self.migration_limit),

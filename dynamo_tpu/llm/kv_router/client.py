@@ -40,7 +40,8 @@ class KvRoutedEngineClient:
     """EngineClient with KV-cache-aware worker selection."""
 
     def __init__(self, client, runtime, block_size: int = 64,
-                 config: Optional[KvRouterConfig] = None) -> None:
+                 config: Optional[KvRouterConfig] = None,
+                 registry=None) -> None:
         from dynamo_tpu.llm.discovery import delta_from_wire, request_to_wire
 
         self._to_wire = request_to_wire
@@ -72,6 +73,16 @@ class KvRoutedEngineClient:
         self._penalty: dict = {}
         self._penalty_ttl = 3.0
         self._last_decision = None  # last KVHitRateEvent (routing spans)
+        # Fleet prefix reuse: requests routed with a remote-prefix hint
+        # attached (the donor side of block_manager/prefix_share.py).
+        # Plain int always; a Prometheus counter too when the frontend
+        # hands us its registry (runtime/metrics.MetricsRegistry).
+        self.remote_hint_routes = 0
+        self._remote_routes_counter = (
+            registry.counter(
+                "router_remote_prefix_routes_total",
+                "Requests routed with a remote-prefix donor hint")
+            if registry is not None else None)
 
     async def start(self) -> None:
         self._sub = await self.runtime.cp.subscribe(KV_EVENTS_SUBJECT)
@@ -260,10 +271,38 @@ class KvRoutedEngineClient:
             route_span.end(error=type(e).__name__)
             raise
         ev = self._last_decision
+        donor = self.router.last_donor
+        donor_id = None
+        # Always clear first: a migration RETRY reuses the same request
+        # object (shared annotations dict), and a stale hint from the
+        # previous attempt could point at a donor that has since died.
+        from dynamo_tpu.llm.block_manager.prefix_share import HINT_ANNOTATION
+
+        request.annotations.pop(HINT_ANNOTATION, None)
+        if donor is not None:
+            # Fleet prefix reuse: the selected worker's overlap is poor
+            # but this live peer holds a deep prefix — tell the worker
+            # where to pull it from (address from the instance record;
+            # a donor that just vanished simply attaches no hint).
+            addr = next((i.address for i in self.client.instances()
+                         if i.instance_id == donor.worker_id), None)
+            if addr:
+                from dynamo_tpu.llm.block_manager.prefix_share import (
+                    attach_hint)
+
+                attach_hint(
+                    request, addr,
+                    donor.overlap_blocks * self.router.config.block_size,
+                    donor.worker_id)
+                donor_id = donor.worker_id
+                self.remote_hint_routes += 1
+                if self._remote_routes_counter is not None:
+                    self._remote_routes_counter.inc()
         route_span.end(
             worker=int(worker_id), overlap_blocks=int(overlap),
             candidates=(ev.candidates if ev is not None else len(workers)),
-            cost=(round(ev.cost, 3) if ev is not None else None))
+            cost=(round(ev.cost, 3) if ev is not None else None),
+            remote_prefix_donor=donor_id)
         logger.debug("kv-routed %s → worker %s (overlap %d blocks)",
                      request.request_id, worker_id, overlap)
         self._publish_seq("add", request.request_id, worker=worker_id,

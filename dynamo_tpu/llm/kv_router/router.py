@@ -28,7 +28,9 @@ from dynamo_tpu.llm.kv_router.protocols import RouterEvent, WorkerId
 from dynamo_tpu.llm.kv_router.scheduler import (
     DefaultWorkerSelector,
     KVHitRateEvent,
+    RemotePrefixHint,
     WorkerLoadSnapshot,
+    pick_donor,
 )
 from dynamo_tpu.llm.kv_router.sequence import ActiveSequencesMultiWorker
 from dynamo_tpu.tokens import compute_block_hashes
@@ -51,6 +53,14 @@ class KvRouterConfig:
     # ACTIVE_SEQUENCES_SUBJECT, kv_router.rs:62-63) — needed once more
     # than one frontend routes the same workers.
     replica_sync: bool = True
+    # Fleet-wide prefix reuse: when the chosen worker's overlap is poor
+    # but a peer's is deep, attach a remote-prefix hint (donor address +
+    # covered tokens) so the serving worker pulls the prefix
+    # peer-to-peer instead of recomputing it (scheduler.pick_donor →
+    # block_manager/prefix_share.py).
+    remote_prefix_hints: bool = True
+    remote_prefix_min_frac: float = 0.5    # donor must cover >= this
+    remote_prefix_min_gain_blocks: int = 2  # donor - chosen overlap floor
 
 
 class KvRouter:
@@ -80,6 +90,9 @@ class KvRouter:
             temperature=self.config.temperature,
             on_hit_rate_event=on_hit_rate_event,
         )
+        # Donor candidate of the LAST find_best_match (None when the
+        # chosen worker's own overlap was fine, or hints are disabled).
+        self.last_donor: Optional[RemotePrefixHint] = None
 
     def workers(self) -> List[WorkerId]:
         """Workers the router currently knows anything about (index
@@ -145,6 +158,19 @@ class KvRouter:
             for w in workers
         ]
         chosen = self.selector.select(candidates, request_blocks)
+
+        # Fleet prefix reuse: offer the deepest-overlap LIVE peer as a
+        # donor when it beats the chosen worker's own prefix coverage.
+        # Restricting scores to `workers` (the live instance set) plus
+        # remove_worker's index purge keeps hints off dead donors.
+        self.last_donor = None
+        if self.config.remote_prefix_hints:
+            live_scores = {w: overlaps.scores.get(w, 0) for w in workers}
+            self.last_donor = pick_donor(
+                live_scores, chosen.worker_id, chosen.overlap_blocks,
+                request_blocks,
+                min_donor_frac=self.config.remote_prefix_min_frac,
+                min_gain_blocks=self.config.remote_prefix_min_gain_blocks)
 
         if update_states:
             self.active.add_request(

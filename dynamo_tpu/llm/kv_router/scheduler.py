@@ -48,6 +48,63 @@ class KVHitRateEvent:
     candidates: int = 0
 
 
+@dataclass(frozen=True)
+class RemotePrefixHint:
+    """A donor candidate for fleet-wide prefix reuse: `worker_id` holds
+    the request's first `overlap_blocks` blocks (per the indexer's
+    stored-block events).  The routing client turns this into the
+    `remote_prefix` annotation — donor RPC address + covered-token
+    high-water mark — that the serving worker's PrefixFetcher consumes
+    (`block_manager/prefix_share.py`)."""
+
+    worker_id: WorkerId
+    overlap_blocks: int
+
+
+def pick_donor(
+    scores: Dict[WorkerId, int],
+    chosen: WorkerId,
+    chosen_overlap: int,
+    request_blocks: int,
+    *,
+    min_donor_frac: float = 0.5,
+    min_gain_blocks: int = 2,
+) -> Optional[RemotePrefixHint]:
+    """The remote-prefix donor decision: when the chosen worker's local
+    overlap is poor but a peer's is deep, pulling the peer's sealed
+    blocks beats recomputing them.
+
+    A peer qualifies as donor when it covers at least `min_donor_frac`
+    of the request's blocks AND beats the chosen worker's own overlap by
+    at least `min_gain_blocks` (a 1-block gain isn't worth a pull RPC).
+    Deepest overlap wins; EQUAL overlaps tie-break deterministically on
+    worker id (ascending) so replica routers agree on the donor and
+    tests are reproducible.  `scores` must already be restricted to
+    LIVE workers — `KvIndexer.remove_worker` purges departed workers
+    from the index, so hints never point at dead donors."""
+    if request_blocks <= 0:
+        return None
+
+    def id_key(w):
+        # Numeric ids compare numerically (lease ids are ints — worker 2
+        # must beat worker 10), everything else lexically; the type tag
+        # keeps mixed fleets deterministic.
+        return (0, w, "") if isinstance(w, int) else (1, 0, str(w))
+
+    floor = max(1, math.ceil(min_donor_frac * request_blocks))
+    best: Optional[RemotePrefixHint] = None
+    for w, ov in scores.items():
+        if w == chosen:
+            continue
+        if ov < floor or ov - chosen_overlap < min_gain_blocks:
+            continue
+        if (best is None or ov > best.overlap_blocks
+                or (ov == best.overlap_blocks
+                    and id_key(w) < id_key(best.worker_id))):
+            best = RemotePrefixHint(worker_id=w, overlap_blocks=ov)
+    return best
+
+
 @dataclass
 class WorkerLoadSnapshot:
     """Candidate worker state at selection time: router-local optimistic

@@ -445,6 +445,18 @@ class KvCacheMetrics:
         self.prefix_misses = registry.counter(
             "kv_prefix_cache_misses_tokens",
             "Prompt tokens that missed the prefix cache at admission")
+        # Fleet-wide prefix reuse (block_manager/prefix_share.py):
+        # peer-to-peer prefix pulls driven by router remote-prefix hints.
+        self.prefix_remote_hits = registry.counter(
+            "prefix_remote_hits_total",
+            "Requests whose prefix was pulled from a peer worker")
+        self.prefix_remote_pulled = registry.counter(
+            "prefix_remote_pulled_blocks_total",
+            "KV blocks injected from peer workers via prefix-share pulls")
+        self.prefix_remote_fallbacks = registry.counter(
+            "prefix_remote_fallbacks_total",
+            "Remote-prefix pulls that failed or were refused "
+            "(request fell back to local prefill)")
         self.hbm_used = registry.gauge(
             "hbm_used_bytes", "Accelerator memory in use")
         self.hbm_limit = registry.gauge(
@@ -482,6 +494,14 @@ class KvCacheMetrics:
         if cum > prev:
             counter.inc(cum - prev, labels=labels)
         self._last[key] = cum
+
+    def observe_prefix_share(self, fetcher) -> None:
+        """Sample a PrefixFetcher's cumulative pull accounting into the
+        dynamo_prefix_remote_* counters (same pull-style delta
+        conversion as the pool counters)."""
+        self._inc_to(self.prefix_remote_hits, {}, fetcher.remote_hits)
+        self._inc_to(self.prefix_remote_pulled, {}, fetcher.pulled_blocks)
+        self._inc_to(self.prefix_remote_fallbacks, {}, fetcher.fallbacks)
 
     def observe_pool(self, pool, tier: str) -> None:
         """Sample one BlockPool's occupancy + eviction counters."""

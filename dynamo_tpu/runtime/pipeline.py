@@ -64,15 +64,18 @@ class KvRouterOp:
     """KV-aware worker selection over the instance set (llm/kv_router/
     client.py; reference `kv_router.rs:304` KvPushRouter)."""
 
-    def __init__(self, runtime, block_size: int = 64) -> None:
+    def __init__(self, runtime, block_size: int = 64,
+                 registry=None) -> None:
         self.runtime = runtime
         self.block_size = block_size
+        self.registry = registry  # frontend MetricsRegistry (router series)
 
     async def wrap(self, inner):
         from dynamo_tpu.llm.kv_router.client import KvRoutedEngineClient
 
         routed = KvRoutedEngineClient(inner, self.runtime,
-                                      block_size=self.block_size)
+                                      block_size=self.block_size,
+                                      registry=self.registry)
         await routed.start()
         return routed
 

@@ -131,6 +131,11 @@ class SloMonitor:
             self._g_state = registry.gauge(
                 "slo_state", "Overall SLO state: 0 OK, 1 WARN, 2 PAGE")
         self.state = OK
+        # Worst fast-window burn across objectives at the last tick —
+        # a cheap attribute read for hot-path consumers (the block
+        # pool's SLO eviction bias runs on the engine thread and must
+        # not recompute windows per eviction).
+        self.last_max_burn = 0.0
         self._task: Optional[asyncio.Task] = None
 
     # -- evaluation -------------------------------------------------------
@@ -173,6 +178,7 @@ class SloMonitor:
         now = self._clock() if now is None else now
         rows = []
         worst = OK
+        worst_burn = 0.0
         for obj, source in self.objectives:
             total, bad = source()
             dq = self._series[obj.name]
@@ -195,6 +201,7 @@ class SloMonitor:
                 state = OK
             if _STATE_NUM[state] > _STATE_NUM[worst]:
                 worst = state
+            worst_burn = max(worst_burn, burn_fast)
             if self._g_burn is not None:
                 self._g_burn.set(burn_fast, labels={
                     "objective": obj.name, "window": "fast"})
@@ -219,6 +226,7 @@ class SloMonitor:
                 "state": state,
             })
         self.state = worst
+        self.last_max_burn = worst_burn
         if self._g_state is not None:
             self._g_state.set(float(_STATE_NUM[worst]))
         return {

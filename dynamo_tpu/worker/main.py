@@ -61,6 +61,11 @@ def parse_args(argv=None):
                         "(pull the whole sealed prefix only after the "
                         "prefill-done announcement, the pre-streaming "
                         "serial protocol)")
+    p.add_argument("--no-prefix-share", action="store_true",
+                   help="disable fleet-wide prefix reuse: ignore the "
+                        "router's remote-prefix hints instead of pulling "
+                        "a peer's sealed prefix blocks before prefill "
+                        "(this worker still serves kv_blocks as a donor)")
     p.add_argument("--mocker", action="store_true")
     p.add_argument("--model", default=None,
                    help="model preset name (random weights) or HF-layout "
@@ -408,6 +413,26 @@ async def run(args) -> None:
                                     registry=registry)
     if slo_monitor is not None:
         slo_monitor.start(interval=args.slo_tick)
+    # Fleet-wide prefix reuse: consume router remote-prefix hints by
+    # pulling the donor's sealed blocks over the kv_blocks plane before
+    # engine admission (block_manager/prefix_share.py).  INNERMOST
+    # wrapper — directly in front of the local engine — so on a
+    # decode-role worker the pull runs AFTER any disagg remote-prefill
+    # onboard: blocks the prefill worker already delivered are locally
+    # resident by then and the fetcher's residency check skips the wire
+    # entirely, while a failed/local-prefill path still benefits from
+    # the donor's blocks.  Every real engine also SERVES kv_blocks
+    # above, so any worker is a donor.
+    prefix_fetcher = None
+    serve_base = engine
+    if transfer_engine is not None and not args.no_prefix_share:
+        from dynamo_tpu.llm.block_manager.prefix_share import (
+            PrefixFetcher, PrefixShareClient)
+
+        prefix_fetcher = PrefixFetcher(
+            transfer_engine, runtime.client_for, args.block_size)
+        serve_base = PrefixShareClient(engine, prefix_fetcher)
+
     if args.role == "decode":
         from dynamo_tpu.llm.disagg import DisaggDecodeClient, disagg_config_key
 
@@ -415,13 +440,24 @@ async def run(args) -> None:
             await cp.put(disagg_config_key(args.namespace),
                          {"max_local_prefill_length": args.max_local_prefill})
         disagg_client = DisaggDecodeClient(
-            engine, transfer_engine, cp, args.namespace, args.block_size,
+            serve_base, transfer_engine, cp, args.namespace, args.block_size,
             transfer_plane=transfer_plane, request_metrics=request_metrics,
             eager=not args.no_eager_kv)
         await disagg_client.start()
         serve_client = disagg_client
     else:
-        serve_client = engine
+        serve_client = serve_base
+
+    # SLO-aware tier demotion: while the error budget burns, hot prefix
+    # blocks resist device→host→disk demotion (pool.slo_eviction_bias
+    # over the monitor's cheap last_max_burn attribute).
+    if slo_monitor is not None and transfer_engine is not None:
+        manager = getattr(transfer_engine.core.allocator, "manager", None)
+        if manager is not None:
+            from dynamo_tpu.llm.block_manager.pool import slo_eviction_bias
+
+            manager.set_eviction_bias(slo_eviction_bias(
+                lambda: slo_monitor.last_max_burn))
 
     instance = await endpoint.serve(engine_wire_handler(
         serve_client, request_metrics=request_metrics))
@@ -479,6 +515,8 @@ async def run(args) -> None:
             # never the engine thread.
             if core is not None:
                 kv_metrics.observe_engine(core)
+            if prefix_fetcher is not None:
+                kv_metrics.observe_prefix_share(prefix_fetcher)
             return "\n".join(lines) + "\n"
 
         status = StatusServer(

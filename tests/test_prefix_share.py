@@ -1,0 +1,633 @@
+"""Fleet-wide prefix reuse (ISSUE 7): router donor hints, peer-to-peer
+prefix pulls, SLO-aware tier eviction.
+
+Two-worker e2e: a request routed to a NON-holder with a >=50%-shared
+prefix pulls the prefix from the donor over the kv_blocks plane,
+prefills only the residual tokens (asserted via scheduler admission
+counters), and emits byte-identical greedy output.  Donor death
+mid-pull falls back to local prefill with zero failed requests; a
+mixed-kv-quant donor is refused loudly.  The heavy full-stack fleet
+variant is slow-marked (tier-1 runs close to its timeout).
+"""
+
+import asyncio
+import logging
+
+import pytest
+
+from dynamo_tpu.engine.engine import EngineConfig, EngineCore, InferenceEngine
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.llm.block_manager.pool import BlockPool, slo_eviction_bias
+from dynamo_tpu.llm.block_manager.prefix_share import (
+    HINT_ANNOTATION,
+    PrefixFetcher,
+    PrefixShareClient,
+    attach_hint,
+    decode_hint,
+)
+from dynamo_tpu.llm.block_manager.transfer import (
+    KV_BLOCKS_ENDPOINT,
+    make_kv_blocks_handler,
+)
+from dynamo_tpu.llm.kv_router.protocols import (
+    KvCacheEvent,
+    KvCacheEventData,
+    RouterEvent,
+)
+from dynamo_tpu.llm.kv_router.router import KvRouter, KvRouterConfig
+from dynamo_tpu.llm.kv_router.scheduler import pick_donor
+from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+from dynamo_tpu.llm.service import LocalEngineClient
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.runtime.rpc import RpcClient
+
+TINY = mcfg.get_config("tiny-test")
+BS = 8
+LONG_PROMPT = list(range(1, 36))   # 4 sealed blocks + 3-token tail
+
+
+def _core(kv_quant="none", kv_event_sink=None):
+    return EngineCore(EngineConfig(
+        model=TINY, num_blocks=64, kv_quant=kv_quant,
+        scheduler=SchedulerConfig(
+            max_seqs=4, block_size=BS, max_pages_per_seq=8,
+            max_prefill_chunk=16,
+            decode_buckets=(1, 2, 4), prefill_buckets=(8, 16))),
+        kv_event_sink=kv_event_sink)
+
+
+class _Worker:
+    """One in-process worker: engine + RPC server with kv_blocks, plus
+    a captured KV-event stream (what the real worker pumps to the
+    router)."""
+
+    def __init__(self, kv_quant="none"):
+        self.kv_quant = kv_quant
+        self.events = []
+
+    async def start(self):
+        from dynamo_tpu.runtime.rpc import RpcServer
+
+        self.engine = InferenceEngine(
+            _core(self.kv_quant, kv_event_sink=self.events.append))
+        await self.engine.start()
+        self.client = LocalEngineClient(self.engine)
+        self.rpc = RpcServer()
+        self.rpc.register(KV_BLOCKS_ENDPOINT,
+                          make_kv_blocks_handler(self.engine))
+        self.address = await self.rpc.start()
+        return self
+
+    async def stop(self):
+        await self.rpc.stop()
+        await self.engine.stop()
+
+
+async def _collect(client, rid, prompt, n=4, annotations=None):
+    req = PreprocessedRequest(request_id=rid, model="m",
+                              token_ids=list(prompt),
+                              sampling=SamplingParams(max_tokens=n),
+                              annotations=dict(annotations or {}))
+    out = []
+    async for d in client.generate(req):
+        out.extend(d.token_ids)
+        if d.finished:
+            assert d.finish_reason is not None
+            assert d.finish_reason.value != "error"
+            break
+    return out
+
+
+def _run(coro):
+    return asyncio.run(asyncio.wait_for(coro, 180))
+
+
+def _route_spilled(donor_events, prompt, donor_id="A", other_id="B"):
+    """Feed the donor's real KV events into a KvRouter, load the donor
+    so the selector spills the repeat request onto the other worker,
+    and return (chosen, overlap, last_donor)."""
+    router = KvRouter(KvRouterConfig(block_size=BS))
+    for ev in donor_events:
+        router.apply_event(RouterEvent(worker_id=donor_id, event=ev))
+    # The donor is busy: optimistic accounting carries a fat in-flight
+    # request, so the spilled worker wins on load despite zero overlap.
+    router.active.add_request("busy", donor_id, 512, 0,
+                              expected_output_tokens=512)
+    chosen, overlap = router.find_best_match(
+        "r1", prompt, [donor_id, other_id])
+    return router, chosen, overlap
+
+
+def test_remote_prefix_pull_e2e():
+    """A >=50%-shared-prefix request lands on the non-holder, pulls the
+    donor's 4 sealed blocks peer-to-peer, prefills only the 3-token
+    residual, and emits byte-identical greedy output."""
+
+    async def main():
+        wa = await _Worker().start()
+        wb = await _Worker().start()
+        rpc = RpcClient(wa.address)
+        try:
+            want = await _collect(wa.client, "seed", LONG_PROMPT)
+            router, chosen, overlap = _route_spilled(
+                [e for e in wa.events], LONG_PROMPT)
+            assert chosen == "B" and overlap == 0
+            donor = router.last_donor
+            assert donor is not None and donor.worker_id == "A"
+            assert donor.overlap_blocks == 4
+
+            fetcher = PrefixFetcher(wb.engine, lambda a: rpc, BS)
+            psc = PrefixShareClient(wb.client, fetcher)
+            req = PreprocessedRequest(
+                request_id="r1", model="m", token_ids=list(LONG_PROMPT),
+                sampling=SamplingParams(max_tokens=4))
+            attach_hint(req, wa.address, donor.overlap_blocks * BS,
+                        donor.worker_id)
+            got = []
+            async for d in psc.generate(req):
+                got.extend(d.token_ids)
+                if d.finished:
+                    break
+            assert got == want                       # byte-identical
+            sched = wb.engine.core.scheduler
+            # Residual-only prefill: the 4 pulled blocks hit at
+            # admission; only the 3-token tail missed.
+            assert sched.prefix_hit_tokens == 4 * BS
+            assert sched.prefix_miss_tokens == len(LONG_PROMPT) - 4 * BS
+            assert fetcher.remote_hits == 1
+            assert fetcher.pulled_blocks == 4
+            assert fetcher.fallbacks == 0
+            assert wb.engine.core.allocator.manager.onboarded_blocks == 4
+        finally:
+            await rpc.close()
+            await wa.stop()
+            await wb.stop()
+
+    _run(main())
+
+
+def test_donor_death_falls_back_to_local():
+    """The hint points at a dead donor: the pull fails over to plain
+    local prefill — zero failed requests, byte-identical output."""
+
+    async def main():
+        wa = await _Worker().start()
+        wb = await _Worker().start()
+        try:
+            want = await _collect(wa.client, "seed", LONG_PROMPT)
+            dead_address = wa.address
+            await wa.rpc.stop()     # donor dies before the pull
+
+            fetcher = PrefixFetcher(wb.engine, lambda a: RpcClient(a), BS,
+                                    pull_timeout=10.0)
+            psc = PrefixShareClient(wb.client, fetcher)
+            got = await _collect(psc, "r1", LONG_PROMPT, annotations={
+                HINT_ANNOTATION:
+                    '{"address": "%s", "covered_tokens": %d}'
+                    % (dead_address, 4 * BS)})
+            assert got == want                       # request survived
+            assert fetcher.fallbacks == 1
+            assert fetcher.remote_hits == 0
+            sched = wb.engine.core.scheduler
+            assert sched.prefix_hit_tokens == 0      # full local prefill
+        finally:
+            await wa.engine.stop()   # rpc already stopped mid-test
+            await wb.stop()
+
+    _run(main())
+
+
+def test_mixed_kv_quant_peer_refused_loudly(caplog):
+    """An int8 donor's packed blocks must be REFUSED by a bf16 worker —
+    pointed error log, fallback to local prefill, no junk in the cache."""
+
+    async def main():
+        wa = await _Worker(kv_quant="int8").start()
+        wb = await _Worker().start()
+        rpc = RpcClient(wa.address)
+        try:
+            await _collect(wa.client, "seed", LONG_PROMPT)
+            fetcher = PrefixFetcher(wb.engine, lambda a: rpc, BS)
+            psc = PrefixShareClient(wb.client, fetcher)
+            with caplog.at_level(
+                    logging.ERROR,
+                    logger="dynamo_tpu.llm.block_manager.prefix_share"):
+                got = await _collect(psc, "r1", LONG_PROMPT, annotations={
+                    HINT_ANNOTATION:
+                        '{"address": "%s", "covered_tokens": %d}'
+                        % (wa.address, 4 * BS)})
+            assert fetcher.fallbacks == 1 and fetcher.remote_hits == 0
+            assert any("REFUSED" in r.message for r in caplog.records)
+            mgr = wb.engine.core.allocator.manager
+            assert mgr.onboarded_blocks == 0         # nothing injected
+            # The fallback output is a plain deterministic local decode:
+            # a repeat of the same prompt reproduces it exactly.
+            again = await _collect(wb.client, "r2", LONG_PROMPT)
+            assert got == again and len(got) == 4
+        finally:
+            await rpc.close()
+            await wa.stop()
+            await wb.stop()
+
+    _run(main())
+
+
+import numpy as np
+
+from dynamo_tpu.llm.block_manager.transfer import encode_block, sealed_hashes
+
+_PROMPT4 = list(range(1, 4 * BS + 1))            # 4 sealed blocks
+_HASHES4 = sealed_hashes(_PROMPT4, BS)
+_BLOCK = np.zeros((2, 1, BS, 4), np.float32)
+
+
+class _ScriptWire:
+    """kv_blocks stub: call N fails when N is in `fail_calls`; counts
+    blocks actually served over the wire."""
+
+    def __init__(self, fail_calls=(), die_after=None):
+        self.fail_calls = set(fail_calls)
+        self.die_after = die_after   # every call past this one fails
+        self.calls = 0
+        self.served = 0
+
+    def call(self, endpoint, payload):
+        self.calls += 1
+        n = self.calls
+
+        async def gen():
+            if n in self.fail_calls or (self.die_after is not None
+                                        and n > self.die_after):
+                raise ConnectionError("donor died")
+            for h in payload["hashes"]:
+                self.served += 1
+                yield encode_block(h, _BLOCK)
+
+        return gen()
+
+
+class _Sink:
+    def __init__(self, accept=True):
+        self.accept = accept
+        self.imported = []
+
+    async def import_blocks(self, blocks):
+        if not self.accept:
+            return 0
+        self.imported.extend(blocks)
+        return len(blocks)
+
+    async def resident_prefix_blocks(self, hashes):
+        n = 0
+        for h in hashes:
+            if n < len(self.imported) and self.imported[n] == h:
+                n += 1
+            else:
+                break
+        return n
+
+
+def test_partial_pull_keeps_landed_prefix():
+    """The donor dies mid-pull: the contiguous prefix that landed stays
+    injected and is counted; the failure still registers a fallback."""
+
+    async def main():
+        wire = _ScriptWire(die_after=1)   # first batch lands, then death
+        sink = _Sink()
+        fetcher = PrefixFetcher(sink, lambda a: wire, BS,
+                                max_inflight=1, batch_blocks=2)
+        covered = await fetcher.pull(_PROMPT4, "dead", 4 * BS)
+        # First 2-block batch landed before the death...
+        assert covered == 2 * BS
+        assert sink.imported == _HASHES4[:2]
+        # ...and the accounting shows both the partial hit and the
+        # fallback the residual failure triggered.
+        assert fetcher.remote_hits == 1
+        assert fetcher.pulled_blocks == 2
+        assert fetcher.fallbacks == 1
+
+    _run(main())
+
+
+def test_gap_refetch_reuses_post_gap_blocks():
+    """A transient failure on one batch refetches ONLY the gap: blocks
+    that already crossed the wire are injected, not re-pulled."""
+
+    async def main():
+        wire = _ScriptWire(fail_calls={1})   # batch [0,2) fails once
+        sink = _Sink()
+        fetcher = PrefixFetcher(sink, lambda a: wire, BS,
+                                max_inflight=2, batch_blocks=2)
+        covered = await fetcher.pull(_PROMPT4, "flaky", 4 * BS)
+        assert covered == 4 * BS
+        assert sink.imported == _HASHES4
+        # Wire traffic = the 4 prefix blocks exactly: the surviving
+        # batch's 2 blocks were reused, only the gap was refetched.
+        assert wire.served == 4
+        assert fetcher.remote_hits == 1 and fetcher.pulled_blocks == 4
+        assert fetcher.fallbacks == 0
+
+    _run(main())
+
+
+def test_concurrent_same_prefix_pulls_dedup():
+    """A burst of requests carrying the same hint transfers the prefix
+    ONCE: later pulls wait on the in-flight pull, find the blocks
+    resident, and skip the wire."""
+
+    async def main():
+        wire = _ScriptWire()
+        sink = _Sink()
+        fetcher = PrefixFetcher(sink, lambda a: wire, BS,
+                                max_inflight=2, batch_blocks=2)
+        covered = await asyncio.gather(*(
+            fetcher.pull(_PROMPT4, "donor", 4 * BS) for _ in range(3)))
+        assert covered == [4 * BS] * 3
+        assert wire.served == 4          # one transfer, not three
+        assert sink.imported == _HASHES4
+        assert fetcher.remote_hits == 1  # the burst is ONE remote hit
+        assert fetcher.pulled_blocks == 4
+
+    _run(main())
+
+
+def test_capacity_stall_reports_no_phantom_hits():
+    """A device pool that refuses injects must not report remote hits —
+    and the fetcher stops burning wire on blocks it cannot land."""
+
+    async def main():
+        wire = _ScriptWire()
+        sink = _Sink(accept=False)           # pool pinned full
+        fetcher = PrefixFetcher(sink, lambda a: wire, BS,
+                                max_inflight=1, batch_blocks=2)
+        covered = await fetcher.pull(_PROMPT4, "full", 4 * BS)
+        assert covered == 0
+        assert fetcher.remote_hits == 0
+        assert fetcher.pulled_blocks == 0
+        # The stall short-circuits the remaining batches.
+        assert wire.served <= 2
+
+    _run(main())
+
+
+# -- router policy units --------------------------------------------------
+
+
+def test_pick_donor_policy_and_tiebreak():
+    # Qualifying donor: covers >= 50% of 8 blocks and beats chosen by 2.
+    d = pick_donor({"A": 6, "B": 0}, chosen="B", chosen_overlap=0,
+                   request_blocks=8)
+    assert d is not None and d.worker_id == "A" and d.overlap_blocks == 6
+    # Below the coverage floor: no donor.
+    assert pick_donor({"A": 3, "B": 0}, "B", 0, 8) is None
+    # Insufficient gain over the chosen worker's own overlap.
+    assert pick_donor({"A": 6, "B": 5}, "B", 5, 8) is None
+    # The chosen worker never donates to itself.
+    assert pick_donor({"B": 8}, "B", 8, 8) is None
+    # Deterministic tie-break: equal overlap -> lowest worker id.
+    for _ in range(5):
+        d = pick_donor({"C": 6, "A": 6, "B": 6}, "Z", 0, 8)
+        assert d.worker_id == "A"
+    # Integer lease ids compare NUMERICALLY: worker 2 beats worker 10.
+    d = pick_donor({10: 6, 2: 6}, 99, 0, 8)
+    assert d.worker_id == 2
+
+
+def test_router_donor_lifecycle_and_dead_purge():
+    """last_donor comes from the live worker set and the indexer;
+    remove_worker purges the index so hints never name dead donors."""
+    from dynamo_tpu.llm.block_manager.transfer import sealed_hashes
+
+    prompt = list(range(1, 36))
+    hashes = sealed_hashes(prompt, BS)
+    router = KvRouter(KvRouterConfig(block_size=BS))
+    router.apply_event(RouterEvent(worker_id="A", event=KvCacheEvent(
+        event_id=1, data=KvCacheEventData.stored(hashes))))
+    router.active.add_request("busy", "A", 512, 0,
+                              expected_output_tokens=512)
+    chosen, _ = router.find_best_match("r1", prompt, ["A", "B"])
+    assert chosen == "B"
+    assert router.last_donor is not None
+    assert router.last_donor.worker_id == "A"
+    # A dead donor outside the live set is never offered...
+    router.find_best_match("r2", prompt, ["B"], update_states=False)
+    assert router.last_donor is None
+    # ...and remove_worker purges its residency outright.
+    router.remove_worker("A")
+    assert router.indexer.find_matches(hashes).scores == {}
+    router.find_best_match("r3", prompt, ["A", "B"], update_states=False)
+    assert router.last_donor is None
+
+
+def test_hint_codec_tolerates_garbage():
+    req = PreprocessedRequest(request_id="r", model="m", token_ids=[1],
+                              sampling=SamplingParams(max_tokens=1))
+    attach_hint(req, "1.2.3.4:5", 64, "w7")
+    h = decode_hint(req.annotations[HINT_ANNOTATION])
+    assert h == {"address": "1.2.3.4:5", "covered_tokens": 64,
+                 "worker": "w7"}
+    assert decode_hint(None) is None
+    assert decode_hint("") is None
+    assert decode_hint("not json") is None
+    assert decode_hint('{"covered_tokens": 8}') is None      # no address
+    assert decode_hint('{"address": "x", "covered_tokens": 0}') is None
+
+
+# -- SLO-aware eviction bias ----------------------------------------------
+
+
+def _inactive_pool(hot_hash):
+    """A full pool of inactive registered blocks 1..4 (LRU order 1
+    oldest) with `hot_hash` carrying prefix-cache hit history.  Hits are
+    stamped directly (an acquire/release would ALSO revive the block to
+    MRU — the bias exists precisely for hot blocks that have aged back
+    to the LRU head since their last hit)."""
+    pool = BlockPool(4, name="t")
+    for h in (1, 2, 3, 4):
+        [s] = pool.allocate(1)
+        pool.register(s, h)
+        pool.release([s])
+    pool.registry.lookup(hot_hash).hits = 2
+    return pool
+
+
+def test_acquire_matched_counts_slot_hits():
+    pool = BlockPool(2, name="t")
+    [s] = pool.allocate(1)
+    pool.register(s, 7)
+    pool.release([s])
+    slots = pool.match_sequence_hashes([7])
+    pool.release(pool.acquire_matched(slots))
+    assert pool.registry.lookup(7).hits == 1
+
+
+def test_slo_eviction_bias_protects_hot_blocks():
+    burn = {"v": 0.0}
+    # Budget healthy: pure LRU — the hot-but-oldest block 1 is evicted.
+    pool = _inactive_pool(hot_hash=1)
+    pool.set_eviction_bias(slo_eviction_bias(lambda: burn["v"]))
+    pool.allocate(1)
+    assert pool.registry.lookup(1) is None
+    assert pool.bias_protected == 0
+    # Budget burning: the hot LRU-oldest block survives; the oldest COLD
+    # block goes instead.
+    pool = _inactive_pool(hot_hash=1)
+    pool.set_eviction_bias(slo_eviction_bias(lambda: burn["v"]))
+    burn["v"] = 2.0
+    pool.allocate(1)
+    assert pool.registry.lookup(1) is not None   # hot prefix kept
+    assert pool.registry.lookup(2) is None       # cold LRU evicted
+    assert pool.bias_protected == 1
+    # A broken burn signal degrades to LRU instead of wedging eviction.
+    pool = _inactive_pool(hot_hash=1)
+
+    def boom():
+        raise RuntimeError("signal gone")
+
+    pool.set_eviction_bias(slo_eviction_bias(boom))
+    pool.allocate(1)
+    assert pool.registry.lookup(1) is None
+
+
+def test_manager_bias_applies_to_demoting_tiers():
+    from dynamo_tpu.llm.block_manager.manager import (
+        KvBlockManager, TieredConfig)
+
+    mgr = KvBlockManager(TieredConfig(
+        device_blocks=8, host_blocks=4, block_size=BS))
+    bias = slo_eviction_bias(lambda: 2.0)
+    mgr.set_eviction_bias(bias)
+    assert mgr.device.eviction_bias is bias
+    assert mgr.host.eviction_bias is bias
+    mgr.close()
+
+
+# -- metrics + dynamo top -------------------------------------------------
+
+
+class _StubFetcher:
+    remote_hits = 2
+    pulled_blocks = 9
+    fallbacks = 1
+
+
+def test_prefix_share_metrics_deltas():
+    from dynamo_tpu.runtime.metrics import KvCacheMetrics, MetricsRegistry
+
+    reg = MetricsRegistry()
+    kv = KvCacheMetrics(reg)
+    kv.observe_prefix_share(_StubFetcher())
+    kv.observe_prefix_share(_StubFetcher())   # same cumulatives: no double
+    text = reg.expose()
+    assert "dynamo_prefix_remote_hits_total 2" in text
+    assert "dynamo_prefix_remote_pulled_blocks_total 9" in text
+    assert "dynamo_prefix_remote_fallbacks_total 1" in text
+
+
+def test_dynamo_top_remote_hit_column():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "dynamo_top", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "dynamo_top.py"))
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+    samples = [("dynamo_prefix_remote_hits_total", {}, 3.0),
+               ("dynamo_prefix_remote_fallbacks_total", {}, 1.0)]
+    row = top.summarize("worker-both", "127.0.0.1:1", samples, None)
+    assert row["remote_hits"] == 3.0
+    assert row["remote_fallbacks"] == 1.0
+    table = top.render_table({"control_plane": "cp", "processes": [row]})
+    assert "RHIT" in table.splitlines()[1]
+    assert any(h == "RHIT" for h, _, _ in top.COLUMNS)
+
+
+# -- full-stack fleet variant (heavy: real engines behind the runtime) ----
+
+
+@pytest.mark.slow
+def test_fleet_prefix_share_full_stack():
+    """The wiring worker/main.py + the frontend use, end to end: real
+    engines served over the runtime with PrefixShareClient, KV events
+    pumped to a KvRoutedEngineClient that attaches hints; concurrent
+    shared-prefix requests spill off the holder and pull the prefix
+    peer-to-peer."""
+    from dynamo_tpu.llm.discovery import engine_wire_handler
+    from dynamo_tpu.llm.kv_router.client import KvRoutedEngineClient
+    from dynamo_tpu.runtime.control_plane import InProcessControlPlane
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        rts = [DistributedRuntime(cp), DistributedRuntime(cp)]
+        workers, fetchers, insts = [], [], []
+        for rt in rts:
+            w = _Worker()
+            w.engine = InferenceEngine(
+                _core(kv_event_sink=w.events.append))
+            await w.engine.start()
+            w.client = LocalEngineClient(w.engine)
+            rt.rpc.register(KV_BLOCKS_ENDPOINT,
+                            make_kv_blocks_handler(w.engine))
+            fetcher = PrefixFetcher(w.engine, rt.client_for, BS)
+            serve = PrefixShareClient(w.client, fetcher)
+            ep = (rt.namespace("dyn").component("backend")
+                  .endpoint("generate"))
+            inst = await ep.serve(engine_wire_handler(serve))
+            workers.append(w)
+            fetchers.append(fetcher)
+            insts.append(inst)
+
+        async def pump(w, iid):
+            sent = 0
+            while True:
+                await asyncio.sleep(0.005)
+                while sent < len(w.events):
+                    ev = w.events[sent]
+                    sent += 1
+                    await cp.publish("kv_events", RouterEvent(
+                        worker_id=iid, event=ev).to_dict())
+
+        pumps = [asyncio.create_task(pump(w, inst.instance_id))
+                 for w, inst in zip(workers, insts)]
+        client = await (rts[0].namespace("dyn").component("backend")
+                        .endpoint("generate").client())
+        await client.wait_for_instances()
+        kv = KvRoutedEngineClient(client, rts[0], block_size=BS)
+        await kv.start()
+
+        async def run_one(rid, n=4):
+            out = []
+            req = PreprocessedRequest(
+                request_id=rid, model="m", token_ids=list(LONG_PROMPT),
+                sampling=SamplingParams(max_tokens=n))
+            async for d in kv.generate(req):
+                out.extend(d.token_ids)
+            return out
+
+        try:
+            want = await run_one("warm", n=4)
+            await asyncio.sleep(0.1)          # let STORED events index
+            # Concurrent repeats: optimistic load spills some off the
+            # holder; spilled ones carry hints and pull peer-to-peer.
+            outs = await asyncio.gather(*(run_one(f"r{i}", n=16)
+                                          for i in range(4)))
+            assert all(o[:4] == want for o in outs)
+            assert kv.remote_hint_routes >= 1
+            assert sum(f.remote_hits for f in fetchers) >= 1
+            assert sum(f.fallbacks for f in fetchers) == 0
+        finally:
+            for t in pumps:
+                t.cancel()
+            await kv.stop()
+            await client.stop()
+            for w in workers:
+                await w.engine.stop()
+            for rt in rts:
+                await rt.shutdown()
+            await cp.close()
+
+    _run(main())

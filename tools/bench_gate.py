@@ -264,6 +264,31 @@ def decode_wall_checks() -> dict:
     }
 
 
+def prefix_fleet_checks() -> dict:
+    """ISSUE 7 smoke: fleet-wide prefix reuse measured on CPU — the real
+    router must hand out remote-prefix hints on the shared-prefix
+    workload (remote_hit_rate >= 0.2, the TPU gate floor), remote reuse
+    must beat local-only modeled TTFT, and the real PrefixFetcher must
+    pull + inject the full context over the mocked wire with zero
+    fallbacks."""
+    import asyncio
+
+    from dynamo_tpu.bench.prefix_fleet import run_prefix_fleet
+
+    out = asyncio.run(asyncio.wait_for(run_prefix_fleet(), 120))
+    measured = out["measured"]
+    return {
+        "prefix_fleet_remote_hit_rate": out["remote_hit_rate"],
+        "prefix_fleet_hit_rate_ok": out["remote_hit_rate"] >= 0.2,
+        "prefix_fleet_ttft_speedup": out["modeled_ttft_speedup"],
+        "prefix_fleet_reuse_beats_local": out["modeled_ttft_speedup"] > 1.0,
+        "prefix_fleet_pull_wall_ms": round(
+            measured["pull_wall_s"] * 1e3, 1),
+        "prefix_fleet_pull_complete": (measured["all_blocks_injected"]
+                                       and measured["fallbacks"] == 0),
+    }
+
+
 def run_smoke(args) -> int:
     """Mocker-backed smoke of the whole measurement loop — CPU-only, no
     JAX device work, fast enough for tier-1.
@@ -338,7 +363,8 @@ def run_smoke(args) -> int:
                     mixed_prefill_decode={"interference_ratio": 0.88},
                     kv_quant={"traffic_ratio": 0.531},
                     spec_decode={"acceptance_rate": 0.9,
-                                 "modeled_decode_speedup": 1.9})
+                                 "modeled_decode_speedup": 1.9},
+                    prefix_fleet={"remote_hit_rate": 0.34})
     tpu_low_mbu = dict(tpu_good, mbu=0.60)
     tpu_interfered = dict(
         tpu_good, mixed_prefill_decode={"interference_ratio": 0.70})
@@ -348,6 +374,10 @@ def run_smoke(args) -> int:
     tpu_low_accept = dict(
         tpu_good, spec_decode={"acceptance_rate": 0.3,
                                "modeled_decode_speedup": 1.9})
+    # ISSUE-7 floor: a fleet that stopped handing out remote-prefix
+    # hints (remote_hit_rate collapsed) must fail.
+    tpu_no_remote = dict(tpu_good,
+                         prefix_fleet={"remote_hit_rate": 0.05})
 
     from dynamo_tpu.bench.disagg import run_disagg_ttft_model
 
@@ -369,6 +399,8 @@ def run_smoke(args) -> int:
                                             tpu_fat_quant).ok,
         "low_acceptance_fails": not gate.compare(tpu_low_accept,
                                                  tpu_low_accept).ok,
+        "no_remote_hits_fails": not gate.compare(tpu_no_remote,
+                                                 tpu_no_remote).ok,
         "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),
         "disagg_ttft_streamed_ms": round(
             disagg["ttft_streamed_s"] * 1e3, 1),
@@ -379,6 +411,7 @@ def run_smoke(args) -> int:
         **tracing_overhead_checks(),
         **telemetry_overhead_checks(),
         **decode_wall_checks(),
+        **prefix_fleet_checks(),
     }
     ok = all(v is not False for v in checks.values())
     print(json.dumps({"smoke": "pass" if ok else "fail", **checks},

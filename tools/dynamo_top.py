@@ -154,6 +154,9 @@ def summarize(component: str, address: str, samples: List[Sample],
         "kv_capacity_blocks": kv_capacity,
         "kv_usage": kv_usage,
         "prefix_hit_rate": hit_rate,
+        "remote_hits": total(samples, "dynamo_prefix_remote_hits_total"),
+        "remote_fallbacks": total(
+            samples, "dynamo_prefix_remote_fallbacks_total"),
         "evictions": total(samples, "dynamo_kv_evictions_total"),
         "hbm_used_bytes": hbm_used,
         "hbm_limit_bytes": hbm_limit,
@@ -265,6 +268,7 @@ COLUMNS = (
     ("INFL", 5, lambda r: _fmt(r.get("inflight"), "int")),
     ("KV%", 6, lambda r: _fmt(r.get("kv_usage"), "pct")),
     ("HIT%", 6, lambda r: _fmt(r.get("prefix_hit_rate"), "pct")),
+    ("RHIT", 5, lambda r: _fmt(r.get("remote_hits"), "int")),
     ("HBM", 16, lambda r: (f'{_fmt(r.get("hbm_used_bytes"), "bytes")}'
                            f'/{_fmt(r.get("hbm_limit_bytes"), "bytes")}'
                            if r.get("hbm_used_bytes") is not None
