@@ -67,9 +67,13 @@ async def run(args) -> dict:
     http_port = await svc.start()
 
     procs = []
-    log = open(f"/tmp/frontend_bench_{os.getpid()}.log", "w")
+    log = await asyncio.to_thread(
+        open, f"/tmp/frontend_bench_{os.getpid()}.log", "w")
     for _ in range(args.workers):
-        procs.append(subprocess.Popen(
+        # Spawn off-loop (dynamo-lint DL002): the watcher/event pumps
+        # already run on this loop while workers come up.
+        procs.append(await asyncio.to_thread(
+            subprocess.Popen,
             [sys.executable, "-m", "dynamo_tpu.worker",
              "--control-plane", f"127.0.0.1:{cp_port}",
              "--mocker", "--model-name", "bench-model",

@@ -63,6 +63,12 @@ from dynamo_tpu.llm.kv_router.protocols import (
 )
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import Params, init_params, make_forward_step
+from dynamo_tpu.runtime import contracts
+from dynamo_tpu.runtime.contracts import (
+    engine_thread_only,
+    hot_path,
+    never_engine_thread,
+)
 from dynamo_tpu.runtime.metrics import EngineStepCounters
 from dynamo_tpu.tokens import TokenBlockSequence
 from dynamo_tpu.parallel.sharding import (
@@ -381,6 +387,7 @@ class EngineCore:
         self._window_fns: Dict[bool, Callable] = {}
         self._window_state: Optional[Dict] = None  # device-resident rows
         self._inflight: List = []  # dispatched-unsynced decode windows
+        self._async_copy_warned = False  # copy_to_host_async probe, once
         # FOUR fetch threads: device execution serializes windows, but the
         # device→host copies are independent per window and on a tunneled
         # chip each np.asarray pays a full RTT (measured 300-400 ms at bad
@@ -516,6 +523,7 @@ class EngineCore:
 
     # -- request lifecycle ------------------------------------------------
 
+    @engine_thread_only
     def add_request(
         self,
         request_id: str,
@@ -562,6 +570,7 @@ class EngineCore:
         self._requests[request_id] = req
         self.scheduler.add_request(req)
 
+    @engine_thread_only
     def cancel(self, request_id: str) -> None:
         req = self._requests.get(request_id)
         if req and req.state is not RequestState.FINISHED:
@@ -582,6 +591,8 @@ class EngineCore:
 
     # -- stepping ---------------------------------------------------------
 
+    @engine_thread_only
+    @hot_path
     def step(self) -> List[TokenDelta]:
         """Run one engine iteration; returns token deltas (may be empty).
 
@@ -693,6 +704,7 @@ class EngineCore:
             decoding, self.config.decode_window, want)
         self.scheduler.mixed_budget_override = chunk
 
+    @hot_path
     def _window_work(self, plan) -> Optional[DecodeWork]:
         """Decode work for the window path this iteration, or None when
         the engine must leave (or drain) window mode.
@@ -732,6 +744,7 @@ class EngineCore:
                 (r.context_len + bs - 1) // bs for r in cohort)),
         )
 
+    @hot_path
     def _settle_first_tokens(self, deltas: List[TokenDelta],
                              block: bool) -> None:
         """Collect asynchronously-sampled prefill first tokens.  `block`
@@ -746,6 +759,7 @@ class EngineCore:
                     remaining.append((fut, reqs))
                     continue
                 self.counters.host_syncs += 1  # engine thread stalls here
+            # dynamo-lint: disable=DL001 counted sync (host_syncs above)
             toks, lps = fut.result()
             for j, req in enumerate(reqs):
                 self._pending_first.discard(req.request_id)
@@ -1299,6 +1313,7 @@ class EngineCore:
             self._window_fns[greedy_only] = fn
         return fn
 
+    @hot_path
     def _dispatch_window(self, work: DecodeWork) -> Optional[List[TokenDelta]]:
         """Dispatch one fused K-token decode window (no host sync); sync
         and emit the window from pipeline_depth dispatches ago.  Returns
@@ -1394,7 +1409,14 @@ class EngineCore:
         try:
             out.copy_to_host_async()
         except Exception:
-            pass  # backend without async host copies: fetch still works
+            # Backend without async host copies: fetch still works, the
+            # overlap optimisation just silently degrades — say so ONCE
+            # (this fires per window; unbounded logging would flood).
+            if not self._async_copy_warned:
+                self._async_copy_warned = True
+                logger.warning(
+                    "backend lacks copy_to_host_async; window token "
+                    "fetches will pay a blocking device->host copy")
         self._inflight.append({
             "rids": [r.request_id for r in reqs],
             "reqs": list(reqs),
@@ -1461,10 +1483,12 @@ class EngineCore:
             "off": self._dev_row(offsets),
         }
 
+    @hot_path
     def _sync_one_window(self) -> List[TokenDelta]:
         entry = self._inflight.pop(0)
         self.counters.host_syncs += 1
         self.counters.window_syncs += 1
+        # dynamo-lint: disable=DL001 THE one counted sync per window
         tokens = entry["fetch"].result()                   # [K, bucket]
         deltas: List[TokenDelta] = []
         for i in range(tokens.shape[0]):
@@ -1574,6 +1598,7 @@ class EngineCore:
         self.counters.host_syncs += 1
         return fetch()
 
+    @hot_path
     def _append_token(self, req: Request, token: int,
                       logprob: Optional[float] = None) -> TokenDelta:
         if req.first_token_ts is None:
@@ -1633,6 +1658,7 @@ class EngineCore:
         self._hash_seqs.pop(req.request_id, None)
         self._published_blocks.pop(req.request_id, None)
 
+    @engine_thread_only
     def clear_prefix_cache(self) -> int:
         """Admin flush of all reusable cached blocks (reference
         `clear_kv_blocks.rs`); returns the number dropped.  Must run on
@@ -1644,6 +1670,7 @@ class EngineCore:
 
     # -- embeddings --------------------------------------------------------
 
+    @engine_thread_only
     def embed_tokens(self, token_lists: List[List[int]]) -> np.ndarray:
         """Last-token hidden-state embeddings for each prompt: [n, H] f32.
 
@@ -1726,6 +1753,7 @@ class EngineCore:
 
     # -- cross-worker KV transfer ------------------------------------------
 
+    @engine_thread_only
     def export_blocks(self, hashes) -> Dict[int, np.ndarray]:
         """Raw KV bytes for every requested block resident in any tier
         (the extract side of the worker↔worker data plane).  Must run on
@@ -1743,6 +1771,7 @@ class EngineCore:
                 out[h] = data
         return out
 
+    @engine_thread_only
     def export_blocks_device(self, hashes) -> Dict[int, object]:
         """G1-resident blocks as DEVICE arrays (the device-direct transfer
         plane's extract side; no host staging).  Engine thread only.
@@ -1769,6 +1798,7 @@ class EngineCore:
                 out[h] = data
         return out
 
+    @engine_thread_only
     def resident_prefix_blocks(self, hashes) -> int:
         """Length of the contiguous prefix of `hashes` already resident
         in ANY local tier (G1/G2/G3) — host-dict lookups only, no device
@@ -1790,6 +1820,7 @@ class EngineCore:
                 break
         return n
 
+    @engine_thread_only
     def import_blocks(self, blocks: Dict[int, np.ndarray]) -> int:
         """Inject fetched blocks into G1 as registered prefix-cache entries;
         a subsequent add_request with the matching prompt prefix skips
@@ -1862,6 +1893,7 @@ class EngineCore:
         if self._kv_event_sink and self.config.enable_kv_events:
             self._emit(KvCacheEventData.removed([block_hash]))
 
+    @hot_path
     def _publish_completed_blocks(self, req: Request) -> None:
         """Seal pages newly completed by this request: register them with
         the block source (future prefix hits) and emit STORED events."""
@@ -1942,15 +1974,32 @@ class InferenceEngine:
     async def start(self) -> None:
         self._loop = asyncio.get_running_loop()
         self.core.seal_sink = self._on_seal
+        # Ownership transfer: the core (and its pools) may have been
+        # built — and even stepped, e.g. warmup — on the constructing
+        # thread; the step-loop thread owns them from here on
+        # (DYNAMO_CONTRACTS thread-affinity pins re-pin on first call).
+        contracts.release_owner(*self._contract_owned())
         self._thread = threading.Thread(
             target=self._run_loop, name="engine-step-loop", daemon=True)
         self._thread.start()
+
+    def _contract_owned(self):
+        """Everything whose @engine_thread_only pin must follow the step
+        loop: the core, its allocator, and the tiered pools behind it."""
+        owned = [self, self.core, self.core.allocator]
+        manager = getattr(self.core.allocator, "manager", None)
+        if manager is not None:
+            owned += [manager, manager.device, manager.host, manager.disk]
+        return [o for o in owned if o is not None]
 
     async def stop(self) -> None:
         self._stop.set()
         self._wake.set()
         if self._thread:
             await asyncio.to_thread(self._thread.join, 10.0)
+        # The step loop is gone: release the thread-affinity pins so
+        # tests may drive the core directly afterwards.
+        contracts.release_owner(*self._contract_owned())
         # Tear down the managed block source's offload worker (thread
         # leak per discarded engine otherwise).
         close = getattr(getattr(self.core.allocator, "manager", None),
@@ -1959,15 +2008,19 @@ class InferenceEngine:
             await asyncio.to_thread(close)
 
     def _run_loop(self) -> None:
-        while not self._stop.is_set():
-            self._drain_commands()
-            busy = self.core.has_work
-            deltas = self.core.step() if busy else []
-            for d in deltas:
-                self._dispatch(d)
-            if not busy:
-                self._wake.wait(timeout=0.005)
-                self._wake.clear()
+        contracts.register_engine_thread()
+        try:
+            while not self._stop.is_set():
+                self._drain_commands()
+                busy = self.core.has_work
+                deltas = self.core.step() if busy else []
+                for d in deltas:
+                    self._dispatch(d)
+                if not busy:
+                    self._wake.wait(timeout=0.005)
+                    self._wake.clear()
+        finally:
+            contracts.unregister_engine_thread()
 
     def _drain_commands(self) -> None:
         with self._cmd_lock:
@@ -2015,6 +2068,7 @@ class InferenceEngine:
 
     # -- serving API ------------------------------------------------------
 
+    @never_engine_thread
     async def generate(
         self,
         request_id: str,
@@ -2047,6 +2101,7 @@ class InferenceEngine:
 
     # -- prefill seal-progress stream (disagg eager KV streaming) ---------
 
+    @hot_path
     def _on_seal(self, request_id: str, sealed_blocks: int) -> None:
         """Engine-thread callback: forward a request's sealed-block
         high-water mark to its watcher.  A dict miss (no watcher — the
@@ -2057,6 +2112,7 @@ class InferenceEngine:
             return
         self._loop.call_soon_threadsafe(q.put_nowait, sealed_blocks)
 
+    @never_engine_thread
     def watch_seals(self, request_id: str) -> asyncio.Queue:
         """Subscribe to a request's prefill progress: the returned queue
         yields the count of sealed (hash-registered) prompt blocks so
@@ -2070,22 +2126,28 @@ class InferenceEngine:
     def unwatch_seals(self, request_id: str) -> None:
         self._seal_watchers.pop(request_id, None)
 
+    @never_engine_thread
     async def run_in_engine(self, fn):
         """Run fn() on the engine thread between steps (cache access must
-        never race the step loop); returns its result."""
+        never race the step loop); returns its result.  Awaiting this
+        FROM the engine thread would deadlock (the engine thread is the
+        one that drains the command), hence @never_engine_thread."""
         fut = asyncio.get_running_loop().create_future()
         with self._cmd_lock:
             self._pending_calls.append((fn, fut))
         self._wake.set()
         return await fut
 
+    @never_engine_thread
     async def export_blocks(self, hashes) -> Dict[int, np.ndarray]:
         return await self.run_in_engine(
             lambda: self.core.export_blocks(hashes))
 
+    @never_engine_thread
     async def clear_kv_blocks(self) -> int:
         return await self.run_in_engine(self.core.clear_prefix_cache)
 
+    @never_engine_thread
     async def embed(self, token_lists) -> np.ndarray:
         # One engine-thread slot PER INPUT, not one for the whole batch:
         # decode steps for in-flight generations interleave between
@@ -2097,14 +2159,17 @@ class InferenceEngine:
                 lambda t=toks: self.core.embed_tokens([t])))
         return np.concatenate(rows, axis=0) if rows else np.zeros((0, 0))
 
+    @never_engine_thread
     async def import_blocks(self, blocks) -> int:
         return await self.run_in_engine(
             lambda: self.core.import_blocks(blocks))
 
+    @never_engine_thread
     async def resident_prefix_blocks(self, hashes) -> int:
         return await self.run_in_engine(
             lambda: self.core.resident_prefix_blocks(hashes))
 
+    @never_engine_thread
     async def export_blocks_device(self, hashes) -> Dict[int, object]:
         return await self.run_in_engine(
             lambda: self.core.export_blocks_device(hashes))

@@ -32,6 +32,8 @@ import itertools
 import logging
 from typing import Dict, Iterable, List, Optional
 
+from dynamo_tpu.runtime.logutil import warn_rate_limited
+
 logger = logging.getLogger(__name__)
 
 KV_OFFER_ENDPOINT = "kv_offer"
@@ -268,8 +270,13 @@ async def pull_prefix_device(engine, plane: KvTransferPlane, rpc_client,
         async for _ in rpc_client.call(KV_PULLED_ENDPOINT,
                                        {"uuid": meta["uuid"]}):
             pass
-    except Exception:
-        pass
+    except Exception as e:
+        # Still fire-and-forget (the offer retires via cap slack), but a
+        # donor that persistently drops acks is worth ONE line a minute.
+        warn_rate_limited(
+            logger, "kv_pulled_ack", 60.0,
+            "kv_pulled ack to donor failed (offer retires via cap "
+            "slack): %s", e)
     contiguous = contiguous_prefix(hashes, blocks)
     if not contiguous:
         return covered_tokens

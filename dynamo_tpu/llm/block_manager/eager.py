@@ -39,6 +39,7 @@ from dynamo_tpu.llm.block_manager.transfer import (
     pull_prefix,
     sealed_hashes,
 )
+from dynamo_tpu.runtime.contracts import never_engine_thread
 from dynamo_tpu.runtime.rpc import RpcError
 
 logger = logging.getLogger(__name__)
@@ -92,6 +93,7 @@ class EagerPuller:
 
     # -- streaming (while remote prefill runs) -----------------------------
 
+    @never_engine_thread
     def on_progress(self, sealed_blocks: int, address: str) -> None:
         """A progress announcement landed: schedule pulls for every newly
         sealed block, in hash-chain order, bounded batches.  No-op once
@@ -164,6 +166,7 @@ class EagerPuller:
 
     # -- completion / failure ----------------------------------------------
 
+    @never_engine_thread
     async def finish(self, address: str) -> int:
         """Prefill-done: snapshot the overlap, let in-flight pulls land,
         then fetch ONLY the residual tail (pull_prefix resumes from the
@@ -193,6 +196,7 @@ class EagerPuller:
         self._closed = True  # late announcements are no-ops now
         return covered
 
+    @never_engine_thread
     async def abort(self) -> int:
         """Mid-stream failure (timeout, dead prefill worker, residual
         pull error): cancel outstanding pulls, keep the landed contiguous

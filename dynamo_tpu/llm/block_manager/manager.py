@@ -35,6 +35,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from dynamo_tpu.llm.block_manager.pool import BlockPool
+from dynamo_tpu.runtime.contracts import (
+    engine_thread_only,
+    hot_path,
+    never_engine_thread,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -121,6 +126,7 @@ class KvBlockManager:
 
     # -- offload path (down-tier) ------------------------------------------
 
+    @hot_path
     def _on_device_evict(self, block_hash: int, slot: int) -> None:
         """G1 eviction → stash the block in G2 (if enabled).
 
@@ -136,6 +142,7 @@ class KvBlockManager:
         if self._block_shape is None:
             # First offload: the storage allocation needs the concrete
             # shape — pay the one-time sync.
+            # dynamo-lint: disable=DL001 one-time storage-shape settle
             staged = np.asarray(staged)
             self._ensure_storage(staged)
         if not self.host.can_allocate(1):
@@ -196,6 +203,7 @@ class KvBlockManager:
 
     # -- onboard path (up-tier) --------------------------------------------
 
+    @engine_thread_only
     def match_and_onboard(self, hashes: Sequence[int]) -> Tuple[int, List[int]]:
         """Find the longest prefix resident in ANY tier; promote down-tier
         blocks into G1; pin and return (num_blocks, device_slot_ids).
@@ -275,6 +283,7 @@ class KvBlockManager:
             return self.extract_fn(slot.index)
         return None
 
+    @engine_thread_only
     def import_block(self, block_hash: int, data: np.ndarray) -> bool:
         """Inject a fetched block into G1 and register it (inactive,
         matchable) — the onboard side of a remote transfer.  Returns False
@@ -307,9 +316,12 @@ class KvBlockManager:
         if self.host is not None:
             self.host.set_eviction_bias(fn, scan)
 
+    @never_engine_thread
     def close(self) -> None:
         """Settle outstanding offloads and stop the worker thread (a
-        manager per discarded engine would otherwise leak its thread)."""
+        manager per discarded engine would otherwise leak its thread).
+        Joining the offload pool FROM the engine thread would stall the
+        step loop for the whole backlog, hence @never_engine_thread."""
         for h in list(self._pending_host):
             self._settle_host(h)
         self._offload_pool.shutdown(wait=True)

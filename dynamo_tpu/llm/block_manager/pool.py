@@ -25,6 +25,8 @@ from dataclasses import dataclass
 from itertools import islice
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from dynamo_tpu.runtime.contracts import engine_thread_only, hot_path
+
 logger = logging.getLogger(__name__)
 
 
@@ -112,6 +114,7 @@ class BlockPool:
 
     # -- matching ---------------------------------------------------------
 
+    @engine_thread_only
     def match_sequence_hashes(self, hashes: Sequence[int]) -> List[Slot]:
         """Longest registered prefix; returned slots are NOT yet pinned
         (call acquire_matched to pin)."""
@@ -123,6 +126,7 @@ class BlockPool:
             out.append(slot)
         return out
 
+    @engine_thread_only
     def acquire_matched(self, slots: Sequence[Slot]) -> List[int]:
         """Pin matched slots (revives inactive ones); returns slot ids."""
         ids = []
@@ -140,6 +144,8 @@ class BlockPool:
     def can_allocate(self, n: int) -> bool:
         return n <= self.reusable_slots
 
+    @engine_thread_only
+    @hot_path
     def allocate(self, n: int) -> List[int]:
         """Take n fresh slots (evicting LRU inactive blocks as needed)."""
         if not self.can_allocate(n):
@@ -193,6 +199,7 @@ class BlockPool:
 
     # -- registration -----------------------------------------------------
 
+    @engine_thread_only
     def register(self, slot_index: int, block_hash: int) -> bool:
         """Publish a completed block under its hash (Complete→Registered).
 
@@ -226,6 +233,8 @@ class BlockPool:
 
     # -- release ----------------------------------------------------------
 
+    @engine_thread_only
+    @hot_path
     def release(self, slot_indices: Sequence[int]) -> None:
         """Unpin; refcount-0 slots either go inactive (if registered — a
         future prefix hit) or straight back to the free list."""

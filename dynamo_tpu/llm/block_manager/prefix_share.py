@@ -47,6 +47,7 @@ from dynamo_tpu.llm.block_manager.transfer import (
     pull_prefix,
     sealed_hashes,
 )
+from dynamo_tpu.runtime.contracts import never_engine_thread
 from dynamo_tpu.runtime.rpc import RpcError
 
 logger = logging.getLogger(__name__)
@@ -136,6 +137,7 @@ class PrefixFetcher:
             return self.pull_timeout
         return min(30.0, 2.0 + 0.05 * blocks)
 
+    @never_engine_thread
     async def pull(self, prompt_tokens: List[int], address: str,
                    covered_tokens: int = 0) -> int:
         """Pull up to `covered_tokens` (the donor's high-water mark; <=0
@@ -359,6 +361,7 @@ class PrefixShareClient:
         self.inner = inner
         self.fetcher = fetcher
 
+    @never_engine_thread
     async def generate(self, request):
         hint = decode_hint(request.annotations.get(HINT_ANNOTATION))
         if hint is not None:

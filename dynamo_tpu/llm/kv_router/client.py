@@ -198,7 +198,8 @@ class KvRoutedEngineClient:
                     "overlap_blocks": ev.overlap_blocks,
                 })
             except Exception:
-                pass  # observability is best-effort
+                # dynamo-lint: disable=DL003 best-effort metrics publish
+                pass  # observability must not tax the request hot path
 
         try:
             asyncio.get_running_loop().create_task(pub())

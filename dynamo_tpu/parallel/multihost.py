@@ -208,12 +208,16 @@ class LockstepLeader:
     def close(self) -> None:
         try:
             self.broadcast({"op": "stop"})
-        except Exception:
-            pass
+        except Exception as e:
+            # Followers that never hear "stop" exit on socket close
+            # below — but an operator debugging a hung rank needs this.
+            logger.warning("lockstep stop broadcast failed (followers "
+                           "fall back to socket-close exit): %s", e)
         for c in self._conns:
             try:
                 c.close()
             except Exception:
+                # dynamo-lint: disable=DL003 teardown: socket already dead
                 pass
         self._srv.close()
 

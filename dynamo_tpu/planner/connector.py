@@ -9,11 +9,13 @@ in-flight streams — worker/main.py)."""
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 import signal
 import subprocess
 import sys
+import threading
 from typing import List, Optional
 
 logger = logging.getLogger(__name__)
@@ -31,6 +33,11 @@ class LocalConnector:
         self.env = dict(env if env is not None else os.environ)
         self.log_dir = log_dir
         self._procs: List[subprocess.Popen] = []
+        # add_worker's spawn thread appends while _reap (event loop,
+        # via a concurrent /metrics scrape) rebuilds the list — both
+        # sides serialize here or a freshly spawned proc can vanish
+        # from the roster and never be SIGTERMed at shutdown.
+        self._procs_lock = threading.Lock()
         self._seq = 0
 
     def replicas(self) -> int:
@@ -44,41 +51,57 @@ class LocalConnector:
             log.close()
 
     def _reap(self) -> None:
-        live = []
-        for p in self._procs:
-            if p.poll() is None:
-                live.append(p)
-            else:
-                self._close_log(p)
-        self._procs = live
+        with self._procs_lock:
+            live = []
+            for p in self._procs:
+                if p.poll() is None:
+                    live.append(p)
+                else:
+                    self._close_log(p)
+            self._procs = live
 
     async def add_worker(self) -> None:
         self._seq += 1
-        log = open(os.path.join(
+        log_path = os.path.join(
             self.log_dir,
-            f"dynamo_planner_worker_{os.getpid()}_{self._seq}.log"), "w")
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "dynamo_tpu.worker",
-             "--control-plane", self.control_plane_addr,
-             *self.worker_args],
-            env=self.env, stdout=log, stderr=subprocess.STDOUT)
-        proc._logfile = log  # type: ignore[attr-defined]
-        self._procs.append(proc)
+            f"dynamo_planner_worker_{os.getpid()}_{self._seq}.log")
+
+        def spawn():
+            # Log-file open AND fork+exec both block (slow/network
+            # storage, page-cache-cold python): the planner shares its
+            # event loop with the metrics server, and neither may stall
+            # scrapes (dynamo-lint DL002).  The proc registers into
+            # _procs HERE, on the spawn thread — if the awaiting
+            # coroutine is cancelled mid-await (planner stop), the
+            # thread still completes and shutdown() can reap the child
+            # instead of orphaning it.
+            log = open(log_path, "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "dynamo_tpu.worker",
+                 "--control-plane", self.control_plane_addr,
+                 *self.worker_args],
+                env=self.env, stdout=log, stderr=subprocess.STDOUT)
+            proc._logfile = log  # type: ignore[attr-defined]
+            with self._procs_lock:
+                self._procs.append(proc)
+            return proc
+
+        proc = await asyncio.to_thread(spawn)
         logger.info("connector: spawned worker pid %d", proc.pid)
 
     async def remove_worker(self) -> None:
         """Drain the newest worker: SIGTERM → it leaves routing and
         finishes in-flight streams before exiting."""
         self._reap()
-        if not self._procs:
-            return
-        proc = self._procs.pop()
+        with self._procs_lock:
+            if not self._procs:
+                return
+            proc = self._procs.pop()
         logger.info("connector: draining worker pid %d", proc.pid)
         proc.send_signal(signal.SIGTERM)
+
         # Reap off-loop: the drain can take as long as its longest
         # in-flight stream.
-        import asyncio
-
         async def reap():
             while proc.poll() is None:
                 await asyncio.sleep(0.5)
@@ -88,12 +111,19 @@ class LocalConnector:
 
     async def shutdown(self) -> None:
         self._reap()
-        for p in self._procs:
+        with self._procs_lock:
+            procs, self._procs = self._procs, []
+        for p in procs:
             p.send_signal(signal.SIGTERM)
-        for p in self._procs:
+        for p in procs:
+            # Off-loop: a slow-draining worker may take the full 15 s,
+            # and N of them would freeze the shared planner/metrics
+            # loop for 15*N s (same DL002 bug class as add_worker's
+            # spawn — receiver-method calls like proc.wait() are a
+            # documented blind spot of the linter rule, so this is
+            # discipline, not gate-enforced).
             try:
-                p.wait(timeout=15)
+                await asyncio.to_thread(p.wait, 15)
             except subprocess.TimeoutExpired:
                 p.kill()
             self._close_log(p)
-        self._procs.clear()

@@ -195,7 +195,8 @@ def planner_metrics_text(planner, connector) -> str:
     try:
         lines.append(f"dynamo_planner_replicas {connector.replicas()}")
     except Exception:
-        pass
+        # dynamo-lint: disable=DL003 best-effort metrics text
+        pass  # connector variant without replicas(): omit the series
     decisions = getattr(planner, "decisions", []) or []
     ups = sum(1 for d in decisions if len(d) > 1 and d[1] == "up")
     downs = sum(1 for d in decisions if len(d) > 1 and d[1] == "down")
@@ -211,5 +212,6 @@ def planner_metrics_text(planner, connector) -> str:
             lines.append('dynamo_planner_predicted{metric="%s"} %s'
                          % (name, pred.predict_next()))
         except Exception:
-            pass
+            # dynamo-lint: disable=DL003 best-effort metrics text
+            pass  # predictor not warmed up yet: omit the series
     return "\n".join(lines) + "\n"

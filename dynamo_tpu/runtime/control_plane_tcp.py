@@ -198,7 +198,8 @@ class ControlPlaneServer:
             try:
                 await writer.wait_closed()
             except Exception:
-                pass  # peer already gone / loop tearing down
+                # dynamo-lint: disable=DL003 teardown: peer already gone
+                pass  # nothing to salvage — the connection is history
 
 
 _POISON = object()  # sentinel pushed into stream queues on connection death

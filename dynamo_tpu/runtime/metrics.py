@@ -13,10 +13,16 @@ thread-safe; histograms use fixed buckets chosen for LLM latencies.
 
 from __future__ import annotations
 
+import logging
 import math
 import threading
 from bisect import bisect_right
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from dynamo_tpu.runtime.contracts import never_engine_thread
+from dynamo_tpu.runtime.logutil import warn_rate_limited
+
+_logger = logging.getLogger(__name__)
 
 # Buckets tuned for token-level latencies (seconds): sub-ms resolution at
 # the bottom (a routing decision or in-process TPOT at speedup is ~100 µs)
@@ -495,6 +501,7 @@ class KvCacheMetrics:
             counter.inc(cum - prev, labels=labels)
         self._last[key] = cum
 
+    @never_engine_thread
     def observe_prefix_share(self, fetcher) -> None:
         """Sample a PrefixFetcher's cumulative pull accounting into the
         dynamo_prefix_remote_* counters (same pull-style delta
@@ -503,6 +510,7 @@ class KvCacheMetrics:
         self._inc_to(self.prefix_remote_pulled, {}, fetcher.pulled_blocks)
         self._inc_to(self.prefix_remote_fallbacks, {}, fetcher.fallbacks)
 
+    @never_engine_thread
     def observe_pool(self, pool, tier: str) -> None:
         """Sample one BlockPool's occupancy + eviction counters."""
         labels = {"tier": tier, "pool": pool.name}
@@ -512,11 +520,14 @@ class KvCacheMetrics:
         self.pool_free.set(pool.free_slots, labels=labels)
         self._inc_to(self.evictions, labels, pool.evictions)
 
+    @never_engine_thread
     def observe_engine(self, core) -> None:
         """Sample an EngineCore's block source (all tiers) and the
         scheduler's admission prefix-match counters.  Reads host-side
         ints only — never device arrays — so it is safe to call from a
-        scrape thread while the engine steps."""
+        scrape thread while the engine steps (and must never BE the
+        engine thread: sampling on the step loop would charge the
+        steady window for its own telemetry)."""
         alloc = core.allocator
         manager = getattr(alloc, "manager", None)
         if manager is not None:
@@ -582,6 +593,7 @@ class HbmPoller:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    @never_engine_thread
     def poll_once(self) -> int:
         """One sample of every local device; returns the number of
         devices that reported real memory stats (0 → fallback used)."""
@@ -626,7 +638,8 @@ class HbmPoller:
 
             return pages * os.sysconf("SC_PAGE_SIZE")
         except Exception:
-            pass
+            # dynamo-lint: disable=DL003 fallback chain continues below
+            pass  # non-Linux: try getrusage next
         try:  # non-Linux fallback: the peak is better than nothing
             import resource
             import sys
@@ -662,8 +675,10 @@ class HbmPoller:
         while not self._stop.is_set():
             try:
                 self.poll_once()
-            except Exception:  # telemetry must never kill the process
-                pass
+            except Exception as e:  # telemetry must never kill the process
+                warn_rate_limited(
+                    _logger, "hbm_poll", 60.0,
+                    "HBM poll failed (series go stale): %s", e)
             self._stop.wait(self.interval)
 
     def stop(self) -> None:

@@ -29,14 +29,19 @@ maintains — zero cost on the engine thread.  `tick()` takes an explicit
 from __future__ import annotations
 
 import asyncio
+import logging
 import math
 import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
+from dynamo_tpu.runtime.contracts import never_engine_thread
+from dynamo_tpu.runtime.logutil import warn_rate_limited
 from dynamo_tpu.runtime.metrics import (
     Counter, Histogram, MetricsRegistry, RequestMetrics)
+
+_logger = logging.getLogger(__name__)
 
 OK, WARN, PAGE = "OK", "WARN", "PAGE"
 _STATE_NUM = {OK: 0, WARN: 1, PAGE: 2}
@@ -172,9 +177,14 @@ class SloMonitor:
             return 0.0, None
         return d_total, d_bad / d_total
 
+    @never_engine_thread
     def tick(self, now: Optional[float] = None) -> dict:
         """Sample every objective, update burn rates + state, return the
-        /debug/slo payload.  Deterministic given explicit `now`."""
+        /debug/slo payload.  Deterministic given explicit `now`.
+
+        Never the engine thread: a tick walks every objective's sample
+        ring — the step loop reads only the `last_max_burn` attribute
+        this leaves behind (the eviction bias' cheap signal)."""
         now = self._clock() if now is None else now
         rows = []
         worst = OK
@@ -257,8 +267,10 @@ class SloMonitor:
                 await asyncio.sleep(interval)
                 try:
                     self.tick()
-                except Exception:  # telemetry must never kill serving
-                    pass
+                except Exception as e:  # telemetry must never kill serving
+                    warn_rate_limited(
+                        _logger, "slo_tick", 60.0,
+                        "SLO tick failed (burn gauges go stale): %s", e)
 
         self._task = asyncio.get_running_loop().create_task(loop())
 

@@ -18,6 +18,7 @@ import signal
 from dynamo_tpu.llm.discovery import engine_wire_handler, register_llm
 from dynamo_tpu.llm.kv_router.protocols import RouterEvent
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.contracts import never_engine_thread
 from dynamo_tpu.runtime.control_plane_tcp import ControlPlaneClient
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 
@@ -486,6 +487,7 @@ async def run(args) -> None:
         from dynamo_tpu.runtime.status import (
             StatusServer, register_status_endpoint_task)
 
+        @never_engine_thread
         def worker_metrics_text() -> str:
             m = metrics_fn()
             ws, ks = m.worker_stats, m.kv_stats

@@ -7,6 +7,15 @@ CPU devices, so CI needs no TPU.  Must run before any `import jax`.
 
 import os
 
+# Debug-mode thread-affinity contracts (runtime/contracts.py): the
+# decorators on EngineCore step/seal/export internals, the block-manager
+# entry points, SloMonitor.tick and KvCacheMetrics sampling assert
+# caller-thread identity for the whole suite.  Must be set before any
+# dynamo_tpu import — decoration reads the env var at import time (the
+# zero-cost-off guarantee).  Respect an explicit =0 so the pinned
+# counter tests can be re-run contracts-off for A/B.
+os.environ.setdefault("DYNAMO_CONTRACTS", "1")
+
 # The ambient environment may pin JAX to the real TPU (e.g. the "axon"
 # plugin, which ignores JAX_PLATFORMS=cpu), but the test suite must stay on
 # the virtual CPU mesh — single-chip hardware can't host the 8-way sharding
@@ -43,3 +52,47 @@ try:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 except Exception:
     pass  # older jax without the knobs: run uncached
+
+
+# -- thread-leak guard -----------------------------------------------------
+# Non-daemon threads that outlive their test accumulate silently across
+# the suite (an unstopped HbmPoller would be daemon, but kv-offload /
+# kv-window-fetch ThreadPoolExecutor workers are NOT) and can wedge
+# interpreter exit.  Cheap session-scoped check: compare the non-daemon
+# census at session start and end; fail loudly — with names — above an
+# allowance that covers executor workers parked until their pool is
+# garbage-collected.
+
+import gc  # noqa: E402
+import threading  # noqa: E402
+import time as _time  # noqa: E402
+
+import pytest  # noqa: E402
+
+# Idle ThreadPoolExecutor workers exit only when their executor is
+# collected (weakref wakeup), so the census depends on GC timing; the
+# allowance absorbs that churn while still catching a real per-test
+# leak (which grows with the test count, not the pool count).
+THREAD_LEAK_ALLOWANCE = int(os.environ.get("DYNAMO_THREAD_LEAK_MAX", "24"))
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _thread_leak_guard():
+    baseline = {t.ident for t in threading.enumerate() if not t.daemon}
+    yield
+    gc.collect()  # release executor threads owned by dead engines
+    deadline = _time.monotonic() + 2.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if not t.daemon and t.is_alive()
+                  and t.ident not in baseline]
+        if (len(leaked) <= THREAD_LEAK_ALLOWANCE
+                or _time.monotonic() >= deadline):
+            break
+        _time.sleep(0.1)
+    if len(leaked) > THREAD_LEAK_ALLOWANCE:
+        names = sorted(t.name for t in leaked)
+        pytest.fail(
+            f"{len(leaked)} non-daemon thread(s) leaked across the suite "
+            f"(allowance {THREAD_LEAK_ALLOWANCE}): {names[:40]}",
+            pytrace=False)

@@ -1,0 +1,294 @@
+"""Runtime thread-affinity contracts (runtime/contracts.py).
+
+Pure-CPU and engine-build-free (tier-1 wall-time discipline): the
+decorators are exercised on tiny stub classes and, for the
+InferenceEngine integration, via subprocess-free direct checks of the
+module's registry — never by building an EngineCore.
+"""
+
+import asyncio
+import importlib
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from dynamo_tpu.runtime import contracts
+from dynamo_tpu.runtime.contracts import ContractViolation
+
+pytestmark = pytest.mark.skipif(
+    not contracts.ENABLED,
+    reason="suite must run with DYNAMO_CONTRACTS=1 (conftest sets it)")
+
+
+class FakeCore:
+    @contracts.engine_thread_only
+    def step(self):
+        return "stepped"
+
+    @contracts.engine_thread_only
+    def export(self):
+        return "exported"
+
+
+def _call_in_thread(fn):
+    """Run fn() on a fresh thread; return (result, exception)."""
+    box = {}
+
+    def run():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 - test harness
+            box["exc"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join(5.0)
+    return box.get("result"), box.get("exc")
+
+
+# -- engine_thread_only ----------------------------------------------------
+
+
+def test_engine_thread_only_pins_first_caller():
+    core = FakeCore()
+    assert core.step() == "stepped"          # pins THIS thread
+    assert core.export() == "exported"       # same thread: fine
+    _, exc = _call_in_thread(core.step)
+    assert isinstance(exc, ContractViolation)
+    assert "engine-thread-only" in str(exc)
+    # The violating thread's name is in the message (debuggability).
+    assert "Thread-" in str(exc) or "thread" in str(exc).lower()
+
+
+def test_engine_thread_only_per_instance():
+    a, b = FakeCore(), FakeCore()
+    assert a.step() == "stepped"
+    # A DIFFERENT instance pins independently: another thread may own it.
+    result, exc = _call_in_thread(b.step)
+    assert exc is None and result == "stepped"
+
+
+def test_release_owner_transfers_ownership():
+    core = FakeCore()
+    core.step()                              # pinned to main thread
+    contracts.release_owner(core)
+    result, exc = _call_in_thread(core.step)  # new owner re-pins
+    assert exc is None and result == "stepped"
+    # ...and now the MAIN thread is the violator.
+    with pytest.raises(ContractViolation):
+        core.step()
+    contracts.release_owner(core)            # leave main unpinned again
+    core.step()
+
+
+def test_release_owner_tolerates_none_and_foreign():
+    contracts.release_owner(None, object(), FakeCore())  # no raise
+
+
+# -- never_engine_thread ---------------------------------------------------
+
+
+class Sampler:
+    @contracts.never_engine_thread
+    def sample(self):
+        return "sampled"
+
+    @contracts.never_engine_thread
+    async def pull(self):
+        return "pulled"
+
+    @contracts.never_engine_thread
+    async def stream(self):
+        yield 1
+        yield 2
+
+
+def test_never_engine_thread_allows_unregistered_threads():
+    s = Sampler()
+    assert s.sample() == "sampled"
+    result, exc = _call_in_thread(s.sample)
+    assert exc is None and result == "sampled"
+
+
+def test_never_engine_thread_raises_on_engine_thread():
+    s = Sampler()
+
+    def as_engine():
+        contracts.register_engine_thread()
+        try:
+            s.sample()
+        finally:
+            contracts.unregister_engine_thread()
+
+    _, exc = _call_in_thread(as_engine)
+    assert isinstance(exc, ContractViolation)
+    assert "never run on the engine thread" in str(exc)
+
+
+def test_unregister_clears_engine_identity():
+    s = Sampler()
+
+    def once_engine():
+        contracts.register_engine_thread()
+        contracts.unregister_engine_thread()
+        return s.sample()                    # no longer an engine thread
+
+    result, exc = _call_in_thread(once_engine)
+    assert exc is None and result == "sampled"
+
+
+def test_async_flavors_check_on_calling_thread():
+    s = Sampler()
+
+    async def ok():
+        assert await s.pull() == "pulled"
+        assert [x async for x in s.stream()] == [1, 2]
+
+    asyncio.run(ok())
+
+    def engine_loop():
+        contracts.register_engine_thread()
+        try:
+            with pytest.raises(ContractViolation):
+                asyncio.run(s.pull())
+
+            async def drain():
+                return [x async for x in s.stream()]
+
+            with pytest.raises(ContractViolation):
+                asyncio.run(drain())
+        finally:
+            contracts.unregister_engine_thread()
+
+    _, exc = _call_in_thread(engine_loop)
+    assert exc is None
+
+
+# -- hot_path --------------------------------------------------------------
+
+
+def test_hot_path_is_a_pure_marker():
+    calls = []
+
+    @contracts.hot_path
+    def fast(x):
+        calls.append(x)
+        return x * 2
+
+    # Never wrapped, even with contracts ON: identical function object
+    # semantics, only the marker attribute added.
+    assert fast.__dynamo_contract__ == "hot_path"
+    assert fast(21) == 42 and calls == [21]
+
+
+# -- zero-overhead off mode -----------------------------------------------
+
+
+def test_decorators_are_noops_when_disabled():
+    """With DYNAMO_CONTRACTS unset the decorators must return the
+    ORIGINAL function object — no wrapper on the step loop.  Checked in
+    a subprocess so this suite's enabled-mode import is untouched."""
+    code = (
+        "import os; os.environ.pop('DYNAMO_CONTRACTS', None)\n"
+        "from dynamo_tpu.runtime import contracts\n"
+        "assert not contracts.ENABLED\n"
+        "def f(self): return 1\n"
+        "assert contracts.engine_thread_only(f) is f\n"
+        "assert contracts.never_engine_thread(f) is f\n"
+        "assert contracts.hot_path(f) is f\n"
+        "assert f.__dynamo_contract__ == 'hot_path'\n"
+        "print('noop-ok')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert "noop-ok" in out.stdout
+
+
+def test_enabled_mode_wraps():
+    """In this process (DYNAMO_CONTRACTS=1) the thread decorators DO
+    wrap, and the wrapper advertises the contract for introspection."""
+    assert contracts.ENABLED
+    assert FakeCore.step.__dynamo_contract__ == "engine_thread_only"
+    assert Sampler.pull.__dynamo_contract__ == "never_engine_thread"
+    # functools.wraps preserved identity metadata.
+    assert FakeCore.step.__name__ == "step"
+
+
+def test_annotated_modules_import_cleanly():
+    """The real annotated modules (engine, pools, slo, metrics) import
+    and their decorated methods carry the marker — without building an
+    engine."""
+    from dynamo_tpu.engine.engine import EngineCore, InferenceEngine
+    from dynamo_tpu.llm.block_manager.manager import KvBlockManager
+    from dynamo_tpu.llm.block_manager.pool import BlockPool
+    from dynamo_tpu.runtime.metrics import KvCacheMetrics
+    from dynamo_tpu.runtime.slo import SloMonitor
+
+    assert EngineCore.step.__dynamo_contract__ == "engine_thread_only"
+    assert EngineCore.import_blocks.__dynamo_contract__ == \
+        "engine_thread_only"
+    assert InferenceEngine.run_in_engine.__dynamo_contract__ == \
+        "never_engine_thread"
+    assert BlockPool.allocate.__dynamo_contract__ == "engine_thread_only"
+    assert KvBlockManager.close.__dynamo_contract__ == \
+        "never_engine_thread"
+    assert SloMonitor.tick.__dynamo_contract__ == "never_engine_thread"
+    assert KvCacheMetrics.observe_engine.__dynamo_contract__ == \
+        "never_engine_thread"
+
+
+def test_block_pool_contracts_live():
+    """A real BlockPool (host-only object, no engine) enforces the pin:
+    allocate on one thread, then allocate from another raises."""
+    pool = BlockPoolFactory()
+    pool.allocate(1)
+    _, exc = _call_in_thread(lambda: pool.allocate(1))
+    assert isinstance(exc, ContractViolation)
+    contracts.release_owner(pool)
+    result, exc = _call_in_thread(lambda: pool.allocate(1))
+    assert exc is None
+
+
+def BlockPoolFactory():
+    from dynamo_tpu.llm.block_manager.pool import BlockPool
+
+    return BlockPool(8, name="test-pool")
+
+
+def test_slo_tick_refused_on_engine_thread():
+    """SloMonitor.tick asserts off-engine-thread: the eviction bias
+    reads last_max_burn instead of recomputing windows on the step
+    loop."""
+    from dynamo_tpu.runtime.slo import SloMonitor, SloObjective
+
+    mon = SloMonitor([(SloObjective("x"), lambda: (0.0, 0.0))])
+    mon.tick(now=0.0)                        # fine off-engine
+
+    def as_engine():
+        contracts.register_engine_thread()
+        try:
+            mon.tick(now=1.0)
+        finally:
+            contracts.unregister_engine_thread()
+
+    _, exc = _call_in_thread(as_engine)
+    assert isinstance(exc, ContractViolation)
+
+
+def test_module_reimport_respects_env(tmp_path):
+    """ENABLED is an import-time decision — documented contract."""
+    # importlib.reload would re-decorate already-imported modules
+    # inconsistently; just assert the flag matches the env this suite
+    # was started with.
+    assert os.environ.get("DYNAMO_CONTRACTS") == "1"
+    assert contracts.ENABLED is True
+    assert contracts._env_enabled() is True
+    mod = importlib.import_module("dynamo_tpu.runtime.contracts")
+    assert mod is contracts

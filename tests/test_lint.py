@@ -1,0 +1,446 @@
+"""dynamo-lint: rule fixtures, suppression handling, and the tree gate.
+
+Pure-CPU, engine-build-free, no jax import needed — fixture snippets
+are written to tmp_path and linted in-process via
+`tools.dynamo_lint.run_lint`.  `test_tree_is_clean` IS the CI gate:
+the repo has no external CI, so an unsuppressed finding anywhere in
+`dynamo_tpu/`, `tools/` or `benchmarks/` fails tier-1.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.dynamo_lint import RULE_TABLE, main, run_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_source(tmp_path, source: str, name: str = "snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return run_lint([str(p)])
+
+
+def codes(findings):
+    return [f.code for f in findings]
+
+
+# -- DL001: host syncs in @hot_path ---------------------------------------
+
+
+def test_dl001_flags_each_sync_kind(tmp_path):
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime.contracts import hot_path
+
+        @hot_path
+        def steady(x, jax, np, fut):
+            a = x.item()
+            b = jax.device_get(x)
+            x.block_until_ready()
+            c = np.asarray(x)
+            d = fut.result()
+            return a, b, c, d
+        """)
+    assert codes(findings) == ["DL001"] * 5
+
+
+def test_dl001_ignores_undecorated_and_host_literals(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import numpy as np
+        from dynamo_tpu.runtime.contracts import hot_path
+
+        def cold(x):
+            return x.item()            # no @hot_path: fine
+
+        @hot_path
+        def steady(rows):
+            want = np.asarray([r - 1 for r in rows])   # host literal
+            more = np.asarray((1, 2))                  # host literal
+            return want, more
+        """)
+    assert findings == []
+
+
+def test_dl001_excludes_nested_closures(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import numpy as np
+        from dynamo_tpu.runtime.contracts import hot_path
+
+        @hot_path
+        def dispatch(pool, out):
+            def land():
+                return np.asarray(out)   # runs on the offload thread
+            pool.submit(land)
+            pool.submit(np.asarray, out)  # np.asarray as ARG, not call
+        """)
+    assert findings == []
+
+
+def test_dl001_checks_stacked_contract_decorators(tmp_path):
+    """The hottest functions stack @engine_thread_only + @hot_path
+    (EngineCore.step, BlockPool.allocate/release) — DL001 must scan
+    them regardless of decorator order, and DL005 must still see the
+    thread contract."""
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime.contracts import (
+            engine_thread_only, hot_path, never_engine_thread)
+
+        class Core:
+            @engine_thread_only
+            @hot_path
+            def step(self, x):
+                return x.item()
+
+            @hot_path
+            @engine_thread_only
+            def seal(self, x):
+                return x.item()
+
+        class Sampler:
+            @never_engine_thread
+            def scrape(self, core):
+                core.step(None)          # DL005: engine-only callee
+        """)
+    assert codes(findings) == ["DL001", "DL001", "DL005"]
+
+
+def test_dl001_dotted_decorator(tmp_path):
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime import contracts
+
+        class Core:
+            @contracts.hot_path
+            def step(self, x):
+                return x.item()
+        """)
+    assert codes(findings) == ["DL001"]
+
+
+# -- DL002: blocking calls in async def -----------------------------------
+
+
+def test_dl002_flags_blocking_calls(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import subprocess
+        import time
+        import urllib.request
+
+        async def handler():
+            time.sleep(0.1)
+            subprocess.run(["ls"])
+            subprocess.Popen(["ls"])
+            urllib.request.urlopen("http://x")
+        """)
+    assert codes(findings) == ["DL002"] * 4
+
+
+def test_dl002_allows_sync_defs_and_nested(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import time
+
+        def sync_fn():
+            time.sleep(0.1)              # sync context: fine
+
+        async def handler():
+            def worker():
+                time.sleep(0.1)          # runs via to_thread: fine
+            await asyncio.to_thread(worker)
+        """)
+    assert findings == []
+
+
+# -- DL003: silent exception swallowing -----------------------------------
+
+
+def test_dl003_flags_silent_pass(tmp_path):
+    findings = lint_source(tmp_path, """\
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+            try:
+                work()
+            except:
+                pass
+            try:
+                work()
+            except (ValueError, Exception):
+                pass
+        """)
+    assert codes(findings) == ["DL003"] * 3
+
+
+def test_dl003_allows_logged_and_narrow(tmp_path):
+    findings = lint_source(tmp_path, """\
+        def f(log):
+            try:
+                work()
+            except Exception:
+                log.warning("failed")    # logged: fine
+            try:
+                work()
+            except ValueError:
+                pass                     # narrow: fine
+            try:
+                work()
+            except Exception:
+                raise                    # re-raised: fine
+        """)
+    assert findings == []
+
+
+# -- DL004: metrics discipline --------------------------------------------
+
+
+def test_dl004_metric_naming(tmp_path):
+    findings = lint_source(tmp_path, """\
+        def build(registry):
+            registry.counter("dynamo_requests_total")   # double prefix
+            registry.gauge("Upper-Case")                # invalid name
+            registry.histogram("request_ttft_seconds")  # fine
+            Counter("kv_hits", "h")                     # missing prefix
+            Counter("dynamo_kv_hits", "h")              # fine
+        """)
+    msgs = [(f.code, f.line) for f in findings]
+    assert msgs == [("DL004", 2), ("DL004", 3), ("DL004", 5)]
+
+
+def test_dl004_lock_discipline(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import threading
+        from collections import OrderedDict
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._values = {}
+                self._order = OrderedDict()
+                self.public = {}
+
+            def bad_write(self, k, v):
+                self._values[k] = v
+
+            def bad_mutate(self, k):
+                self._order.pop(k, None)
+
+            def good_write(self, k, v):
+                with self._lock:
+                    self._values[k] = v
+
+            def read_ok(self, k):
+                return self._values.get(k)
+
+            def public_ok(self, k, v):
+                self.public[k] = v       # not underscore-private
+
+        class NoLock:
+            def __init__(self):
+                self._values = {}
+
+            def free_write(self, k, v):
+                self._values[k] = v      # class owns no _lock: fine
+        """)
+    assert [(f.code, f.line) for f in findings] == [
+        ("DL004", 12), ("DL004", 15)]
+
+
+# -- DL005: contract consistency ------------------------------------------
+
+
+def test_dl005_conflicting_calls(tmp_path):
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime.contracts import (
+            engine_thread_only, never_engine_thread)
+
+        class Core:
+            @engine_thread_only
+            def step(self, sampler):
+                sampler.observe_everything()
+
+            @engine_thread_only
+            def seal(self):
+                self.step(None)          # same contract: fine
+
+        class Sampler:
+            @never_engine_thread
+            def observe_everything(self):
+                pass
+
+            @never_engine_thread
+            def scrape(self, core):
+                core.step(None)
+        """)
+    assert codes(findings) == ["DL005", "DL005"]
+    assert "observe_everything" in findings[0].message
+    assert "step" in findings[1].message
+
+
+def test_dl005_same_named_classes_do_not_collide(tmp_path):
+    """Two `class Manager` definitions in different files with opposite
+    contracts on the same method name: each file's `self.m()` resolves
+    against ITS OWN class (path-qualified), and cross-object resolution
+    falls back to the by-name table, which sees the ambiguity and
+    skips — never a misattributed finding."""
+    a = tmp_path / "a.py"
+    a.write_text(textwrap.dedent("""\
+        from dynamo_tpu.runtime.contracts import engine_thread_only
+
+        class Manager:
+            @engine_thread_only
+            def sync(self):
+                pass
+
+            @engine_thread_only
+            def drive(self):
+                self.sync()              # own class: same contract, fine
+        """))
+    b = tmp_path / "b.py"
+    b.write_text(textwrap.dedent("""\
+        from dynamo_tpu.runtime.contracts import never_engine_thread
+
+        class Manager:
+            @never_engine_thread
+            def sync(self):
+                pass
+
+            @never_engine_thread
+            def scrape(self):
+                self.sync()              # own class: same contract, fine
+        """))
+    assert run_lint([str(a), str(b)]) == []
+
+
+def test_dl005_skips_ambiguous_and_generic_names(tmp_path):
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime.contracts import (
+            engine_thread_only, never_engine_thread)
+
+        class A:
+            @engine_thread_only
+            def fetch(self):
+                pass
+
+        class B:
+            @never_engine_thread
+            def fetch(self):             # same name, both contracts
+                pass
+
+            @never_engine_thread
+            def runner(self, a, task):
+                a.fetch()                # ambiguous: skipped
+                task.cancel()            # generic stdlib name: skipped
+        """)
+    assert findings == []
+
+
+# -- suppression -----------------------------------------------------------
+
+
+def test_suppression_same_line_and_above(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import time
+
+        async def a():
+            time.sleep(1)  # dynamo-lint: disable=DL002 bench setup only
+
+        async def b():
+            # dynamo-lint: disable=DL002 deliberate throttle
+            time.sleep(1)
+
+        async def c():
+            time.sleep(1)            # NOT suppressed
+        """)
+    assert [(f.code, f.line) for f in findings] == [("DL002", 11)]
+
+
+def test_suppression_inside_except_body(tmp_path):
+    findings = lint_source(tmp_path, """\
+        def f():
+            try:
+                work()
+            except Exception:
+                # dynamo-lint: disable=DL003 best-effort metrics publish
+                pass
+        """)
+    assert findings == []
+
+
+def test_suppression_is_per_code(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import time
+
+        async def a():
+            time.sleep(1)  # dynamo-lint: disable=DL003 wrong code
+        """)
+    assert codes(findings) == ["DL002"]
+
+
+def test_suppression_multiple_codes(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import time
+
+        async def a():
+            time.sleep(1)  # dynamo-lint: disable=DL001,DL002 reason here
+        """)
+    assert findings == []
+
+
+# -- CLI / output modes ----------------------------------------------------
+
+
+def test_cli_json_mode_and_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("async def f():\n    import time\n    time.sleep(1)\n")
+    rc = main(["--json", str(bad)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["count"] == 1
+    assert out["findings"][0]["code"] == "DL002"
+    assert out["rules"] == RULE_TABLE
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert main(["--json", str(good)]) == 0
+    assert json.loads(capsys.readouterr().out)["count"] == 0
+
+
+def test_cli_no_paths_is_usage_error(capsys):
+    assert main([]) == 2
+
+
+def test_unparseable_file_does_not_crash(tmp_path, capsys):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    assert run_lint([str(p)]) == []
+    assert "cannot parse" in capsys.readouterr().err
+
+
+# -- the gate --------------------------------------------------------------
+
+
+def test_tree_is_clean():
+    """Tier-1 IS the CI gate: the serving tree must carry zero
+    unsuppressed findings.  On failure the formatted findings are the
+    assertion message — fix the code or add a justified suppression."""
+    paths = [os.path.join(REPO, d)
+             for d in ("dynamo_tpu", "tools", "benchmarks")]
+    findings = run_lint(paths)
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+@pytest.mark.slow
+def test_cli_end_to_end_over_tree():
+    """`python tools/dynamo_lint.py dynamo_tpu tools benchmarks` exits 0
+    (the acceptance-criteria invocation, exercised as a subprocess)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "dynamo_lint.py"),
+         "dynamo_tpu", "tools", "benchmarks"],
+        capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 findings" in out.stdout

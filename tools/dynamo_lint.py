@@ -1,0 +1,703 @@
+#!/usr/bin/env python
+"""dynamo-lint: machine-checked concurrency + hot-path contracts.
+
+The serving stack's correctness discipline ("engine thread only",
+"never the engine thread", "zero host syncs in the steady window",
+"metrics mutate under self._lock") lived in ~25 comments enforced by
+convention.  This analyzer checks them as rules over the stdlib `ast`
+(no dependencies), reading the `runtime/contracts.py` decorators as its
+source of truth so the static layer and the `DYNAMO_CONTRACTS=1`
+runtime layer enforce the SAME contract.
+
+Rules:
+
+  DL001  host-sync call (`.item()`, `jax.device_get`,
+         `.block_until_ready()`, `np.asarray`, blocking `.result()`)
+         inside a function decorated `@hot_path`
+  DL002  blocking call (`time.sleep`, `subprocess.*`, sync sockets,
+         `urllib.request.urlopen`, `requests.*`, `os.system`) inside
+         `async def` — stalls the whole event loop
+  DL003  silent exception swallow: `except Exception: pass` (body is
+         ONLY `pass`) — serving-path failures must log or be
+         explicitly suppressed with a reason
+  DL004  metrics discipline: registry metric names must be bare
+         (`dynamo_` is added by the registry prefix) and lowercase;
+         direct Counter/Gauge/Histogram constructions must carry the
+         `dynamo_` prefix themselves; classes owning a
+         `self._lock = threading.Lock()` must mutate their dict state
+         inside `with self._lock:`
+  DL005  thread-contract consistency: an `@engine_thread_only`
+         function may not call a `@never_engine_thread` one (or vice
+         versa) — resolved per-class when possible, by globally-unique
+         method name otherwise
+
+Suppression: append `# dynamo-lint: disable=DL003 <reason>` to the
+flagged line (or put it on its own line immediately above).  Multiple
+codes comma-separate: `disable=DL001,DL004`.
+
+Usage:
+    python tools/dynamo_lint.py dynamo_tpu tools benchmarks
+    python tools/dynamo_lint.py --json dynamo_tpu
+
+Exit status: 0 when clean, 1 when any unsuppressed finding, 2 on usage
+error.  Tier-1 runs this over the tree
+(`tests/test_lint.py::test_tree_is_clean`), so a new violation fails
+the suite — the repo has no external CI; tier-1 IS the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+CONTRACT_DECORATORS = ("engine_thread_only", "never_engine_thread",
+                       "hot_path")
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+    end_line: int = 0  # suppression span (multi-line nodes)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} " \
+               f"{self.message}"
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "code": self.code, "message": self.message}
+
+
+@dataclass
+class FileCtx:
+    """One parsed source file plus its suppression map."""
+
+    path: str
+    tree: ast.AST
+    # line -> set of suppressed codes (from `# dynamo-lint: disable=`)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def suppressed(self, f: Finding) -> bool:
+        """A finding is suppressed by a disable comment on the line
+        immediately above it, or anywhere within the flagged node's own
+        span (so `except Exception:` suppressions can live in the
+        handler body they justify)."""
+        for ln in range(f.line - 1, max(f.line, f.end_line) + 1):
+            if f.code in self.suppressions.get(ln, ()):
+                return True
+        return False
+
+
+@dataclass(frozen=True)
+class ContractEntry:
+    path: str
+    cls: Optional[str]       # enclosing class name (None = module level)
+    name: str
+    contract: str            # one of CONTRACT_DECORATORS
+    line: int
+
+
+class Project:
+    """Cross-file state: the decorator-derived contract table DL005
+    resolves against."""
+
+    def __init__(self, files: List[FileCtx]) -> None:
+        self.files = files
+        self.contracts: List[ContractEntry] = []
+        for ctx in files:
+            self.contracts.extend(_collect_contracts(ctx))
+        # name -> set of THREAD contracts anywhere in the tree (hot_path
+        # is orthogonal and must not make a name "ambiguous"; remaining
+        # ambiguity makes DL005 skip rather than guess).  by_class keys
+        # include the file path: two same-named classes in different
+        # files must not clobber each other's contracts.
+        self.by_name: Dict[str, Set[str]] = {}
+        self.by_class: Dict[Tuple[str, str, str], str] = {}
+        for e in self.contracts:
+            if e.contract not in THREAD_CONTRACTS:
+                continue
+            self.by_name.setdefault(e.name, set()).add(e.contract)
+            if e.cls is not None:
+                self.by_class[(e.path, e.cls, e.name)] = e.contract
+
+
+def _decorator_name(node: ast.expr) -> Optional[str]:
+    """`@hot_path`, `@contracts.hot_path`, `@hot_path()` all resolve."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _fn_contracts(node) -> Set[str]:
+    """ALL contract decorators on a function — decorators stack
+    (`@engine_thread_only` + `@hot_path` on EngineCore.step), so a
+    first-match scan would leave the hottest functions unchecked."""
+    return {name for name in (_decorator_name(d)
+                              for d in node.decorator_list)
+            if name in CONTRACT_DECORATORS}
+
+
+THREAD_CONTRACTS = frozenset({"engine_thread_only", "never_engine_thread"})
+
+
+def _thread_contract(node) -> Optional[str]:
+    """The function's thread-affinity contract, if exactly one."""
+    found = _fn_contracts(node) & THREAD_CONTRACTS
+    return next(iter(found)) if len(found) == 1 else None
+
+
+def _collect_contracts(ctx: FileCtx) -> List[ContractEntry]:
+    out: List[ContractEntry] = []
+
+    def visit(node, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for c in sorted(_fn_contracts(child)):
+                    out.append(ContractEntry(ctx.path, cls, child.name, c,
+                                             child.lineno))
+                visit(child, cls)
+            else:
+                visit(child, cls)
+
+    visit(ctx.tree, None)
+    return out
+
+
+def _own_statements(fn) -> Iterable[ast.AST]:
+    """Walk a function body EXCLUDING nested function/lambda bodies —
+    closures may legally execute on another thread (e.g. work submitted
+    to an executor), so lexical nesting does not inherit the contract."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    """`a.b.c` -> "a.b.c" for simple attribute chains; None otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# -- rule framework --------------------------------------------------------
+
+
+class Rule:
+    code = "DL000"
+    name = "base"
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileCtx, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 0)
+        return Finding(ctx.path, line, getattr(node, "col_offset", 0),
+                       self.code, message,
+                       end_line=getattr(node, "end_lineno", line) or line)
+
+
+class HostSyncInHotPath(Rule):
+    """DL001: host-sync calls inside `@hot_path` functions."""
+
+    code = "DL001"
+    name = "host-sync-in-hot-path"
+
+    ZERO_ARG_ATTRS = ("item", "block_until_ready", "result")
+    SYNC_DOTTED = ("jax.device_get", "np.asarray", "numpy.asarray",
+                   "onp.asarray")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if "hot_path" not in _fn_contracts(fn):
+                continue
+            for node in _own_statements(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in self.ZERO_ARG_ATTRS
+                        and not node.args and not node.keywords):
+                    out.append(self.finding(
+                        ctx, node,
+                        f"host sync `.{f.attr}()` inside @hot_path "
+                        f"function {fn.name!r} — the steady window must "
+                        "not stall the engine thread"))
+                    continue
+                dotted = _dotted(f)
+                if dotted in self.SYNC_DOTTED:
+                    # np.asarray over a HOST literal (list/tuple/
+                    # comprehension/constant) builds an array, it does
+                    # not settle a device value — only flag opaque args.
+                    if node.args and isinstance(
+                            node.args[0],
+                            (ast.List, ast.Tuple, ast.ListComp,
+                             ast.GeneratorExp, ast.Dict, ast.Constant)):
+                        continue
+                    out.append(self.finding(
+                        ctx, node,
+                        f"host sync `{dotted}` inside @hot_path function "
+                        f"{fn.name!r} — device values must settle off "
+                        "the steady window"))
+        return out
+
+
+class BlockingInAsync(Rule):
+    """DL002: blocking calls lexically inside `async def`.
+
+    Known blind spot: only MODULE-dotted names are matched
+    (`time.sleep`, `subprocess.run`) — receiver-method calls like
+    `proc.wait()` or `sock.recv()` are invisible because the receiver's
+    type is unknowable from the AST.  Those stay code-review
+    territory; keep them off the loop with `asyncio.to_thread`."""
+
+    code = "DL002"
+    name = "blocking-call-in-async"
+
+    BLOCKING_DOTTED = {
+        "time.sleep": "use `await asyncio.sleep(...)`",
+        "subprocess.run": "use `asyncio.create_subprocess_exec` or hop "
+                          "to a thread",
+        "subprocess.call": "use `asyncio.create_subprocess_exec`",
+        "subprocess.check_call": "use `asyncio.create_subprocess_exec`",
+        "subprocess.check_output": "use `asyncio.create_subprocess_exec`",
+        "subprocess.Popen": "use `asyncio.create_subprocess_exec`",
+        "socket.create_connection": "use `asyncio.open_connection`",
+        "urllib.request.urlopen": "use an async client or "
+                                  "`asyncio.to_thread`",
+        "request.urlopen": "use an async client or `asyncio.to_thread`",
+        "os.system": "use `asyncio.create_subprocess_shell`",
+        "requests.get": "use an async client or `asyncio.to_thread`",
+        "requests.post": "use an async client or `asyncio.to_thread`",
+        "requests.request": "use an async client or `asyncio.to_thread`",
+    }
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _own_statements(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func)
+                hint = self.BLOCKING_DOTTED.get(dotted or "")
+                if hint is not None:
+                    out.append(self.finding(
+                        ctx, node,
+                        f"blocking `{dotted}` inside `async def "
+                        f"{fn.name}` stalls the event loop — {hint}"))
+        return out
+
+
+class SilentSwallow(Rule):
+    """DL003: `except Exception: pass` with nothing else in the body."""
+
+    code = "DL003"
+    name = "silent-exception-swallow"
+
+    BROAD = ("Exception", "BaseException")
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True  # bare `except:`
+        if isinstance(t, ast.Name):
+            return t.id in self.BROAD
+        if isinstance(t, ast.Tuple):
+            return any(isinstance(e, ast.Name) and e.id in self.BROAD
+                       for e in t.elts)
+        return False
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node):
+                continue
+            if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+                out.append(self.finding(
+                    ctx, node,
+                    "broad `except` swallows the exception silently — "
+                    "log (rate-limited) or suppress with a reason"))
+        return out
+
+
+class MetricsDiscipline(Rule):
+    """DL004: metric naming + lock discipline in `_lock`-owning classes."""
+
+    code = "DL004"
+    name = "metrics-discipline"
+
+    REGISTRY_METHODS = ("counter", "gauge", "histogram")
+    METRIC_CLASSES = ("Counter", "Gauge", "Histogram")
+    NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+    MUTATORS = ("pop", "update", "clear", "setdefault", "popitem",
+                "append", "extend", "add", "discard", "remove",
+                "popleft", "appendleft")
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        out.extend(self._check_names(ctx))
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_locks(ctx, node))
+        return out
+
+    # -- naming ------------------------------------------------------------
+
+    def _check_names(self, ctx: FileCtx) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                continue
+            name = first.value
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in self.REGISTRY_METHODS:
+                if name.startswith("dynamo_"):
+                    out.append(self.finding(
+                        ctx, node,
+                        f"registry metric {name!r} double-prefixes: the "
+                        "MetricsRegistry prefix already adds `dynamo_`"))
+                elif not self.NAME_RE.match(name):
+                    out.append(self.finding(
+                        ctx, node,
+                        f"registry metric {name!r} is not a valid "
+                        "lowercase Prometheus name fragment"))
+            elif isinstance(f, ast.Name) and f.id in self.METRIC_CLASSES:
+                if not name.startswith("dynamo_"):
+                    out.append(self.finding(
+                        ctx, node,
+                        f"directly-constructed metric {name!r} must carry "
+                        "the `dynamo_` prefix (no registry adds it here)"))
+        return out
+
+    # -- lock discipline ---------------------------------------------------
+
+    def _init_of(self, cls: ast.ClassDef):
+        for item in cls.body:
+            if isinstance(item, ast.FunctionDef) and item.name == "__init__":
+                return item
+        return None
+
+    def _guarded_attrs(self, init) -> Optional[Set[str]]:
+        """None when the class owns no `self._lock`; else the private
+        container attrs (`self._x = {}` / dict() / OrderedDict() /
+        defaultdict() / deque()) whose mutation the lock must cover."""
+        has_lock = False
+        attrs: Set[str] = set()
+        for node in ast.walk(init):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            v = node.value
+            if t.attr == "_lock":
+                d = _dotted(v.func) if isinstance(v, ast.Call) else None
+                if d in ("threading.Lock", "Lock", "threading.RLock",
+                         "RLock"):
+                    has_lock = True
+                continue
+            if not t.attr.startswith("_"):
+                continue
+            if isinstance(v, ast.Dict) and not v.keys:
+                attrs.add(t.attr)
+            elif isinstance(v, ast.Call):
+                d = _dotted(v.func)
+                if d in ("dict", "OrderedDict", "collections.OrderedDict",
+                         "defaultdict", "collections.defaultdict",
+                         "deque", "collections.deque", "set"):
+                    attrs.add(t.attr)
+        return attrs if has_lock else None
+
+    def _is_lock_with(self, node: ast.With) -> bool:
+        for item in node.items:
+            e = item.context_expr
+            if (isinstance(e, ast.Attribute) and e.attr == "_lock"
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == "self"):
+                return True
+        return False
+
+    def _check_locks(self, ctx: FileCtx, cls: ast.ClassDef) -> List[Finding]:
+        init = self._init_of(cls)
+        if init is None:
+            return []
+        guarded = self._guarded_attrs(init)
+        if not guarded:
+            return []
+        out: List[Finding] = []
+
+        def is_guarded_self_attr(node) -> Optional[str]:
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self" and node.attr in guarded):
+                return node.attr
+            return None
+
+        def walk(node, locked: bool, fn_name: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue  # closures: may run anywhere; out of scope
+                child_locked = locked
+                if isinstance(child, ast.With) and self._is_lock_with(child):
+                    child_locked = True
+                if not locked:
+                    attr = self._mutation_attr(child, is_guarded_self_attr)
+                    if attr is not None:
+                        out.append(self.finding(
+                            ctx, child,
+                            f"`self.{attr}` (lock-guarded state of "
+                            f"{cls.name}) mutated in {fn_name!r} outside "
+                            "`with self._lock:` — scrapes may tear"))
+                walk(child, child_locked, fn_name)
+
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and item.name != "__init__":
+                walk(item, False, item.name)
+        return out
+
+    def _mutation_attr(self, node, is_guarded) -> Optional[str]:
+        # self._x[...] = v   /  self._x[...] += v  /  del self._x[...]
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = node.targets
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                attr = is_guarded(t.value)
+                if attr:
+                    return attr
+        # self._x.pop(...) etc.
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            if isinstance(f, ast.Attribute) and f.attr in self.MUTATORS:
+                attr = is_guarded(f.value)
+                if attr:
+                    return attr
+        return None
+
+
+class ContractConsistency(Rule):
+    """DL005: engine-thread-only functions may not call
+    never-engine-thread ones, and vice versa."""
+
+    code = "DL005"
+    name = "thread-contract-consistency"
+
+    CONFLICTS = {("engine_thread_only", "never_engine_thread"),
+                 ("never_engine_thread", "engine_thread_only")}
+
+    # Method names that collide with ubiquitous stdlib APIs (Task.cancel,
+    # Lock.release, socket.close, ...): resolving these BY NAME on an
+    # arbitrary receiver would be guessing.  Same-class `self.m()` calls
+    # still resolve precisely above this filter.
+    GENERIC_NAMES = frozenset({
+        "cancel", "close", "start", "stop", "clear", "get", "put", "set",
+        "pop", "join", "result", "done", "release", "acquire", "add",
+        "remove", "update", "send", "recv", "wait", "run", "next",
+    })
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+
+        def visit(node, cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    c = _thread_contract(child)
+                    if c is not None:
+                        out.extend(self._check_fn(ctx, project, child,
+                                                  c, cls))
+                    visit(child, cls)
+                    continue
+                visit(child, cls)
+
+        visit(ctx.tree, None)
+        return out
+
+    def _resolve(self, project: Project, ctx: FileCtx, call: ast.Call,
+                 cls: Optional[str]) -> Optional[Tuple[str, str]]:
+        """(callee_name, contract) or None when unknown/ambiguous."""
+        f = call.func
+        name = None
+        if isinstance(f, ast.Attribute):
+            name = f.attr
+            # self.m() resolves against the enclosing class first —
+            # path-qualified, so a same-named class elsewhere in the
+            # tree cannot misattribute the contract
+            if (isinstance(f.value, ast.Name) and f.value.id == "self"
+                    and cls is not None
+                    and (ctx.path, cls, name) in project.by_class):
+                return name, project.by_class[(ctx.path, cls, name)]
+        elif isinstance(f, ast.Name):
+            name = f.id
+        if name is None or name in self.GENERIC_NAMES:
+            return None
+        contracts = project.by_name.get(name)
+        if contracts is None or len(contracts) != 1:
+            return None  # unknown or ambiguous: do not guess
+        return name, next(iter(contracts))
+
+    def _check_fn(self, ctx: FileCtx, project: Project, fn, contract: str,
+                  cls: Optional[str]) -> List[Finding]:
+        out: List[Finding] = []
+        for node in _own_statements(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = self._resolve(project, ctx, node, cls)
+            if resolved is None:
+                continue
+            callee, callee_contract = resolved
+            if (contract, callee_contract) in self.CONFLICTS:
+                out.append(self.finding(
+                    ctx, node,
+                    f"@{contract} function {fn.name!r} calls "
+                    f"@{callee_contract} function {callee!r} — the two "
+                    "contracts are mutually exclusive on one thread"))
+        return out
+
+
+RULES: Sequence[Rule] = (HostSyncInHotPath(), BlockingInAsync(),
+                         SilentSwallow(), MetricsDiscipline(),
+                         ContractConsistency())
+
+RULE_TABLE = {r.code: r.name for r in RULES}
+
+
+# -- driver ---------------------------------------------------------------
+
+
+def _parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        if "dynamo-lint" not in line:
+            continue
+        m = re.search(r"#\s*dynamo-lint:\s*disable=([A-Z0-9,]+)", line)
+        if m:
+            out[i] = {c.strip() for c in m.group(1).split(",") if c.strip()}
+    return out
+
+
+def load_file(path: str) -> Optional[FileCtx]:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError) as e:
+        print(f"dynamo-lint: cannot parse {path}: {e}", file=sys.stderr)
+        return None
+    return FileCtx(path=path, tree=tree,
+                   suppressions=_parse_suppressions(source))
+
+
+def discover(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                files.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d != "__pycache__" and not d.startswith(".")]
+            files.extend(os.path.join(root, n) for n in sorted(names)
+                         if n.endswith(".py"))
+    return sorted(set(files))
+
+
+def run_lint(paths: Sequence[str],
+             rules: Sequence[Rule] = RULES) -> List[Finding]:
+    """Lint `paths` (files or directories); returns UNSUPPRESSED
+    findings sorted by location.  Importable — the tier-1 gate test and
+    the CLI share this."""
+    ctxs = [c for c in (load_file(f) for f in discover(paths))
+            if c is not None]
+    project = Project(ctxs)
+    findings: List[Finding] = []
+    for ctx in ctxs:
+        for rule in rules:
+            for f in rule.check(ctx, project):
+                if not ctx.suppressed(f):
+                    findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.code))
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        "dynamo_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for code, name in sorted(RULE_TABLE.items()):
+            print(f"{code}  {name}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        return 2
+    findings = run_lint(args.paths)
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "count": len(findings),
+            "rules": RULE_TABLE,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        n = len(findings)
+        print(f"dynamo-lint: {n} finding{'s' if n != 1 else ''}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
