@@ -464,6 +464,24 @@ def main():
 
     prefix_fleet = _asyncio.run(
         _asyncio.wait_for(run_prefix_fleet(), 120))
+
+    # Sharded fast-decode plane (ISSUE 9): tok/s/chip + per-chip mbu at
+    # tp2/dp2 vs meshless, through the same make_sharded_window /
+    # make_sharded_greedy_step programs a served sharded engine runs.
+    # Gate floor: sharded_decode.tok_s_per_chip_ratio >= 0.8 on TPU
+    # rounds with >= 2 chips; single-chip rigs report the modes as
+    # skipped and the floor is skipped too (never silently passed).
+    from dynamo_tpu.bench.sharded_decode import run_sharded_decode
+
+    sharded_decode = run_sharded_decode(
+        cfg, params=params, batch=BATCH, ctx=CTX, block=BLOCK,
+        width=WIDTH, window=window, hbm_bw=hbm_bw,
+        weight_bytes=weight_bytes,
+        # Reuse this run's own slope-timed meshless numbers (same
+        # geometry, same fused program shapes) instead of re-compiling
+        # and re-timing the baseline a second time.
+        meshless_window_step_s=win_step_s,
+        meshless_single_step_s=step_s)
     serving_tok_s = sorted(serving_runs)[len(serving_runs) // 2]
     prefill_cold = prefill_runs[0]
     prefill_steady = max(prefill_runs[1:])
@@ -528,6 +546,7 @@ def main():
         "kv_quant": kv_quant,
         "spec_decode": spec_decode,
         "prefix_fleet": prefix_fleet,
+        "sharded_decode": sharded_decode,
         "peak_flops_nominal": round(peak / 1e12, 1),
         "peak_flops_measured": round(peak_measured / 1e12, 1),
         "hbm_bw_nominal_gbs": round(hbm_bw / 1e9, 1),

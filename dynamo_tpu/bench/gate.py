@@ -91,6 +91,13 @@ class FloorSpec:
 #   hand out remote-prefix hints for them; measures ~0.34, so 0.2
 #   catches a broken donor policy (hints never attached, dead-donor
 #   leakage filtering everything out) without flaking on routing noise.
+# - sharded_decode.tok_s_per_chip_ratio >= 0.8 — ISSUE 9: a tp2 engine's
+#   fused decode window must deliver >= 80% of the meshless tok/s PER
+#   CHIP (tp2 trades one all-reduce per layer for halved weight/KV
+#   streaming, so the honest ratio sits near 0.9 on ICI-linked chips);
+#   below 0.8 means the fast decode plane regressed to the gather path
+#   or the sharded fused step broke.  Only present when the round ran on
+#   >= 2 chips (single-chip rigs skip the modes and the floor).
 TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("mbu", minimum=0.75),
     FloorSpec("mixed_prefill_decode.interference_ratio", minimum=0.80),
@@ -98,6 +105,7 @@ TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("spec_decode.acceptance_rate", minimum=0.6),
     FloorSpec("spec_decode.modeled_decode_speedup", minimum=1.3),
     FloorSpec("prefix_fleet.remote_hit_rate", minimum=0.2),
+    FloorSpec("sharded_decode.tok_s_per_chip_ratio", minimum=0.8),
 )
 
 

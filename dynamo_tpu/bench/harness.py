@@ -91,6 +91,21 @@ def measure_slope(run: Callable[[int], float], n1: int, n2: int,
                          samples=tuple(samples), cold_s=cold_s)
 
 
+def sequential_block_tables(batch: int, width: int):
+    """The canonical decode micro-bench page layout: row i owns pages
+    [1 + i*width, 1 + (i+1)*width), page 0 reserved as the null block.
+    ONE definition (used by bench/sharded_decode.py and
+    tools/profile_decode.py) so the allocator's page-numbering
+    convention cannot silently skew one tool's measurements when the
+    other is updated.  Returns int32 numpy; callers device-put it."""
+    import numpy as np
+
+    bt = np.zeros((batch, width), np.int32)
+    for i in range(batch):
+        bt[i] = np.arange(1 + i * width, 1 + (i + 1) * width)
+    return bt
+
+
 def timed(fn: Callable[[], object]) -> Tuple[object, float]:
     """(result, wall seconds) — for cold/compile phases kept separate
     from warm slope samples."""

@@ -104,8 +104,10 @@ class EngineConfig:
     # with per-token-per-head f32 scales and dequantizes inside the
     # decode kernel's VMEM tile — HBM bytes per context token drop to
     # ~0.53x bf16 at serving geometry (kv_cache.py module docstring).
-    # Meshless engines only (the sharded attention bodies don't thread
-    # scale buffers); combination with mesh/pp raises at construction.
+    # Composes with single-process tp/dp meshes, dp_attention and
+    # dp-local decode (scales shard with their kv heads / slots —
+    # ISSUE 9); pp, ring-SP and multi-process meshes still reject at
+    # construction with pointed errors.
     kv_quant: str = "none"
     mesh: Optional[object] = None          # jax.sharding.Mesh for tp/ep
     # Batch-sharded attention with slot-sharded KV (tp beyond the kv-head
@@ -198,11 +200,6 @@ class EngineCore:
         cfg = config.model
         sched_cfg = config.scheduler
         self.block_size = sched_cfg.block_size
-        if config.kv_quant != "none" and config.mesh is not None:
-            raise ValueError(
-                f"kv_quant={config.kv_quant!r} requires a meshless engine "
-                "(the sharded attention paths don't thread scale buffers); "
-                "drop --kv-quant or the parallelism flags")
         self.cache_cfg = kvc.KvCacheConfig.for_model(
             cfg, num_blocks=config.num_blocks, block_size=self.block_size,
             dtype=config.cache_dtype, kv_quant=config.kv_quant,
@@ -219,6 +216,29 @@ class EngineCore:
             from dynamo_tpu.parallel.multihost import mesh_spans_processes
 
             self._mh = mesh_spans_processes(self.mesh)
+        # kv_quant × mesh composition (ISSUE 9 leg 1): the sharded
+        # attention bodies thread per-token-per-head scale buffers for
+        # head-sharded tp (scales shard with their kv heads), for
+        # dp_attention's slot-sharded cache, and for dp-local shard_map
+        # decode — so int8 now serves every single-process tp/dp mesh.
+        # The still-unsupported combos reject with pointed errors:
+        if self.cache_cfg.quantized and self.mesh is not None:
+            if self.mesh.shape.get("pp", 1) > 1:
+                raise ValueError(
+                    "kv_quant=int8 is not wired for pipeline parallelism "
+                    "(the stacked pp cache layout has no scale-buffer "
+                    "variant); drop --kv-quant or --pp")
+            if self.mesh.shape.get("sp", 1) > 1:
+                raise ValueError(
+                    "kv_quant=int8 is not wired for ring-SP prefill (the "
+                    "ring attends unquantized chunk K/V, which would "
+                    "silently diverge from the dequantized cache-read "
+                    "paths); drop --kv-quant or --sp")
+            if self._mh:
+                raise ValueError(
+                    "kv_quant=int8 under a multi-process mesh is not in "
+                    "the lockstep command stream yet; run int8 "
+                    "single-process")
         # Host-side staging for device inputs: single-process uploads
         # eagerly (device-resident caching matters on a tunneled chip);
         # multihost keeps numpy and lets the step wrappers build global
@@ -253,23 +273,10 @@ class EngineCore:
         if params is None:
             params = init_params(cfg, jax.random.key(config.seed))
         self._moe = cfg.is_moe
-        # Auto pallas: on for TPU, except under a dp_attention mesh (its
-        # slot-sharded KV breaks the kernel's global slot indexing — an
-        # EXPLICIT use_pallas_decode=True there is rejected loudly by
-        # make_sharded_step rather than silently downgraded) or when the
-        # per-shard cache feature width can't satisfy Mosaic's DMA tiling
-        # (F % 128, block % 8 — small test models fall back to gather).
-        pallas = config.use_pallas_decode
-        if pallas is None:
-            tp = (self.mesh.shape["tp"] if self.mesh is not None else 1)
-            feat = cfg.num_kv_heads * cfg.head_dim // max(tp, 1)
-            pallas = (jax.default_backend() == "tpu"
-                      and feat % 128 == 0
-                      and self.block_size % 8 == 0
-                      and not (config.dp_attention
-                               and config.mesh is not None))
-        self._use_pallas = pallas
         # dp-attention locality (see EngineConfig.dp_attention_local).
+        # Resolved BEFORE the pallas auto-selection: the kernel composes
+        # with dp_attention only through locality (local slot rebase
+        # inside the shard_map body — ISSUE 9 leg 2).
         self._dp_local = config.dp_attention_local
         if self._dp_local is None:
             self._dp_local = (config.dp_attention
@@ -283,6 +290,30 @@ class EngineCore:
             raise ValueError("dp_attention_local needs the plain "
                              "allocator (enable_prefix_cache=False); the "
                              "tiered source has no shard concept yet")
+        # Auto pallas: on for TPU, except under a dp_attention mesh
+        # WITHOUT page locality (pages may live on any shard — an
+        # EXPLICIT use_pallas_decode=True there is rejected loudly by
+        # make_sharded_step rather than silently downgraded) or when the
+        # per-shard cache feature width can't satisfy Mosaic's DMA tiling
+        # (F % 128, block % 8 — small test models fall back to gather).
+        # dp_attention slot-shards the cache, so every shard keeps the
+        # FULL feature width; head-sharded tp splits it.
+        pallas = config.use_pallas_decode
+        if pallas is None:
+            from dynamo_tpu.ops.pallas import mosaic_geometry_ok
+
+            if self.mesh is not None and config.dp_attention:
+                feat = cfg.num_kv_heads * cfg.head_dim
+            else:
+                tp = (self.mesh.shape["tp"] if self.mesh is not None
+                      else 1)
+                feat = cfg.num_kv_heads * cfg.head_dim // max(tp, 1)
+            pallas = (jax.default_backend() == "tpu"
+                      and mosaic_geometry_ok(feat, self.block_size)
+                      and not (config.dp_attention
+                               and config.mesh is not None
+                               and not self._dp_local))
+        self._use_pallas = pallas
         self._n_local_shards = 1
         if self._dp_local:
             self._n_local_shards = (self.mesh.shape["dp"]
@@ -298,8 +329,10 @@ class EngineCore:
         self._pp = (self.mesh is not None
                     and self.mesh.shape.get("pp", 1) > 1)
         # Raw (pre-jit) forward for the fused greedy single step
-        # (_greedy_step_fn); stays None on sharded/pp engines, whose
-        # steps come back already jitted.
+        # (_greedy_step_fn) on meshless engines; sharded (non-pp,
+        # single-process) engines build their fused step through
+        # parallel.sharding.make_sharded_greedy_step instead (ISSUE 9
+        # leg 3 — the sharded single-step cliff).
         self._fwd_raw: Optional[Callable] = None
         if self._mh and self._pp:
             raise ValueError("pipeline parallelism under a multi-process "
@@ -337,12 +370,14 @@ class EngineCore:
                 with_expert_load=self._moe,
                 dp_attention=config.dp_attention,
                 use_pallas_decode=pallas,
-                dp_local=self._dp_local)
+                dp_local=self._dp_local,
+                kv_quant=self.cache_cfg.quantized)
             cache = shard_pytree(
                 kvc.init_cache(self.cache_cfg),
                 cache_pspecs(cfg.num_layers,
                              dp_attention=config.dp_attention,
-                             dp_local=self._dp_local),
+                             dp_local=self._dp_local,
+                             kv_quant=self.cache_cfg.quantized),
                 self.mesh)
             if (self.mesh.shape.get("sp", 1) > 1 and not cfg.is_moe
                     and not config.dp_attention):
@@ -359,6 +394,36 @@ class EngineCore:
             self._step = jax.jit(fwd, donate_argnums=(1,))
             self._fwd_raw = fwd
             cache = kvc.init_cache(self.cache_cfg)
+        # Modeled-bytes honesty under meshes (ISSUE 9 satellite) needs
+        # TWO per-chip divisors, because residency and read traffic
+        # shard differently:
+        # - `kv_shard_count` (RESIDENCY — dynamo_kv_bytes_per_block):
+        #   how many chips one stored KV byte splits across.  Head-
+        #   sharded tp and dp_attention split the cache tp-ways
+        #   (features vs slots), dp-local over the flat (dp, tp) grid,
+        #   pp splits the LAYERS over stages; plain dp REPLICATES the
+        #   cache per replica — no division.
+        # - `kv_traffic_shards` (READ TRAFFIC — kv_read_bytes_modeled /
+        #   effective_bytes_per_token): batch rows shard over dp (and
+        #   over (dp, tp) under dp_attention), so each chip's attention
+        #   sweeps only its rows' context — per-chip traffic divides by
+        #   dp*tp on every non-pp mesh even where residency doesn't
+        #   (plain dp: full cache resident, half the rows read).  A pp
+        #   stage reads its layer slice for ALL rows: divide by pp.
+        if self._pp:
+            self.kv_shard_count = self.mesh.shape["pp"]
+            self.kv_traffic_shards = self.mesh.shape["pp"]
+        elif self.mesh is not None:
+            self.kv_traffic_shards = (self.mesh.shape["dp"]
+                                      * self.mesh.shape["tp"])
+            self.kv_shard_count = (self.kv_traffic_shards if self._dp_local
+                                   else max(self.mesh.shape["tp"], 1))
+        else:
+            self.kv_shard_count = self.kv_traffic_shards = 1
+        # Per-chip KV bytes one decode step reads per context token.
+        self._ctx_token_bytes_chip = (
+            self.cache_cfg.bytes_per_context_token
+            / self.kv_traffic_shards)
         # Cumulative per-expert assignment counts (MoE telemetry the
         # worker publishes; reference `base_handlers.py:40-62`).
         self.expert_load = (np.zeros((cfg.num_experts,), np.int64)
@@ -906,10 +971,11 @@ class EngineCore:
         self.counters.note_dispatch("spec", bucket, T, width)
         self.counters.spec_dispatches += 1
         # Effective-bytes model: ONE sweep of each row's KV serves up to
-        # T emitted tokens (tokens tally added below from n_emit).
+        # T emitted tokens (tokens tally added below from n_emit);
+        # per-chip bytes under meshes (kv_shard_count).
         self.counters.note_kv_read(
             sum(r.context_len + K for r in reqs)
-            * self.cache_cfg.bytes_per_context_token, 0)
+            * self._ctx_token_bytes_chip, 0)
         logits, self.cache = self._run_step(
             jnp.asarray(tokens), jnp.asarray(positions),
             jnp.asarray(seq_lens), jnp.asarray(bts), None)
@@ -1125,7 +1191,8 @@ class EngineCore:
                     self._mm_step = make_sharded_mm_step(
                         self.config.model, self.block_size, self.mesh,
                         dp_attention=self.config.dp_attention,
-                        dp_local=self._dp_local)
+                        dp_local=self._dp_local,
+                        kv_quant=self.cache_cfg.quantized)
                 else:
                     self._mm_step = jax.jit(
                         make_forward_step(self.config.model,
@@ -1213,22 +1280,27 @@ class EngineCore:
         self.counters.single_step_dispatches += 1
         # Effective-bytes model: this step's attention reads each live
         # row's full KV context once (weights excluded — this series
-        # isolates the KV plane the quantized cache halves).
+        # isolates the KV plane the quantized cache halves); per-chip
+        # bytes under meshes (kv_shard_count).
         self.counters.note_kv_read(
             sum(r.context_len for r in live)
-            * self.cache_cfg.bytes_per_context_token, len(live))
+            * self._ctx_token_bytes_chip, len(live))
         zeros = self._zeros_dev.get(bucket)
         if zeros is None:
             zeros = self._zeros_dev[bucket] = self._dev(
                 np.zeros((bucket,), np.int32))
-        if (self._fwd_raw is not None and not self._mh
+        if (self._fused_greedy_capable
                 and all(r.sampling.temperature <= 0 for r in live)
                 and not any(r.sampling.logprobs for r in live)):
             # Fused greedy single step: forward + argmax in ONE compiled
             # program (donated cache), ONE host sync for [bucket] tokens.
             # The unfused path is 3 dispatches (step, row gather, argmax)
             # plus a [B, V] f32 logits output allocation per step — the
-            # r5 single-step cliff's engine-side half.
+            # r5 single-step cliff's engine-side half.  Sharded non-pp
+            # engines fuse through make_sharded_greedy_step, so the
+            # cliff dies under meshes too (pp keeps the plain path: the
+            # stage step has no all-in-one program; multihost replays
+            # the unfused step through the lockstep stream).
             self.counters.note_dispatch("decode1g", bucket, work.pages)
             res = self._greedy_step_fn()(
                 self.params, self.cache, self._dev(tokens),
@@ -1261,12 +1333,37 @@ class EngineCore:
                 float(lps[i]) if lps is not None else None))
         return deltas
 
+    @property
+    def _fused_greedy_capable(self) -> bool:
+        """Engines whose all-greedy single-step decode runs the fused
+        forward+argmax program: meshless (raw forward captured) and
+        single-process sharded non-pp (make_sharded_greedy_step)."""
+        return (self._fwd_raw is not None
+                or (self.mesh is not None and not self._pp
+                    and not self._mh))
+
     def _greedy_step_fn(self):
-        """Lazily-jitted fused greedy single step (unsharded engines):
-        the forward and the argmax compile into one program, so the
-        non-window decode path costs one dispatch and returns [B] tokens
-        instead of [B, V] logits."""
+        """Lazily-jitted fused greedy single step: the forward and the
+        argmax compile into one program, so the non-window decode path
+        costs one dispatch and returns [B] tokens instead of [B, V]
+        logits.  Sharded (non-pp) engines build it through
+        parallel.sharding.make_sharded_greedy_step with the engine's own
+        sharding choices, so tp/dp/dp-attention fleets shed the
+        single-step cliff exactly like meshless ones."""
         if self._greedy_fused is None:
+            if self.mesh is not None:
+                from dynamo_tpu.parallel.sharding import (
+                    make_sharded_greedy_step)
+
+                self._greedy_fused = make_sharded_greedy_step(
+                    self.config.model, self.block_size, self.mesh,
+                    moe_mode=getattr(self, "_moe_mode", "auto"),
+                    with_expert_load=self._moe,
+                    dp_attention=self.config.dp_attention,
+                    use_pallas_decode=self._use_pallas,
+                    dp_local=self._dp_local,
+                    kv_quant=self.cache_cfg.quantized)
+                return self._greedy_fused
             fwd = self._fwd_raw
             moe = self._moe
 
@@ -1298,7 +1395,8 @@ class EngineCore:
                     greedy_only=greedy_only,
                     use_pallas_decode=self._use_pallas,
                     dp_attention=self.config.dp_attention,
-                    dp_local=self._dp_local)
+                    dp_local=self._dp_local,
+                    kv_quant=self.cache_cfg.quantized)
             else:
                 from dynamo_tpu.models.llama import make_decode_window
 
@@ -1376,7 +1474,7 @@ class EngineCore:
         # (the spec path makes the same appended-only choice).
         self.counters.note_kv_read(
             sum(s * K + K * (K - 1) // 2 for s in shadows)
-            * self.cache_cfg.bytes_per_context_token, 0)
+            * self._ctx_token_bytes_chip, 0)
 
         if lag:
             last_tokens = self._inflight[-1]["out"][K - 1]  # device, no sync
@@ -1693,7 +1791,8 @@ class EngineCore:
                 self._embed_step = make_sharded_embed_step(
                     self.config.model, self.block_size, self.mesh,
                     dp_attention=self.config.dp_attention,
-                    dp_local=self._dp_local)
+                    dp_local=self._dp_local,
+                    kv_quant=self.cache_cfg.quantized)
             else:
                 from dynamo_tpu.models.llama import make_forward_step as mfs
 

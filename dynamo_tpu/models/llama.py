@@ -149,6 +149,7 @@ def _attention_block(
     sp_mesh=None,            # mesh → ring attention over its sp axis
     pallas_mesh=None,        # mesh → shard_map the decode kernel (dp, tp)
     dp_local_mesh=None,      # mesh → device-local dp-attention decode
+    dp_local_pallas=False,   # dp-local body: pallas kernel on local slots
     k_scale_cache=None,      # [S, Hkv] f32 (int8 cache) or None
     v_scale_cache=None,
 ) -> Tuple:
@@ -158,14 +159,14 @@ def _attention_block(
     scatter in `write_kv` aliases in place under donation / loop carries."""
     B, T, _ = x.shape
     quant = k_scale_cache is not None
-    if quant and (sp_mesh is not None or pallas_mesh is not None
-                  or dp_local_mesh is not None):
-        # The sharded shard_map bodies don't thread scale buffers yet;
-        # the engine gates kv_quant to meshless serving (worker flag
-        # rejects the combination with a clear error).
-        raise ValueError("kv_quant=int8 is not wired for sharded "
-                         "attention paths (sp ring / sharded pallas / "
-                         "dp-local); run the quantized cache unsharded")
+    if quant and sp_mesh is not None:
+        # The ring path attends the chunk's PRE-quantization K/V (no
+        # cache read), which would silently diverge from every
+        # dequantized-read path — the engine rejects sp×int8 at
+        # construction; this is the backstop.
+        raise ValueError("kv_quant=int8 is not wired for ring-SP "
+                         "prefill (the ring attends unquantized chunk "
+                         "K/V); drop --kv-quant or --sp")
     q = (x @ p_attn["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
     k = (x @ p_attn["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     v = (x @ p_attn["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
@@ -183,9 +184,21 @@ def _attention_block(
         # null block (dropped; they land in the real null block on the
         # device that owns it) and (b) pad-context gathers already masked
         # by seq_lens.
+        #
+        # `dp_local_pallas` (ISSUE 9 leg 2): block tables rebase to the
+        # shard's LOCAL page range and the Pallas kernel streams pages
+        # from the local cache shard — the "global slot indexing" that
+        # used to force the gather path becomes local indexing inside
+        # the body.  Clamped out-of-range entries (other shards' null
+        # block in pad columns) sit past each row's ceil(seq_len/bs)
+        # real pages, which is all the kernel ever reads.  Quantized
+        # caches thread their scale shards the same way and reuse the
+        # kernel's k_scale/v_scale variant.
         from jax.sharding import PartitionSpec as P
 
-        def body(qs, ks, vs, kc, vc, bts, pos_s, sls):
+        interp = jax.default_backend() != "tpu"
+
+        def body(qs, ks, vs, kc, vc, bts, pos_s, sls, *scales):
             b_loc, t_loc = qs.shape[0], qs.shape[1]
             s_local = kc.shape[0]
             tp_sz = axis_size("tp")
@@ -193,36 +206,74 @@ def _attention_block(
             offset = flat * s_local
             wslots = kvc.slots_for_positions(bts, pos_s, block_size)
             wslots = wslots.reshape(b_loc * t_loc) - offset
-            kc, vc = kvc.write_kv(kc, vc, wslots,
-                                  ks.reshape(b_loc * t_loc, cfg.kv_size),
-                                  vs.reshape(b_loc * t_loc, cfg.kv_size))
-            Pw = bts.shape[1]
-            C = Pw * block_size
-            ctx_pos = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32),
-                                       (b_loc, C))
-            cslots = kvc.slots_for_positions(bts, ctx_pos, block_size)
-            cslots = jnp.clip(cslots - offset, 0, s_local - 1)
-            k_ctx, v_ctx = kvc.gather_kv(kc, vc, cslots, cfg.num_kv_heads)
-            o = paged_attention(qs, k_ctx, v_ctx, pos_s, ctx_pos, sls,
-                                scale=cfg.query_scale,
-                                soft_cap=cfg.attn_soft_cap)
+            kr = ks.reshape(b_loc * t_loc, cfg.kv_size)
+            vr = vs.reshape(b_loc * t_loc, cfg.kv_size)
+            if scales:
+                ksc, vsc = scales
+                kc, vc, ksc, vsc = kvc.write_kv_quant(
+                    kc, vc, ksc, vsc, wslots, kr, vr)
+            else:
+                kc, vc = kvc.write_kv(kc, vc, wslots, kr, vr)
+                ksc = vsc = None
+            if dp_local_pallas:
+                from dynamo_tpu.ops.pallas import paged_decode_attention
+
+                pages_local = s_local // block_size
+                bt_local = jnp.clip(bts - flat * pages_local,
+                                    0, pages_local - 1)
+                o = paged_decode_attention(
+                    qs[:, 0], kc, vc, bt_local, sls,
+                    block_size=block_size, scale=cfg.query_scale,
+                    soft_cap=cfg.attn_soft_cap, interpret=interp,
+                    k_scale=ksc, v_scale=vsc)[:, None]
+            else:
+                Pw = bts.shape[1]
+                C = Pw * block_size
+                ctx_pos = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32),
+                                           (b_loc, C))
+                cslots = kvc.slots_for_positions(bts, ctx_pos, block_size)
+                cslots = jnp.clip(cslots - offset, 0, s_local - 1)
+                if scales:
+                    k_ctx, v_ctx = kvc.gather_kv_quant(
+                        kc, vc, ksc, vsc, cslots, cfg.num_kv_heads,
+                        out_dtype=qs.dtype)
+                else:
+                    k_ctx, v_ctx = kvc.gather_kv(kc, vc, cslots,
+                                                 cfg.num_kv_heads)
+                o = paged_attention(qs, k_ctx, v_ctx, pos_s, ctx_pos, sls,
+                                    scale=cfg.query_scale,
+                                    soft_cap=cfg.attn_soft_cap)
+            if scales:
+                return o, kc, vc, ksc, vsc
             return o, kc, vc
 
         row = P(("dp", "tp"))
-        out, k_layer, v_layer = shard_map(
+        slot = P(("dp", "tp"), None)
+        in_specs = [P(("dp", "tp"), None, None, None),
+                    P(("dp", "tp"), None, None, None),
+                    P(("dp", "tp"), None, None, None),
+                    slot, slot, slot, P(("dp", "tp"), None), row]
+        out_specs = [P(("dp", "tp"), None, None, None), slot, slot]
+        args = [q, k, v, k_cache, v_cache, block_tables, positions,
+                seq_lens]
+        if quant:
+            in_specs += [slot, slot]
+            out_specs += [slot, slot]
+            args += [k_scale_cache, v_scale_cache]
+        res = shard_map(
             body,
             mesh=dp_local_mesh,
-            in_specs=(P(("dp", "tp"), None, None, None),
-                      P(("dp", "tp"), None, None, None),
-                      P(("dp", "tp"), None, None, None),
-                      P(("dp", "tp"), None), P(("dp", "tp"), None),
-                      P(("dp", "tp"), None), P(("dp", "tp"), None), row),
-            out_specs=(P(("dp", "tp"), None, None, None),
-                       P(("dp", "tp"), None), P(("dp", "tp"), None)),
+            in_specs=tuple(in_specs),
+            out_specs=tuple(out_specs),
             check_vma=False,
-        )(q, k, v, k_cache, v_cache, block_tables, positions, seq_lens)
+        )(*args)
+        if quant:
+            out, k_layer, v_layer, ks_layer, vs_layer = res
+        else:
+            out, k_layer, v_layer = res
+            ks_layer = vs_layer = None
         out = out.reshape(B, T, cfg.q_size) @ p_attn["wo"]
-        return out, k_layer, v_layer, None, None
+        return out, k_layer, v_layer, ks_layer, vs_layer
 
     if quant:
         k_layer, v_layer, ks_layer, vs_layer = kvc.write_kv_quant(
@@ -274,20 +325,40 @@ def _attention_block(
             # Sharded serving: GSPMD can't partition a custom call, so
             # the kernel runs under shard_map — heads over tp (each shard
             # sees its [S, F/tp] cache slice, a self-consistent smaller
-            # GQA geometry), batch over dp.
+            # GQA geometry), batch over dp.  Quantized caches shard the
+            # [S, Hkv] scale buffers over the SAME head axis (tp | Hkv),
+            # so each shard dequantizes its own heads with local scales
+            # — the kernel's existing k_scale/v_scale variant, per shard.
             from jax.sharding import PartitionSpec as P
 
-            out = shard_map(
-                lambda qs, ks, vs, bts, sls: paged_decode_attention(
-                    qs, ks, vs, bts, sls, block_size=block_size,
-                    scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap,
-                    interpret=interp),
-                mesh=pallas_mesh,
-                in_specs=(P("dp", "tp", None), P(None, "tp"), P(None, "tp"),
-                          P("dp", None), P("dp")),
-                out_specs=P("dp", "tp", None),
-                check_vma=False,
-            )(q[:, 0], k_layer, v_layer, block_tables, seq_lens)[:, None]
+            head = P(None, "tp")
+            if quant:
+                out = shard_map(
+                    lambda qs, ks, vs, ksc, vsc, bts, sls:
+                        paged_decode_attention(
+                            qs, ks, vs, bts, sls, block_size=block_size,
+                            scale=cfg.query_scale,
+                            soft_cap=cfg.attn_soft_cap,
+                            interpret=interp, k_scale=ksc, v_scale=vsc),
+                    mesh=pallas_mesh,
+                    in_specs=(P("dp", "tp", None), head, head, head, head,
+                              P("dp", None), P("dp")),
+                    out_specs=P("dp", "tp", None),
+                    check_vma=False,
+                )(q[:, 0], k_layer, v_layer, ks_layer, vs_layer,
+                  block_tables, seq_lens)[:, None]
+            else:
+                out = shard_map(
+                    lambda qs, ks, vs, bts, sls: paged_decode_attention(
+                        qs, ks, vs, bts, sls, block_size=block_size,
+                        scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap,
+                        interpret=interp),
+                    mesh=pallas_mesh,
+                    in_specs=(P("dp", "tp", None), head, head,
+                              P("dp", None), P("dp")),
+                    out_specs=P("dp", "tp", None),
+                    check_vma=False,
+                )(q[:, 0], k_layer, v_layer, block_tables, seq_lens)[:, None]
         else:
             out = paged_decode_attention(
                 q[:, 0], k_layer, v_layer, block_tables, seq_lens,
@@ -546,10 +617,15 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                 block_tables, block_size,
                 k_layers[i], v_layers[i],
                 sp_mesh=mesh if (sp_ring and T > 1) else None,
+                # dp_local owns its own shard_map body; pallas routing
+                # there happens INSIDE it (local slot rebase), not via
+                # the head-sharded pallas_mesh wrapper.
                 pallas_mesh=(mesh if (use_pallas_decode and T == 1
-                                      and mesh is not None) else None),
+                                      and mesh is not None
+                                      and not dp_local) else None),
                 dp_local_mesh=(mesh if (dp_local and T == 1
                                         and mesh is not None) else None),
+                dp_local_pallas=use_pallas_decode and dp_local,
                 k_scale_cache=ks_layers[i], v_scale_cache=vs_layers[i],
             )
             if cfg.post_norms:

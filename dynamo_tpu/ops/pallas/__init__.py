@@ -7,6 +7,9 @@ budget goes where it pays: paged-attention decode, which would otherwise
 materialise a full gathered context per step.
 """
 
-from dynamo_tpu.ops.pallas.paged_attention import paged_decode_attention
+from dynamo_tpu.ops.pallas.paged_attention import (
+    mosaic_geometry_ok,
+    paged_decode_attention,
+)
 
-__all__ = ["paged_decode_attention"]
+__all__ = ["paged_decode_attention", "mosaic_geometry_ok"]

@@ -69,6 +69,19 @@ _TARGET_TILE_INT8 = 512
 _SCRATCH_BUDGET = 4 * 1024 * 1024
 
 
+def mosaic_geometry_ok(feat: int, block_size: int) -> bool:
+    """THE Mosaic DMA-tiling eligibility rule for this kernel: the cache
+    view's lane (feature) dim must be 128-aligned and the sublane
+    (block) dim 8-aligned, or compilation dies deep in the DMA lowering.
+    One predicate shared by every auto-selection site (engine auto rule,
+    profile_decode, bench/sharded_decode) so the served engine, the
+    profiler and the gated bench can never silently diverge on which
+    attention path a geometry runs.  `feat` is the PER-SHARD feature
+    width (F/tp under head-sharded tensor parallelism, full F under
+    dp_attention's slot sharding)."""
+    return feat % 128 == 0 and block_size % 8 == 0
+
+
 def auto_pair(block_size: int, feat: int, itemsize: int = 2,
               target: Optional[int] = None) -> int:
     """Pages per DMA tile for a (block_size, feature-width) geometry:
@@ -284,7 +297,7 @@ def paged_decode_attention(
             f"scales imply an int8 cache; got {k_cache.dtype}")
     if Fc % D or Hq % Hkv:
         raise ValueError(f"bad geometry: q {q.shape}, cache {k_cache.shape}")
-    if not interpret and (Fc % 128 or block_size % 8):
+    if not interpret and not mosaic_geometry_ok(Fc, block_size):
         # Mosaic DMA tiling: the cache's lane dim must be 128-aligned and
         # the sublane (block) dim 8-aligned, or compilation dies deep in
         # the DMA lowering.  Callers (engine auto-selection) should fall

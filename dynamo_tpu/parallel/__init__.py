@@ -19,6 +19,7 @@ from dynamo_tpu.parallel.pipeline import make_pp_step
 from dynamo_tpu.parallel.sharding import (
     cache_pspecs,
     data_pspecs,
+    make_sharded_greedy_step,
     make_sharded_step,
     make_sp_prefill_step,
     param_pspecs,
@@ -33,6 +34,7 @@ __all__ = [
     "data_pspecs",
     "shard_pytree",
     "make_sharded_step",
+    "make_sharded_greedy_step",
     "make_sp_prefill_step",
     "make_pp_step",
 ]

@@ -98,7 +98,7 @@ def param_pspecs(cfg: ModelConfig, moe_mode: str = "dense",
 
 
 def cache_pspecs(num_layers: int, dp_attention: bool = False,
-                 dp_local: bool = False) -> Dict:
+                 dp_local: bool = False, kv_quant: bool = False) -> Dict:
     """KV cache: per-layer [slots, F = kv_heads * head_dim] buffers; the
     flat feature axis shards over tp, which IS head sharding (F is
     head-major and validate() enforces tp | num_kv_heads).
@@ -116,14 +116,30 @@ def cache_pspecs(num_layers: int, dp_attention: bool = False,
     device grid and the engine's locality-aware allocator guarantees a
     row's pages live on that row's device — decode attention then runs
     fully device-local under shard_map (llama._attention_block dp-local
-    branch), no cross-chip gathers per step (VERDICT r3 weak #4)."""
+    branch), no cross-chip gathers per step (VERDICT r3 weak #4).
+
+    `kv_quant` (ISSUE 9): the int8 cache's sibling per-layer [S, Hkv] f32
+    scale buffers SHARD WITH THEIR KV HEADS — head-sharded tp splits the
+    Hkv axis exactly as the F axis splits (F is head-major and tp | Hkv),
+    so every shard dequantizes its own heads with locally-resident
+    scales; slot-sharded modes (dp_attention / dp_local) shard the scale
+    slot axis like the page slot axis.  Scales are never replicated:
+    a replicated [S, Hkv] f32 buffer would cost more HBM per chip than
+    the int8 quantization saves at small head_dim."""
     if dp_local:
         spec = P(("dp", "tp"), None)
+        sspec = P(("dp", "tp"), None)
     elif dp_attention:
         spec = P("tp", None)
+        sspec = P("tp", None)
     else:
         spec = P(None, "tp")
-    return {"k": [spec] * num_layers, "v": [spec] * num_layers}
+        sspec = P(None, "tp")   # Hkv axis: scales ride their heads
+    out = {"k": [spec] * num_layers, "v": [spec] * num_layers}
+    if kv_quant:
+        out["k_scale"] = [sspec] * num_layers
+        out["v_scale"] = [sspec] * num_layers
+    return out
 
 
 def data_pspecs() -> Dict:
@@ -251,12 +267,29 @@ def resolve_moe_mode(cfg: ModelConfig, mesh: Mesh,
     return moe_mode
 
 
+def _reject_pallas_dp_attention(use_pallas_decode: bool,
+                                dp_attention: bool, dp_local: bool) -> None:
+    """Pallas decode composes with head-sharded tp (heads over tp inside
+    shard_map) and with dp_attention LOCALITY (slots rebase to the shard's
+    local range inside the body — ISSUE 9 leg 2).  Plain dp_attention
+    without locality is the one remaining exclusion: pages may live on
+    any shard, and the kernel's slot indexing cannot cross chips."""
+    if use_pallas_decode and dp_attention and not dp_local:
+        raise ValueError(
+            "pallas decode under dp_attention needs page locality "
+            "(dp_attention_local=True): without it a row's pages may "
+            "live on any shard and the kernel's slot indexing cannot "
+            "cross chips — set dp_attention_local (plain allocator) or "
+            "drop use_pallas_decode for the gather path")
+
+
 def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
                         window: int,
                         greedy_only: bool = False,
                         use_pallas_decode: bool = False,
                         dp_attention: bool = False,
-                        dp_local: bool = False):
+                        dp_local: bool = False,
+                        kv_quant: bool = False):
     """Jit the fused K-token decode window under a mesh — the fast decode
     path for SERVED sharded models (VERDICT r3 weak #3: without this, a
     tp=8 70B decode would fall back to the per-token host loop over a
@@ -265,18 +298,19 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
     aux threads through the fori_loop carry since r5).
 
     `use_pallas_decode` routes attention through the Pallas kernel inside
-    a shard_map over (dp, tp) — requires head-sharded KV (not
-    dp_attention, whose slot-sharded cache breaks the kernel's global
-    slot indexing).
+    a shard_map over (dp, tp) — heads over tp, or shard-local slots under
+    dp_attention locality (see _reject_pallas_dp_attention).
+
+    `kv_quant`: the cache pytree carries int8 pages + [S, Hkv] f32 scale
+    buffers (cache_pspecs kv_quant=True) and the attention bodies
+    dequantize shard-locally.
     """
     from dynamo_tpu.models.llama import make_decode_window
     from dynamo_tpu.parallel.multihost import mesh_spans_processes
 
     validate(cfg, mesh, dp_attention)
     mh = mesh_spans_processes(mesh)
-    if use_pallas_decode and dp_attention:
-        raise ValueError("pallas decode needs head-sharded KV; "
-                         "dp_attention slot-shards it")
+    _reject_pallas_dp_attention(use_pallas_decode, dp_attention, dp_local)
     # MoE windows (r5): the expert-load telemetry threads through the
     # fori_loop carry; the window uses the same resolved moe mode as the
     # engine's single step.
@@ -295,7 +329,8 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
                      param_pspecs(cfg, moe_mode,
                                   dp_attention=dp_attention)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
+                                  kv_quant)),
         b,                                         # last_tokens [B]
         b,                                         # positions0 [B]
         b,                                         # seq_lens0 [B]
@@ -308,7 +343,8 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
     )
     out_shardings = [
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
+                                  kv_quant)),
         # Tokens are the one host-read output: multihost replicates them
         # so the fetch thread can read locally (collectives are illegal
         # off the lockstep thread).
@@ -326,7 +362,8 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
 
 def make_sharded_embed_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
                             dp_attention: bool = False,
-                            dp_local: bool = False):
+                            dp_local: bool = False,
+                            kv_quant: bool = False):
     """Jit the return_hidden step under a mesh (the /v1/embeddings path on
     a sharded engine — r3 raised NotImplementedError here)."""
     from dynamo_tpu.models.llama import make_forward_step
@@ -342,13 +379,15 @@ def make_sharded_embed_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      param_pspecs(cfg, moe_mode, dp_attention)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
+                                  kv_quant)),
         b2, b2, b, b2, b,
     )
     out_shardings = (
         b2,                                        # hidden [B, H]
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
+                                  kv_quant)),
     )
     return _finalize(jax.jit(step, in_shardings=in_shardings,
                              out_shardings=out_shardings,
@@ -357,7 +396,8 @@ def make_sharded_embed_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
 
 def make_sharded_mm_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
                          dp_attention: bool = False,
-                         dp_local: bool = False):
+                         dp_local: bool = False,
+                         kv_quant: bool = False):
     """Jit the multimodal prefill variant under a mesh: positions whose
     mask is set take the provided [B, T, H] embeddings instead of the
     token lookup (llm/multimodal.py; lifts VERDICT r4's sharded-engine
@@ -381,7 +421,8 @@ def make_sharded_mm_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      param_pspecs(cfg, moe_mode, dp_attention)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
+                                  kv_quant)),
         b2,                                        # tokens [B, T]
         b2,                                        # positions [B, T]
         b,                                         # seq_lens [B]
@@ -393,7 +434,8 @@ def make_sharded_mm_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
     out_shardings = (
         NamedSharding(mesh, P(None, None) if mh else P(batch_axes, None)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
+                                  kv_quant)),
     )
     return _finalize(jax.jit(step, in_shardings=in_shardings,
                              out_shardings=out_shardings,
@@ -405,7 +447,8 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
                       with_expert_load: bool = False,
                       dp_attention: bool = False,
                       use_pallas_decode: bool = False,
-                      dp_local: bool = False):
+                      dp_local: bool = False,
+                      kv_quant: bool = False):
     """Jit the unified engine step with explicit in/out shardings.
 
     Returns `step(params, cache, tokens, positions, seq_lens, block_tables)`
@@ -416,13 +459,14 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
     `dp_attention`: batch shards over (dp, tp) and the KV cache's slot
     axis over tp — see param_pspecs/cache_pspecs.  Batch must be a
     multiple of dp×tp.
+
+    `kv_quant`: int8 cache pytree with sharded scale buffers
+    (cache_pspecs kv_quant=True; ISSUE 9 leg 1).
     """
     from dynamo_tpu.models.llama import make_forward_step
 
     validate(cfg, mesh, dp_attention)
-    if use_pallas_decode and dp_attention:
-        raise ValueError("pallas decode needs head-sharded KV; "
-                         "dp_attention slot-shards it")
+    _reject_pallas_dp_attention(use_pallas_decode, dp_attention, dp_local)
     if dp_local and not dp_attention:
         raise ValueError("dp_local implies dp_attention")
     moe_mode = resolve_moe_mode(cfg, mesh, moe_mode)
@@ -451,7 +495,8 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         jax.tree.map(lambda s: NamedSharding(mesh, s),
                      param_pspecs(cfg, moe_mode, dp_attention)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
+                                  kv_quant)),
         NamedSharding(mesh, P(batch_axes, None)),  # tokens
         NamedSharding(mesh, P(batch_axes, None)),  # positions
         NamedSharding(mesh, P(batch_axes)),        # seq_lens
@@ -464,7 +509,8 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         NamedSharding(mesh,
                       P(None, None) if mh else P(batch_axes, None)),
         jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local)),
+                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
+                                  kv_quant)),
     ]
     if with_expert_load:
         out_shardings.append(NamedSharding(mesh, P(None)))
@@ -474,3 +520,77 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         out_shardings=tuple(out_shardings),
         donate_argnums=(1,),
     ), in_shardings, mesh)
+
+
+def make_sharded_greedy_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
+                             moe_mode: str = "auto",
+                             with_expert_load: bool = False,
+                             dp_attention: bool = False,
+                             use_pallas_decode: bool = False,
+                             dp_local: bool = False,
+                             kv_quant: bool = False):
+    """Jit the FUSED greedy single step under a mesh: forward + on-device
+    argmax compile into ONE program with a donated cache, returning [B]
+    int32 tokens instead of [B, V] logits (ISSUE 9 leg 3 — the sharded
+    half of the r5 single-step cliff).  The unfused sharded path was a
+    step dispatch + row gather + argmax, three eager dispatches plus a
+    full-vocab f32 logits output per token; on a tunneled chip the extra
+    dispatches dominate the step.  Same fusion as the meshless
+    `EngineCore._greedy_step_fn`; multihost stays on the plain path (the
+    lockstep command stream replays the unfused step).
+
+    Returns `fused(params, cache, tokens, positions, seq_lens,
+    block_tables, sample_positions)` → (tokens[B], cache[, expert_load]).
+    """
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import make_forward_step
+
+    validate(cfg, mesh, dp_attention)
+    _reject_pallas_dp_attention(use_pallas_decode, dp_attention, dp_local)
+    if dp_local and not dp_attention:
+        raise ValueError("dp_local implies dp_attention")
+    moe_mode = resolve_moe_mode(cfg, mesh, moe_mode)
+    inner = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
+                              with_expert_load=with_expert_load,
+                              use_pallas_decode=use_pallas_decode,
+                              dp_local=dp_local)
+    div = (mesh.shape["dp"] * mesh.shape["tp"]) if dp_attention else 1
+
+    def fused(params, cache, tokens, positions, seq_lens, block_tables,
+              sample_positions):
+        if tokens.shape[0] % div:
+            # Same trace-time check as make_sharded_step: a clear error
+            # instead of opaque GSPMD padding (the fused path must not
+            # hide a misconfiguration the unfused path surfaces).
+            raise ValueError(
+                f"dp_attention: batch {tokens.shape[0]} must be a "
+                f"multiple of dp*tp = {div}")
+        out = inner(params, cache, tokens, positions, seq_lens,
+                    block_tables, sample_positions)
+        if with_expert_load:
+            logits, cache, load = out
+            return (jnp.argmax(logits, -1).astype(jnp.int32), cache, load)
+        logits, cache = out
+        return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+    batch_axes = ("dp", "tp") if dp_attention else "dp"
+    cache_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        cache_pspecs(cfg.num_layers, dp_attention, dp_local, kv_quant))
+    in_shardings = (
+        jax.tree.map(lambda s: NamedSharding(mesh, s),
+                     param_pspecs(cfg, moe_mode, dp_attention)),
+        cache_sh,
+        NamedSharding(mesh, P(batch_axes, None)),  # tokens [B, 1]
+        NamedSharding(mesh, P(batch_axes, None)),  # positions [B, 1]
+        NamedSharding(mesh, P(batch_axes)),        # seq_lens [B]
+        NamedSharding(mesh, P(batch_axes, None)),  # block_tables [B, P]
+        NamedSharding(mesh, P(batch_axes)),        # sample_positions [B]
+    )
+    out_shardings = [NamedSharding(mesh, P(batch_axes)),  # tokens [B]
+                     cache_sh]
+    if with_expert_load:
+        out_shardings.append(NamedSharding(mesh, P(None)))
+    return jax.jit(fused, in_shardings=in_shardings,
+                   out_shardings=tuple(out_shardings), donate_argnums=(1,))

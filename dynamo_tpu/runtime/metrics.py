@@ -248,7 +248,13 @@ class EngineStepCounters:
       `effective_bytes_per_token`, is the decode-bandwidth-wall series
       (ISSUE 6): int8 KV roughly halves the numerator, speculative
       decoding grows the denominator per sweep — both show up here
-      without a TPU in the loop.
+      without a TPU in the loop.  Under a mesh the bytes are PER CHIP
+      (the engine divides by its `kv_traffic_shards` = dp*tp on non-pp
+      meshes, pp on pipelines — ISSUE 9): a tp2 engine sweeps half the
+      cache bytes per chip, a dp2 engine half the ROWS per chip, and the
+      per-chip mbu derived from this series must say so.  (Residency
+      gauges divide by the distinct `kv_shard_count` — plain dp
+      replicates storage while halving traffic.)
     """
 
     def __init__(self) -> None:
@@ -472,11 +478,13 @@ class KvCacheMetrics:
         # emitted token, and the speculative-decoding accept telemetry.
         self.kv_bytes_per_block = registry.gauge(
             "kv_bytes_per_block",
-            "True bytes of one KV block across layers, including "
-            "quantization scales in int8 mode")
+            "True PER-CHIP bytes of one KV block across layers, "
+            "including quantization scales in int8 mode and divided by "
+            "the mesh's KV shard count on sharded pools")
         self.kv_effective_bytes_per_token = registry.gauge(
             "kv_effective_bytes_per_token",
-            "Modeled decode-attention HBM bytes per emitted token")
+            "Modeled decode-attention HBM bytes per emitted token, "
+            "per chip under meshes")
         self.spec_drafted = registry.counter(
             "spec_decode_drafted_tokens_total",
             "Draft tokens proposed to the batched verify step")
@@ -558,8 +566,13 @@ class KvCacheMetrics:
                          getattr(sched, "prefix_miss_tokens", 0))
         cache_cfg = getattr(core, "cache_cfg", None)
         if cache_cfg is not None:
+            # Per-CHIP bytes: a tp/dp-sharded pool splits every block
+            # over kv_shard_count chips, and the HBM-residency math the
+            # planner does against dynamo_hbm_* would double-count a
+            # whole-block figure (ISSUE 9 satellite).
+            shards = getattr(core, "kv_shard_count", 1)
             self.kv_bytes_per_block.set(
-                cache_cfg.bytes_per_block,
+                cache_cfg.bytes_per_block / max(shards, 1),
                 labels={"kv_quant": cache_cfg.kv_quant})
         counters = getattr(core, "counters", None)
         if counters is not None:

@@ -88,6 +88,17 @@ def parse_args(argv=None):
                    help="engine-internal data-parallel degree (batch axis)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel degree (MoE models)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel degree: whole-prompt prefills "
+                        "past the engine's threshold run ring attention "
+                        "over the ICI ring (the long-context prefill "
+                        "path); decode stays on the tp/dp plane")
+    p.add_argument("--pp", type=int, default=1,
+                   help="pipeline-parallel degree (GPipe stage-rotated "
+                        "step).  Excludes spec decode, multimodal "
+                        "embeds, /v1/embeddings and --kv-quant — the "
+                        "engine rejects those combos with pointed "
+                        "errors")
     p.add_argument("--dp-attention", action="store_true",
                    help="batch-sharded attention with slot-sharded KV "
                         "(tp beyond the kv-head count; reference sglang "
@@ -161,23 +172,29 @@ def parse_args(argv=None):
 
 
 def build_mesh(args):
-    """Mesh from the parallelism flags; under multihost the degrees MUST
-    span every process's chips — a prefix-sliced mesh that happens to fit
+    """Mesh from the parallelism flags (tp/dp/ep/sp/pp — MeshConfig's
+    full axis set; ISSUE 9 satellite: sp-ring prefill and pp pipelines
+    were dry-run-proven but unreachable from a real worker because only
+    tp/dp/ep were read here).  Under multihost the degrees MUST span
+    every process's chips — a prefix-sliced mesh that happens to fit
     one rank's devices would leave follower ranks shadowing computations
     on devices they can't address (and the lockstep channel pure
     overhead)."""
-    if args.tp * args.dp * args.ep <= 1:
+    sp = getattr(args, "sp", 1)
+    pp = getattr(args, "pp", 1)
+    if args.tp * args.dp * args.ep * sp * pp <= 1:
         if args.num_processes > 1:
             raise SystemExit(
                 "--num-processes > 1 needs parallelism degrees that span "
-                "the cluster (tp*dp*ep > 1); a meshless engine is "
+                "the cluster (tp*dp*ep*sp*pp > 1); a meshless engine is "
                 "process-local by construction")
         return None
     import jax
 
     from dynamo_tpu.parallel import MeshConfig, make_mesh
 
-    mesh_cfg = MeshConfig(dp=args.dp, ep=args.ep, tp=args.tp)
+    mesh_cfg = MeshConfig(dp=args.dp, pp=pp, sp=sp, ep=args.ep,
+                          tp=args.tp)
     devices = jax.devices()
     if mesh_cfg.size > len(devices):
         raise SystemExit(

@@ -190,6 +190,8 @@ def test_bench_gate_smoke_cli():
     assert out["invalid_run_fails"] is True
     assert out["low_mbu_fails"] is True
     assert out["interference_fails"] is True
+    assert out["sharded_floor_fails"] is True
+    assert out["sharded_decode_section_ok"] is True
 
 
 def test_gate_tpu_floors():
@@ -207,6 +209,20 @@ def test_gate_tpu_floors():
     interfered = dict(tpu, mixed_prefill_decode={"interference_ratio": 0.7})
     res = gate.compare(interfered, tpu)
     assert not res.ok and res.floor_failures
+
+    # ISSUE 9: a sharded engine whose per-chip throughput collapsed vs
+    # meshless fails the floor; a single-chip round (no ratio) skips it.
+    slow_sharded = dict(tpu, sharded_decode={"tok_s_per_chip_ratio": 0.5})
+    res = gate.compare(slow_sharded, slow_sharded)
+    assert not res.ok and any(
+        f["metric"] == "sharded_decode.tok_s_per_chip_ratio"
+        for f in res.floor_failures)
+    ok_sharded = dict(tpu, sharded_decode={"tok_s_per_chip_ratio": 0.91})
+    assert gate.compare(ok_sharded, ok_sharded).ok
+    single_chip = dict(tpu, sharded_decode={"tp2": {"skipped": "1 chip"}})
+    res = gate.compare(single_chip, single_chip)
+    assert res.ok
+    assert "floor:sharded_decode.tok_s_per_chip_ratio" in res.skipped
 
     # CPU artifacts carry no roofline: floors are skipped, not failed.
     cpu = dict(GOOD, device="TFRT_CPU_0", mbu=0.01)

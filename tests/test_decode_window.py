@@ -239,7 +239,11 @@ def test_profile_decode_emits_phase_breakdown_json():
          "--block", "8", "--width", "4", "--window", "2",
          "--no-probes", "--json"],
         capture_output=True, text=True, timeout=280,
-        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo)
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 JAX_COMPILATION_CACHE_DIR=os.environ.get(
+                     "JAX_COMPILATION_CACHE_DIR",
+                     "/tmp/dynamo_tpu_test_xla_cache")),
+        cwd=repo)
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     phases = out["phases"]
@@ -249,6 +253,47 @@ def test_profile_decode_emits_phase_breakdown_json():
         assert key in phases, key
     assert phases["window_ms_per_tok"] > 0
     assert phases["scheduler_ms"] > 0
+
+
+def test_profile_decode_tp_emits_sharded_phases():
+    """ISSUE 9 satellite: `--tp 2` profiles the SHARDED decode phases on
+    a CPU host (virtual devices forced pre-jax-init), so the sharded gap
+    is attributable per phase; kernel_ms reflects the per-shard
+    geometry.  (`--kv-quant int8 --tp` composition is covered by the
+    engine-level sharded int8 tests and the bench_gate smoke — one
+    fewer sharded-window compile keeps this inside the tier-1 budget.)"""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "profile_decode.py"),
+         "--model", "tiny-test", "--batch", "2", "--ctx", "16",
+         "--block", "8", "--width", "4", "--window", "2", "--tp", "2",
+         "--no-probes", "--json"],
+        capture_output=True, text=True, timeout=280,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 JAX_COMPILATION_CACHE_DIR=os.environ.get(
+                     "JAX_COMPILATION_CACHE_DIR",
+                     "/tmp/dynamo_tpu_test_xla_cache")),
+        cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["tp"] == 2
+    phases = out["phases"]
+    assert phases["window_ms_per_tok"] > 0
+    assert phases["kernel_ms"] > 0
+    # Modeled bytes are PER CHIP under --tp (the measured times are
+    # per-chip sharded times — whole-model bytes would inflate derived
+    # mbu by tp).
+    from dynamo_tpu.bench.decode_wall import kv_quant_traffic
+    from dynamo_tpu.models import config as mcfg
+
+    full = kv_quant_traffic(mcfg.get_config("tiny-test"),
+                            block_size=8, batch=2, ctx=16)
+    assert out["kv_bytes_per_step"] == full["kv_bytes_per_step_bf16"] // 2
 
 
 def test_counters_expose_dict():

@@ -222,10 +222,33 @@ def test_int8_engine_counts_fewer_effective_bytes():
                / (TINY.num_kv_heads * TINY.head_dim * 4)) < 1e-6
 
 
-def test_kv_quant_rejects_mesh():
-    with pytest.raises(ValueError, match="meshless"):
+def test_kv_quant_mesh_composition_gating():
+    """ISSUE 9: int8 composes with single-process tp/dp meshes (scales
+    shard with their kv heads — construction succeeds and the sharded
+    cache pytree carries sharded scale buffers); the still-unsupported
+    combos (pp stacked layout, ring-SP prefill) reject with pointed
+    errors instead of the old blanket meshless-only rule."""
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    tp2 = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    core = EngineCore(EngineConfig(
+        model=TINY, num_blocks=64, mesh=tp2, kv_quant="int8",
+        enable_prefix_cache=False,
+        scheduler=SchedulerConfig(
+            max_seqs=8, block_size=BS, max_pages_per_seq=8,
+            max_prefill_chunk=16,
+            decode_buckets=(1, 2, 4, 8), prefill_buckets=(8, 16))))
+    assert kvc.cache_is_quantized(core.cache)
+    assert core.kv_shard_count == 2
+
+    pp2 = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    with pytest.raises(ValueError, match="pipeline"):
         EngineCore(EngineConfig(model=TINY, num_blocks=64,
-                                kv_quant="int8", mesh=object()))
+                                kv_quant="int8", mesh=pp2))
+    sp2 = make_mesh(MeshConfig(sp=2), jax.devices()[:2])
+    with pytest.raises(ValueError, match="ring"):
+        EngineCore(EngineConfig(model=TINY, num_blocks=64,
+                                kv_quant="int8", mesh=sp2))
     with pytest.raises(ValueError, match="kv_quant"):
         kvc.KvCacheConfig(num_blocks=4, block_size=8, num_layers=1,
                           num_kv_heads=2, head_dim=16, kv_quant="fp8")

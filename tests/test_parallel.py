@@ -76,6 +76,27 @@ def test_sharded_step_matches_unsharded(cfg_name, mesh_cfg):
             == cache_pspecs(cfg.num_layers)["k"][0])
 
 
+def test_worker_build_mesh_reads_sp_and_pp():
+    """ISSUE 9 satellite: `--sp`/`--pp` are reachable from a real worker
+    — build_mesh folds them into the MeshConfig instead of silently
+    serving meshless while the operator believes the ring/pipeline paths
+    are on."""
+    from dynamo_tpu.worker.main import build_mesh, parse_args
+
+    args = parse_args(["--control-plane", "127.0.0.1:1",
+                       "--sp", "2", "--tp", "2"])
+    mesh = build_mesh(args)
+    assert dict(mesh.shape)["sp"] == 2 and dict(mesh.shape)["tp"] == 2
+
+    args = parse_args(["--control-plane", "127.0.0.1:1", "--pp", "2"])
+    mesh = build_mesh(args)
+    assert dict(mesh.shape)["pp"] == 2
+
+    # Meshless stays meshless: no axis asked for.
+    args = parse_args(["--control-plane", "127.0.0.1:1"])
+    assert build_mesh(args) is None
+
+
 def test_mesh_validation():
     from dynamo_tpu.parallel.sharding import validate
 

@@ -23,13 +23,16 @@ SCHED = dict(max_seqs=4, block_size=8, max_pages_per_seq=8,
 
 
 def _run_engine(mesh=None, decode_window=1, spec=0, dp_attention=False,
-                use_pallas=None, n_tokens=12):
+                use_pallas=None, n_tokens=12, kv_quant="none",
+                dp_local=None):
     core = EngineCore(EngineConfig(
         model=mcfg.get_config("tiny-test"), num_blocks=64,
         mesh=mesh, dp_attention=dp_attention,
+        dp_attention_local=dp_local,
         decode_window=decode_window, window_pipeline_depth=2,
         speculative_tokens=spec,
         use_pallas_decode=use_pallas,
+        kv_quant=kv_quant,
         enable_prefix_cache=False,
         scheduler=SchedulerConfig(**SCHED)))
     core.add_request("a", [5, 6, 7, 8, 9, 10, 5, 6, 7, 8],
@@ -112,6 +115,141 @@ def test_pp_engine_serving(oracle):
     mesh = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
     got = _run_engine(mesh=mesh)
     assert got == oracle
+
+
+def test_sharded_int8_matches_unsharded(oracle):
+    """ISSUE 9 leg 1: the quantized KV plane composes with head-sharded
+    tp — scales shard with their kv heads — and greedy output stays
+    token-identical to the meshless bf16 oracle on BOTH sharded decode
+    paths (fused window and the fused greedy single step, which also
+    covers leg 3's make_sharded_greedy_step with an int8 cache)."""
+    mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    assert _run_engine(mesh=mesh, decode_window=4,
+                       kv_quant="int8") == oracle
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64,
+        mesh=mesh, kv_quant="int8", decode_window=1,
+        enable_prefix_cache=False,
+        scheduler=SchedulerConfig(**SCHED)))
+    core.add_request("a", [5, 6, 7, 8, 9, 10, 5, 6, 7, 8],
+                     SamplingParams(max_tokens=12))
+    core.add_request("b", list(range(20, 34)),
+                     SamplingParams(max_tokens=12))
+    outputs = {}
+    for _ in range(300):
+        for d in core.step():
+            outputs.setdefault(d.request_id, []).extend(d.token_ids)
+        if not core._requests:
+            break
+    assert outputs == oracle
+    assert core._greedy_fused is not None, \
+        "sharded int8 single-step decode did not take the fused path"
+
+
+def test_dp_attention_plain_int8_matches_unsharded(oracle):
+    """int8 × PLAIN dp_attention (no locality): the GSPMD slot-sharded
+    gather path with P('tp', None) scale buffers — the README matrix
+    advertises this combination, so it needs its own parity pin
+    (enable_prefix_cache=False would auto-resolve locality; force it
+    off to keep the test on the non-local path)."""
+    mesh = make_mesh(MeshConfig(tp=2, dp=2), jax.devices()[:4])
+    got = _run_engine(mesh=mesh, decode_window=4, dp_attention=True,
+                      dp_local=False, kv_quant="int8")
+    assert got == oracle
+
+
+def test_dp_local_pallas_int8_matches_unsharded(oracle):
+    """ISSUE 9 leg 2: the Pallas kernel runs SHARD-LOCALLY under
+    dp_attention locality (block tables rebase to the shard's local page
+    range inside the shard_map body) — with the int8 cache threading its
+    scale shards into the kernel's k_scale/v_scale variant."""
+    mesh = make_mesh(MeshConfig(tp=2, dp=2), jax.devices()[:4])
+    got = _run_engine(mesh=mesh, decode_window=4, dp_attention=True,
+                      use_pallas=True, kv_quant="int8")
+    assert got == oracle
+
+
+def test_sharded_fused_step_counters():
+    """The sharded fused greedy step's loop discipline (ISSUE 9 leg 3):
+    in steady single-step decode each engine iteration is ONE fused
+    dispatch with ONE host sync and zero new compiled shapes — the same
+    pin the meshless path carries in test_decode_window."""
+    mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64,
+        mesh=mesh, decode_window=1, enable_prefix_cache=False,
+        scheduler=SchedulerConfig(**SCHED)))
+    core.add_request("a", [5, 6, 7, 8, 9, 10, 5, 6, 7, 8],
+                     SamplingParams(max_tokens=30))
+    core.add_request("b", list(range(20, 34)),
+                     SamplingParams(max_tokens=30))
+    for _ in range(6):   # prefill + warm the fused program
+        core.step()
+    assert core._greedy_fused is not None
+    base = core.counters.snapshot()
+    n = 8
+    for _ in range(n):
+        core.step()
+    d = core.counters.delta(base)
+    assert d["single_step_dispatches"] == n
+    assert d["host_syncs"] == n, "fused sharded step must cost 1 sync"
+    assert d["xla_cache_misses"] == 0, "steady shape recompiled"
+
+
+def test_sharded_per_chip_modeled_bytes():
+    """Modeled-bytes honesty under meshes (ISSUE 9 satellite): a tp2
+    engine sweeps HALF the KV bytes per chip, so
+    `effective_bytes_per_token` (and the per-chip mbu derived from it)
+    must halve vs meshless; `dynamo_kv_bytes_per_block` reports per-chip
+    block bytes on sharded pools."""
+    from dynamo_tpu.runtime.metrics import KvCacheMetrics, MetricsRegistry
+
+    def run(mesh):
+        core = EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=64,
+            mesh=mesh, enable_prefix_cache=False,
+            scheduler=SchedulerConfig(**SCHED)))
+        core.add_request("a", [5, 6, 7, 8, 9, 10, 5, 6, 7, 8],
+                         SamplingParams(max_tokens=12))
+        for _ in range(300):
+            core.step()
+            if not core._requests:
+                break
+        return core
+
+    meshless = run(None)
+    tp2 = run(make_mesh(MeshConfig(tp=2), jax.devices()[:2]))
+    assert meshless.kv_shard_count == 1
+    assert tp2.kv_shard_count == 2
+    b0 = meshless.counters.effective_bytes_per_token
+    b2 = tp2.counters.effective_bytes_per_token
+    assert b2 > 0
+    assert abs(b2 / b0 - 0.5) < 1e-6
+    reg = MetricsRegistry()
+    kvm = KvCacheMetrics(reg)
+    kvm.observe_engine(tp2)
+    got = kvm.kv_bytes_per_block.value(labels={"kv_quant": "none"})
+    assert got == tp2.cache_cfg.bytes_per_block / 2
+
+
+def test_sharded_int8_wire_block_mismatch_refused():
+    """Disagg / prefix-share between sharded int8 peers keeps refusing
+    mixed-mode blocks loudly: the packed wire format is
+    sharding-independent, so a bf16 peer's block into a tp2 int8 cache
+    must be rejected BEFORE any bytes touch the cache."""
+    import numpy as np
+
+    mesh = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64,
+        mesh=mesh, kv_quant="int8", enable_prefix_cache=False,
+        scheduler=SchedulerConfig(**SCHED)))
+    cfg = core.cache_cfg
+    bf16_shape = (2, cfg.num_layers, cfg.block_size, cfg.feature_dim)
+    with pytest.raises(ValueError, match="kv_quant"):
+        core._validate_block(np.zeros(bf16_shape, np.float32))
+    # The exact packed block passes the format check.
+    core._validate_block(np.zeros(cfg.block_wire_shape, np.int8))
 
 
 def test_sharded_embeddings():

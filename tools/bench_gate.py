@@ -264,6 +264,40 @@ def decode_wall_checks() -> dict:
     }
 
 
+def sharded_decode_checks() -> dict:
+    """ISSUE 9 smoke: the sharded fast-decode plane measured on the CPU
+    mesh rig — the tp2 fused window and fused greedy single step must
+    run through the exact make_sharded_window / make_sharded_greedy_step
+    programs a served sharded engine dispatches, the section must carry
+    the gated ratio, and the gate floor must fail a fabricated
+    slow-sharded run (tok_s_per_chip_ratio below 0.8 on a TPU doc).
+
+    The CPU ratio itself is NOT gated: host-process sharding overhead
+    at tiny geometry swamps it; only presence + plumbing are asserted
+    here, the 0.8 floor binds on TPU rounds."""
+    import jax
+
+    from dynamo_tpu.bench.sharded_decode import run_sharded_decode
+    from dynamo_tpu.models import config as mcfg
+
+    out = run_sharded_decode(
+        mcfg.get_config("tiny-test"), batch=4, ctx=16, block=8, width=4,
+        window=2, modes=("tp2",), with_int8=True)
+    tp2 = out.get("tp2", {})
+    ran = "tok_s_per_chip" in tp2
+    return {
+        "sharded_decode_devices": out["devices"],
+        "sharded_decode_ran_tp2": ran,
+        "sharded_decode_ratio": out.get("tok_s_per_chip_ratio"),
+        "sharded_decode_section_ok": (
+            ran and isinstance(out.get("tok_s_per_chip_ratio"), float)
+            and out["tok_s_per_chip_ratio"] > 0
+            and tp2.get("single_step_ms", 0) > 0
+            and tp2.get("window_step_ms_int8", 0) > 0
+            and len(jax.devices()) >= 2),
+    }
+
+
 def prefix_fleet_checks() -> dict:
     """ISSUE 7 smoke: fleet-wide prefix reuse measured on CPU — the real
     router must hand out remote-prefix hints on the shared-prefix
@@ -310,8 +344,23 @@ def run_smoke(args) -> int:
        <= 0.55 at serving geometry, tiny-model greedy pin bf16 == int8,
        spec-decode acceptance >= 0.6 + modeled sweep speedup >= 1.3 on
        the repetitive workload with byte-identical output, and the new
-       gate floors verified to fail fabricated bad runs.
+       gate floors verified to fail fabricated bad runs;
+    9. sharded fast-decode plane (ISSUE 9): tp2 fused window + fused
+       greedy single step + int8 window measured on the CPU mesh rig,
+       and the tok_s_per_chip_ratio floor verified to fail a fabricated
+       slow-sharded run.
     """
+    # The sharded checks need a multi-device rig: force the 8-way
+    # virtual-CPU platform BEFORE anything imports jax (this smoke is
+    # CPU-only by contract — the module docstring and the tier-1 test
+    # both pin JAX_PLATFORMS=cpu).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ("xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+
     import asyncio
 
     from benchmarks.data_generator.prefix_analyzer import analyze_trace
@@ -364,7 +413,8 @@ def run_smoke(args) -> int:
                     kv_quant={"traffic_ratio": 0.531},
                     spec_decode={"acceptance_rate": 0.9,
                                  "modeled_decode_speedup": 1.9},
-                    prefix_fleet={"remote_hit_rate": 0.34})
+                    prefix_fleet={"remote_hit_rate": 0.34},
+                    sharded_decode={"tok_s_per_chip_ratio": 0.91})
     tpu_low_mbu = dict(tpu_good, mbu=0.60)
     tpu_interfered = dict(
         tpu_good, mixed_prefill_decode={"interference_ratio": 0.70})
@@ -378,6 +428,10 @@ def run_smoke(args) -> int:
     # hints (remote_hit_rate collapsed) must fail.
     tpu_no_remote = dict(tpu_good,
                          prefix_fleet={"remote_hit_rate": 0.05})
+    # ISSUE-9 floor: a sharded engine that fell back to the slow gather
+    # path (per-chip throughput collapsed vs meshless) must fail.
+    tpu_sharded_slow = dict(
+        tpu_good, sharded_decode={"tok_s_per_chip_ratio": 0.5})
 
     from dynamo_tpu.bench.disagg import run_disagg_ttft_model
 
@@ -401,6 +455,8 @@ def run_smoke(args) -> int:
                                                  tpu_low_accept).ok,
         "no_remote_hits_fails": not gate.compare(tpu_no_remote,
                                                  tpu_no_remote).ok,
+        "sharded_floor_fails": not gate.compare(tpu_sharded_slow,
+                                                tpu_sharded_slow).ok,
         "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),
         "disagg_ttft_streamed_ms": round(
             disagg["ttft_streamed_s"] * 1e3, 1),
@@ -412,6 +468,7 @@ def run_smoke(args) -> int:
         **telemetry_overhead_checks(),
         **decode_wall_checks(),
         **prefix_fleet_checks(),
+        **sharded_decode_checks(),
     }
     ok = all(v is not False for v in checks.values())
     print(json.dumps({"smoke": "pass" if ok else "fail", **checks},

@@ -29,6 +29,29 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def _prescan_tp() -> None:
+    """`--tp N` on a CPU host needs N visible devices, and the XLA flag
+    must land before jax initialises (same discipline as
+    worker/__main__.py's prescan).  Harmless under a real TPU backend:
+    the flag only multiplies the HOST platform's device count."""
+    argv = sys.argv[1:]
+    tp = 0
+    for i, a in enumerate(argv):
+        if a == "--tp" and i + 1 < len(argv):
+            tp = int(argv[i + 1])
+        elif a.startswith("--tp="):
+            tp = int(a.split("=", 1)[1])
+    if tp > 1 and ("xla_force_host_platform_device_count"
+                   not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={max(tp, 8)}"
+        ).strip()
+
+
+_prescan_tp()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -66,21 +89,42 @@ def slope(fn, n1=3, n2=9):
 
 
 def _block_tables(batch, width):
-    bt = np.zeros((batch, width), np.int32)
-    for i in range(batch):
-        bt[i] = np.arange(1 + i * width, 1 + (i + 1) * width)
-    return jnp.asarray(bt)
+    from dynamo_tpu.bench.harness import sequential_block_tables
+
+    return jnp.asarray(sequential_block_tables(batch, width))
 
 
 def window_time(cfg, params, use_pallas, *, batch=BATCH, ctx=CTX,
                 block=BLOCK, width=WIDTH, window=WINDOW,
-                kv_quant="none"):
-    """Per-token device time inside the fused K-step decode window."""
+                kv_quant="none", mesh=None):
+    """Per-token device time inside the fused K-step decode window.
+    With `mesh`, the SHARDED window (parallel.sharding.make_sharded_window
+    — exactly the program a `--tp N` worker dispatches) with params and
+    cache laid out over it."""
     num_blocks = 1 + batch * width
-    win = jax.jit(
-        make_decode_window(cfg, block, window, use_pallas_decode=use_pallas,
-                           greedy_only=True),
-        donate_argnums=(1,))
+    quant = kv_quant != "none"
+    if mesh is not None:
+        from dynamo_tpu.parallel.sharding import (
+            cache_pspecs, make_sharded_window, param_pspecs, shard_pytree)
+
+        win = make_sharded_window(cfg, block, mesh, window,
+                                  greedy_only=True,
+                                  use_pallas_decode=use_pallas,
+                                  kv_quant=quant)
+        params = shard_pytree(params, param_pspecs(cfg), mesh)
+        cache_specs = cache_pspecs(cfg.num_layers, kv_quant=quant)
+
+        def make_cache(c):
+            return shard_pytree(c, cache_specs, mesh)
+    else:
+        win = jax.jit(
+            make_decode_window(cfg, block, window,
+                               use_pallas_decode=use_pallas,
+                               greedy_only=True),
+            donate_argnums=(1,))
+
+        def make_cache(c):
+            return c
     bt = _block_tables(batch, width)
     z = jnp.zeros((batch,), jnp.float32)
     zi = jnp.zeros((batch,), jnp.int32)
@@ -88,9 +132,9 @@ def window_time(cfg, params, use_pallas, *, batch=BATCH, ctx=CTX,
     keys = jnp.zeros((batch, 2), jnp.uint32)
 
     def fresh():
-        return (kvc.init_cache(kvc.KvCacheConfig.for_model(
+        return (make_cache(kvc.init_cache(kvc.KvCacheConfig.for_model(
                     cfg, num_blocks=num_blocks, block_size=block,
-                    kv_quant=kv_quant)),
+                    kv_quant=kv_quant))),
                 jnp.ones((batch,), jnp.int32))
 
     def run(n):
@@ -111,13 +155,17 @@ def window_time(cfg, params, use_pallas, *, batch=BATCH, ctx=CTX,
 
 
 def kernel_time(cfg, *, batch=BATCH, ctx=CTX, block=BLOCK, width=WIDTH,
-                layers=None, interpret=None):
-    """Pallas paged-decode kernel alone, chained x num_layers per 'step'."""
+                layers=None, interpret=None, tp=1):
+    """Pallas paged-decode kernel alone, chained x num_layers per 'step'.
+    `tp` > 1 profiles the PER-SHARD geometry a head-sharded engine hands
+    the kernel inside shard_map (Hq/tp query heads over an [S, F/tp]
+    cache slice) — the honest per-chip kernel cost under tensor
+    parallelism."""
     L = layers or cfg.num_layers
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     S = (1 + batch * width) * block
-    F = cfg.num_kv_heads * cfg.head_dim
+    F = cfg.num_kv_heads * cfg.head_dim // tp
     k_cache = jnp.ones((S, F), jnp.bfloat16)
     v_cache = jnp.ones((S, F), jnp.bfloat16)
     bt = _block_tables(batch, width)
@@ -131,7 +179,8 @@ def kernel_time(cfg, *, batch=BATCH, ctx=CTX, block=BLOCK, width=WIDTH,
                                        interpret=interpret)
         return q
 
-    q0 = jnp.ones((batch, cfg.num_heads, cfg.head_dim), jnp.bfloat16)
+    q0 = jnp.ones((batch, cfg.num_heads // tp, cfg.head_dim),
+                  jnp.bfloat16)
 
     def run(n):
         q = q0
@@ -204,20 +253,30 @@ def scheduler_time(*, batch=BATCH, ctx=CTX, block=BLOCK, iters=200):
 
 def phase_breakdown(cfg, params, *, batch=BATCH, ctx=CTX, block=BLOCK,
                     width=WIDTH, window=WINDOW, use_pallas=None,
-                    with_kernel=True):
+                    with_kernel=True, mesh=None):
     """The per-phase decode-step split, all values in ms.
 
     `non_attention` is derived (window - kernel) and only meaningful
     when both run on the real device; on CPU the kernel runs in
-    interpret mode and the subtraction is reported as None."""
+    interpret mode and the subtraction is reported as None.
+
+    `mesh` (ISSUE 9 satellite): the window/weights phases run the
+    SHARDED programs, so a `--tp N` gap vs meshless is attributable to a
+    phase instead of being one opaque number; the kernel phase profiles
+    the per-shard geometry."""
+    from dynamo_tpu.ops.pallas import mosaic_geometry_ok
+
     on_tpu = jax.default_backend() == "tpu"
+    tp = mesh.shape["tp"] if mesh is not None else 1
     if use_pallas is None:
-        use_pallas = on_tpu
+        feat = cfg.num_kv_heads * cfg.head_dim // max(tp, 1)
+        use_pallas = on_tpu and mosaic_geometry_ok(feat, block)
     win_ms = window_time(cfg, params, use_pallas, batch=batch, ctx=ctx,
-                         block=block, width=width, window=window) * 1e3
+                         block=block, width=width, window=window,
+                         mesh=mesh) * 1e3
     weights_ms = window_time(cfg, params, use_pallas, batch=batch, ctx=1,
                              block=block, width=width,
-                             window=window) * 1e3
+                             window=window, mesh=mesh) * 1e3
     # 6 decimals: tiny-model CPU smokes can slope-clamp to 1e-6 ms under
     # machine load, and 4-decimal rounding flattened that to a 0.0 that
     # reads as "not measured".
@@ -232,9 +291,10 @@ def phase_breakdown(cfg, params, *, batch=BATCH, ctx=CTX, block=BLOCK,
         "kernel_ms": None,
         "non_attention_ms": None,
     }
-    if with_kernel:
+    if with_kernel and cfg.num_heads % max(tp, 1) == 0 \
+            and cfg.num_kv_heads % max(tp, 1) == 0:
         k_ms = kernel_time(cfg, batch=batch, ctx=ctx, block=block,
-                           width=width) * 1e3
+                           width=width, tp=tp) * 1e3
         phases["kernel_ms"] = round(k_ms, 6)
         # Interpret-mode kernel times are not comparable to compiled
         # window times — the subtraction only means something on TPU.
@@ -251,6 +311,13 @@ def main(argv=None):
     p.add_argument("--block", type=int, default=BLOCK)
     p.add_argument("--width", type=int, default=WIDTH)
     p.add_argument("--window", type=int, default=WINDOW)
+    p.add_argument("--tp", type=int, default=1,
+                   help="profile a SHARDED engine's decode phases: the "
+                        "window/weights phases run under a tp-degree "
+                        "mesh (CPU hosts get virtual devices forced "
+                        "before jax init), the kernel phase profiles "
+                        "the per-shard geometry — so the sharded gap "
+                        "is attributable per phase")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON object instead of the text report")
     p.add_argument("--no-probes", action="store_true",
@@ -265,12 +332,34 @@ def main(argv=None):
                         "always reported)")
     args = p.parse_args(argv)
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/dynamo_tpu_xla_cache")
+    # Same env override as bench.py: lets the tier-1 subprocess tests
+    # point at the suite's persistent cache so repeated runs in one
+    # container stay warm.
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                     "/tmp/dynamo_tpu_xla_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     cfg = mcfg.get_config(args.model)
     params = init_params(cfg, jax.random.key(0))
+    mesh = None
+    if args.tp > 1:
+        from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+        devices = jax.devices()
+        if len(devices) < args.tp:
+            p.error(f"--tp {args.tp} needs {args.tp} devices; "
+                    f"have {len(devices)}")
+        mesh = make_mesh(MeshConfig(tp=args.tp), devices[:args.tp])
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
-    w_bytes = n_params * 2
+    # PER-CHIP modeled bytes under --tp (same honesty rule as the
+    # engine's kv_traffic_shards and the bench's mbu_per_chip): the
+    # measured window/kernel times below are per-chip sharded times, so
+    # a whole-model byte count would inflate any mbu/roofline derived
+    # from this JSON by tp.  Weights and KV both split tp-ways under
+    # head-sharded tensor parallelism (the one mesh shape this tool
+    # builds).
+    shards = max(args.tp, 1)
+    w_bytes = n_params * 2 // shards
     # True per-context-token KV bytes (incl. int8 scales) from the ONE
     # accounting everything else gates on (bench.py BENCH JSON, the
     # bench_gate traffic-ratio floor) — no forked formula here.
@@ -278,23 +367,27 @@ def main(argv=None):
 
     traffic = kv_quant_traffic(cfg, block_size=args.block,
                                batch=args.batch, ctx=args.ctx)
-    kv_bytes = traffic["kv_bytes_per_step_bf16"]
-    kv_bytes_int8 = traffic["kv_bytes_per_step_int8"]
+    kv_bytes = traffic["kv_bytes_per_step_bf16"] // shards
+    kv_bytes_int8 = traffic["kv_bytes_per_step_int8"] // shards
 
     out = {
         "model": args.model,
         "batch": args.batch,
         "ctx": args.ctx,
         "window": args.window,
+        "tp": args.tp,
         "device": str(jax.devices()[0]),
         "weight_bytes": w_bytes,
         "kv_bytes_per_step": kv_bytes,
         # The decode-bandwidth-wall phase (ISSUE 6): modeled KV bytes
         # each emitted token costs in HBM sweeps, both cache modes — the
-        # "move half the bytes" claim as arithmetic a CPU can check.
+        # "move half the bytes" claim as arithmetic a CPU can check
+        # (per chip under --tp, like every other figure here).
         "effective_bytes_per_token": {
-            "bf16": args.ctx * traffic["bytes_per_context_token_bf16"],
-            "int8": args.ctx * traffic["bytes_per_context_token_int8"],
+            "bf16": args.ctx * traffic["bytes_per_context_token_bf16"]
+            // shards,
+            "int8": args.ctx * traffic["bytes_per_context_token_int8"]
+            // shards,
             "traffic_ratio": traffic["traffic_ratio"],
         },
     }
@@ -317,16 +410,22 @@ def main(argv=None):
     out["phases"] = phase_breakdown(
         cfg, params, batch=args.batch, ctx=args.ctx, block=args.block,
         width=args.width, window=args.window,
-        with_kernel=not args.no_kernel)
+        with_kernel=not args.no_kernel, mesh=mesh)
     if args.kv_quant != "none":
         # Measured: the fused window's wall time with the quantized cache
         # (gather path dequant on CPU; kernel dequant on TPU) — lets a
         # TPU round report measured-vs-modeled for the int8 plane.
+        # Composes with --tp: scales shard with their kv heads.
+        from dynamo_tpu.ops.pallas import mosaic_geometry_ok
+
+        feat = cfg.num_kv_heads * cfg.head_dim // max(args.tp, 1)
+        use_pallas = (jax.default_backend() == "tpu"
+                      and mosaic_geometry_ok(feat, args.block))
         out["phases"]["window_ms_per_tok_int8"] = round(window_time(
-            cfg, params, jax.default_backend() == "tpu",
+            cfg, params, use_pallas,
             batch=args.batch, ctx=args.ctx, block=args.block,
             width=args.width, window=args.window,
-            kv_quant=args.kv_quant) * 1e3, 6)
+            kv_quant=args.kv_quant, mesh=mesh) * 1e3, 6)
 
     if args.json:
         print(json.dumps(out))
