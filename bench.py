@@ -454,6 +454,26 @@ def main():
         cfg, params=params, k=4, n_requests=8, n_out=64, prompt_len=64,
         period=8, block_size=BLOCK)
 
+    # Prefill plane (ISSUE 10): packed ragged vs padded-bucket prefill
+    # through two real EngineCores over the same ragged prompt set —
+    # warm tok/s ratio (gate floor >= 1.2 on TPU), the cold-vs-warm
+    # compile cliff per plane, packed prefill MFU, and the kernel-level
+    # paged-vs-gather attention slope timing at serving geometry.
+    from dynamo_tpu.bench.prefill_plane import (
+        run_prefill_plane, run_tiny_prefill_plane)
+
+    if on_tpu:
+        prefill_plane = run_prefill_plane(
+            cfg, params=params, n_prompts=32, block_size=BLOCK,
+            max_pages=MAX_PAGES // 4, max_prefill_chunk=512, waves=3,
+            flops_per_token=2.0 * n_params, peak_flops=peak,
+            measure_attention=True)
+    else:
+        # Off-TPU the packed plane runs the kernel in interpret mode —
+        # fine at tiny geometry (plumbing + parity), pathological at
+        # 1B.  Same rig `bench_gate --smoke` gates (ONE definition).
+        prefill_plane = run_tiny_prefill_plane()
+
     # Fleet-wide prefix reuse (ISSUE 7): prefix-dedup study on the
     # shared-prefix data_generator workload — real router + donor hints
     # over a modeled busy fleet, plus a measured PrefixFetcher pull over
@@ -545,6 +565,7 @@ def main():
         "mixed_prefill_decode": mixed,
         "kv_quant": kv_quant,
         "spec_decode": spec_decode,
+        "prefill_plane": prefill_plane,
         "prefix_fleet": prefix_fleet,
         "sharded_decode": sharded_decode,
         "peak_flops_nominal": round(peak / 1e12, 1),

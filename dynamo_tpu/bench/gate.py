@@ -91,6 +91,16 @@ class FloorSpec:
 #   hand out remote-prefix hints for them; measures ~0.34, so 0.2
 #   catches a broken donor policy (hints never attached, dead-donor
 #   leakage filtering everything out) without flaking on routing noise.
+# - prefill_plane.packed_vs_padded_tok_s_ratio >= 1.2 — ISSUE 10: on the
+#   ragged prompt set the packed ragged plane (flat token axis + Pallas
+#   flash-prefill over the pool) must beat the padded-bucket plane by
+#   >= 1.2x warm.  The padded plane's waste on that workload is padding
+#   (ragged lengths into [rows, chunk] buckets) plus the dense gather_kv
+#   materialisation, so parity-or-worse means the packed plane regressed
+#   to the gather path or the kernel lost its streaming advantage.  The
+#   bench ZEROES the ratio when `token_parity` fails, so this floor also
+#   trips on a fast-but-wrong kernel, and the existing interference
+#   floor (>= 0.80) keeps holding with the measured-cost controller.
 # - sharded_decode.tok_s_per_chip_ratio >= 0.8 — ISSUE 9: a tp2 engine's
 #   fused decode window must deliver >= 80% of the meshless tok/s PER
 #   CHIP (tp2 trades one all-reduce per layer for halved weight/KV
@@ -106,6 +116,7 @@ TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("spec_decode.modeled_decode_speedup", minimum=1.3),
     FloorSpec("prefix_fleet.remote_hit_rate", minimum=0.2),
     FloorSpec("sharded_decode.tok_s_per_chip_ratio", minimum=0.8),
+    FloorSpec("prefill_plane.packed_vs_padded_tok_s_ratio", minimum=1.2),
 )
 
 

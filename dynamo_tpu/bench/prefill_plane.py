@@ -1,0 +1,271 @@
+"""Prefill-plane benchmarks: packed ragged vs padded-bucket prefill
+(ISSUE 10).
+
+BENCH_r05's prefill story was the weak half of the serving path:
+serving_mfu 0.062, cold prefill 2,649 tok/s vs 27,706 warm (a 10x compile
+cliff over the rows × chunk × pages bucket lattice), and the dense
+`gather_kv` context copy burning HBM on every chunk.  This module
+measures the packed plane against the padded one through the SAME
+EngineCore serving path — both engines run the same ragged prompt set,
+wave 1 cold (compiles), later waves warm:
+
+- `packed_vs_padded_tok_s_ratio` — warm prefill tok/s, packed / padded.
+  Gate floor (TPU): >= 1.2.  The ragged workload is the honest one: a
+  uniform all-512 wave packs and pads identically, and the padded
+  plane's waste is exactly the raggedness serving traffic has.
+- `cold_warm_ratio` per plane — the compile-cliff series.  The packed
+  plane's shape lattice is (<= 2 token buckets) × (page buckets), so its
+  cold wave compiles a handful of programs where the padded lattice
+  compiles rows × chunks × pages; `compiled_shapes` reports both
+  (EngineStepCounters.xla_cache_misses).
+- `token_parity` — both planes must emit byte-identical first tokens
+  for every prompt (the bench doubles as an oracle; a fast-but-wrong
+  kernel fails here before any throughput number is read).
+- `prefill_mfu` — warm packed prefill tok/s x FLOPs/token / peak.
+- `measure_prefill_attention` — kernel-level paged-vs-gather slope
+  timing at serving geometry (TPU; interpret-mode timings are
+  meaningless and skipped on CPU).
+
+`bench.py` embeds this as the `prefill_plane` BENCH section;
+`tools/bench_gate.py --smoke` runs the tiny-model version so the
+plumbing (section shape, parity, floor wiring) is exercised every CPU
+round.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def ragged_lengths(n: int, lo: int, hi: int, seed: int = 7) -> List[int]:
+    """Deterministic ragged prompt lengths — the mix that makes the
+    padded plane pay (uniform lengths pad nothing and hide the win)."""
+    rng = np.random.default_rng(seed)
+    return [int(x) for x in rng.integers(lo, hi + 1, size=n)]
+
+
+def _build_core(model_cfg, params, packed, *, num_blocks, block_size,
+                max_pages, max_prefill_chunk, prefill_buckets, max_seqs):
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+
+    return EngineCore(EngineConfig(
+        model=model_cfg,
+        num_blocks=num_blocks,
+        packed_prefill=packed,
+        enable_prefix_cache=False,   # distinct prompts; isolate the plane
+        mixed_prefill_adaptive=False,
+        scheduler=SchedulerConfig(
+            max_seqs=max_seqs, block_size=block_size,
+            max_pages_per_seq=max_pages,
+            max_prefill_chunk=max_prefill_chunk,
+            max_batched_tokens=8192,
+            prefill_buckets=prefill_buckets,
+            decode_buckets=(1, 2, 4, 8, 16, 32, 64)),
+    ), params=params)
+
+
+def _run_waves(core, model_cfg, lens, waves):
+    """Each wave: the same ragged prompt set (seeded per wave), pure
+    prefill (max_tokens=1 — the request finishes at its first token).
+    Returns (tok_s per wave, {wave: {rid: token}} first-token map)."""
+    from dynamo_tpu.engine.sampling import SamplingParams
+
+    tok_s, first_tokens = [], []
+    total = sum(lens)
+    for wave in range(waves):
+        rng = np.random.default_rng(1000 + wave)
+        t0 = time.perf_counter()
+        for i, n in enumerate(lens):
+            prompt = rng.integers(1, model_cfg.vocab_size, size=n).tolist()
+            core.add_request(f"w{wave}r{i}", prompt,
+                             SamplingParams(max_tokens=1))
+        toks: Dict[str, int] = {}
+        while core.has_work:
+            for d in core.step():
+                if d.token_ids:
+                    toks[d.request_id] = d.token_ids[0]
+        tok_s.append(total / max(time.perf_counter() - t0, 1e-9))
+        first_tokens.append(toks)
+    return tok_s, first_tokens
+
+
+def measure_prefill_attention(model_cfg, *, block_size: int = 64,
+                              ctx: int = 512, chunk: int = 512,
+                              segments: int = 4,
+                              interpret: bool = False) -> Dict:
+    """Kernel-level paged-vs-gather prefill attention slope timing at a
+    given geometry: one layer's pool buffers, `segments` sequences each
+    prefilling a `chunk`-token tail of a `ctx`-token context.  The
+    gather side is the exact `gather_kv` + `paged_attention` program the
+    padded plane runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.bench import harness
+    from dynamo_tpu.engine import kv_cache as kvc
+    from dynamo_tpu.ops.attention import paged_attention
+    from dynamo_tpu.ops.pallas import paged_prefill_attention
+
+    Hq, Hkv, D = (model_cfg.num_heads, model_cfg.num_kv_heads,
+                  model_cfg.head_dim)
+    F = Hkv * D
+    # The block tables below are sized ctx // block_size wide and the
+    # packed q_starts land on chunk boundaries — a misaligned geometry
+    # would read past the table (kernel) or hit NULL_BLOCK (gather),
+    # timing two DIFFERENT programs.  Reject it up front.
+    from dynamo_tpu.ops.pallas import PACK_ALIGN
+
+    if ctx % block_size or chunk % PACK_ALIGN or chunk > ctx:
+        raise ValueError(
+            f"measure_prefill_attention needs ctx % block_size == 0, "
+            f"chunk % {PACK_ALIGN} == 0 and chunk <= ctx; got "
+            f"ctx={ctx}, chunk={chunk}, block_size={block_size}")
+    width = ctx // block_size
+    S = (1 + segments * width) * block_size
+    key = jax.random.key(0)
+    kc = jax.random.normal(key, (S, F), jnp.bfloat16)
+    vc = jax.random.normal(jax.random.key(1), (S, F), jnp.bfloat16)
+    bt = jnp.asarray(harness.sequential_block_tables(segments, width))
+    start = ctx - chunk
+    T = segments * chunk
+    q_packed = jax.random.normal(jax.random.key(2), (T, Hq, D),
+                                 jnp.bfloat16)
+    seq_lens = jnp.full((segments,), ctx, jnp.int32)
+    q_starts = jnp.arange(segments, dtype=jnp.int32) * chunk
+    q_lens = jnp.full((segments,), chunk, jnp.int32)
+
+    def sync(x):
+        jax.device_get(jax.tree.leaves(x)[0].ravel()[0])
+
+    def run_paged(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = paged_prefill_attention(
+                q_packed, kc, vc, bt, seq_lens, q_starts, q_lens,
+                block_size=block_size, interpret=interpret)
+        sync(out)
+        return time.perf_counter() - t0
+
+    ctx_pos = jnp.broadcast_to(jnp.arange(ctx, dtype=jnp.int32),
+                               (segments, ctx))
+    slots = kvc.slots_for_positions(bt, ctx_pos, block_size)
+    q_rows = q_packed.reshape(segments, chunk, Hq, D)
+    q_pos = jnp.broadcast_to(
+        jnp.arange(start, ctx, dtype=jnp.int32), (segments, chunk))
+
+    @jax.jit
+    def gather_step(q):
+        k_ctx, v_ctx = kvc.gather_kv(kc, vc, slots, Hkv)
+        return paged_attention(q, k_ctx, v_ctx, q_pos, ctx_pos, seq_lens)
+
+    def run_gather(n):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = gather_step(q_rows)
+        sync(out)
+        return time.perf_counter() - t0
+
+    run_paged(1)   # compile
+    run_gather(1)
+    paged = harness.measure_slope(run_paged, 2, 6, repeats=3)
+    gather = harness.measure_slope(run_gather, 2, 6, repeats=3)
+    return {
+        "geometry": {"segments": segments, "ctx": ctx, "chunk": chunk,
+                     "block_size": block_size},
+        "paged_ms": round(paged.per_call_s * 1e3, 4),
+        "gather_ms": round(gather.per_call_s * 1e3, 4),
+        "paged_vs_gather_speedup": round(
+            gather.per_call_s / paged.per_call_s, 3)
+        if paged.per_call_s else 0.0,
+    }
+
+
+def run_prefill_plane(model_cfg, params=None, *,
+                      n_prompts: int = 16,
+                      lens: Optional[List[int]] = None,
+                      block_size: int = 64,
+                      max_pages: int = 32,
+                      max_prefill_chunk: int = 512,
+                      prefill_buckets: tuple = (16, 32, 64, 128, 256, 512),
+                      waves: int = 3,
+                      flops_per_token: Optional[float] = None,
+                      peak_flops: Optional[float] = None,
+                      measure_attention: bool = False) -> Dict:
+    """The `prefill_plane` BENCH section: packed vs padded prefill
+    through two otherwise-identical EngineCores over the same ragged
+    prompt set.  See the module docstring for what each metric pins."""
+    if lens is None:
+        lens = ragged_lengths(n_prompts, max(block_size, 16),
+                              min(max_prefill_chunk,
+                                  max_pages * block_size // 2))
+    num_blocks = 1 + len(lens) * max_pages
+    max_seqs = min(64, max(8, len(lens)))
+
+    results = {}
+    tokens_by_plane = {}
+    for name, packed in (("padded", False), ("packed", True)):
+        core = _build_core(model_cfg, params, packed,
+                           num_blocks=num_blocks, block_size=block_size,
+                           max_pages=max_pages,
+                           max_prefill_chunk=max_prefill_chunk,
+                           prefill_buckets=prefill_buckets,
+                           max_seqs=max_seqs)
+        tok_s, first = _run_waves(core, model_cfg, lens, waves)
+        tokens_by_plane[name] = first
+        results[name] = {
+            "tok_s_per_wave": [round(t, 2) for t in tok_s],
+            "tok_s_cold": round(tok_s[0], 2),
+            "tok_s_warm": round(max(tok_s[1:] or tok_s), 2),
+            "cold_warm_ratio": round(
+                tok_s[0] / max(tok_s[1:] or tok_s), 4),
+            "compiled_shapes": core.counters.xla_cache_misses,
+            "prefill_dispatches": core.counters.prefill_dispatches,
+            "packed_dispatches": core.counters.packed_prefill_dispatches,
+        }
+
+    warm_packed = results["packed"]["tok_s_warm"]
+    warm_padded = results["padded"]["tok_s_warm"]
+    # Byte-identical first tokens, every prompt, every wave: the
+    # throughput comparison is void if the planes disagree — the ratio
+    # is ZEROED on a parity failure so the TPU gate floor (>= 1.2)
+    # trips instead of passing a fast-but-wrong kernel.
+    parity = tokens_by_plane["packed"] == tokens_by_plane["padded"]
+    out = {
+        "prompt_lens": lens,
+        "total_prompt_tokens": sum(lens),
+        "waves": waves,
+        **results,
+        "packed_vs_padded_tok_s_ratio": round(
+            warm_packed / warm_padded, 4)
+        if (warm_padded and parity) else 0.0,
+        "token_parity": parity,
+    }
+    if flops_per_token and peak_flops:
+        out["prefill_mfu"] = round(
+            warm_packed * flops_per_token / peak_flops, 4)
+    if measure_attention:
+        out["paged_vs_gather"] = measure_prefill_attention(
+            model_cfg, block_size=block_size)
+    return out
+
+
+def run_tiny_prefill_plane(**over) -> Dict:
+    """The ONE CPU-sized rig shared by bench.py's off-TPU branch and
+    `bench_gate --smoke` (tools/bench_gate.py prefill_plane_checks):
+    the tiny model, a fixed ragged prompt set, interpret-mode kernel.
+    A single definition so tuning the smoke geometry can never make the
+    gated check and the reported bench section measure different
+    workloads."""
+    from dynamo_tpu.models import config as mcfg
+
+    kw: Dict = dict(n_prompts=6, lens=[40, 24, 9, 17, 33, 12],
+                    block_size=8, max_pages=16, max_prefill_chunk=32,
+                    prefill_buckets=(8, 16, 32), waves=2)
+    kw.update(over)
+    return run_prefill_plane(mcfg.get_config("tiny-test"), **kw)

@@ -134,6 +134,17 @@ class EngineConfig:
     # Pallas paged-decode kernel; None = auto (TPU backend, unsharded —
     # the sharded step keeps the GSPMD-partitionable gather path).
     use_pallas_decode: Optional[bool] = None
+    # Packed ragged prefill plane (ISSUE 10): scheduled prefill chunks
+    # pack into ONE flat token axis with per-segment block tables and
+    # attention streams pages from the pool via the Pallas flash-prefill
+    # kernel (ops/pallas/paged_prefill.py) — no [R, T] bucket padding,
+    # no gather_kv materialisation, and a shape lattice small enough to
+    # prewarm (the cold-prefill cliff).  None = auto: on for TPU,
+    # meshless, non-MoE engines whose geometry passes
+    # mosaic_geometry_ok (the decode kernel's shared eligibility rule);
+    # everything else keeps the padded gather plane.  Explicit True off
+    # TPU runs the kernel in interpret mode (tests).
+    packed_prefill: Optional[bool] = None
     # Fused decode window: K tokens per device dispatch with on-device
     # token feedback, host syncs lagging `pipeline_depth` windows behind.
     # 1 disables (single-step host loop).  Eliminates the per-token
@@ -436,6 +447,68 @@ class EngineCore:
         # steady shape.  Unsharded engines only (self._fwd_raw); lazily
         # jitted on first all-greedy single-step decode.
         self._greedy_fused: Optional[Callable] = None
+        # Packed ragged prefill plane (EngineConfig.packed_prefill).
+        # The kernel's T % PACK_ALIGN contract binds in interpret mode
+        # too, so token buckets DERIVED from prefill_buckets must be
+        # aligned just like explicit packed_prefill_buckets (which
+        # SchedulerConfig validates itself): auto treats a misaligned
+        # ladder as ineligible, explicit-on rejects it at construction.
+        from dynamo_tpu.ops.pallas import PACK_ALIGN as _pack_align
+
+        packed = config.packed_prefill
+        _bad_buckets = [b for b in sched_cfg.packed_buckets()
+                        if b % _pack_align]
+        if packed is None:
+            from dynamo_tpu.ops.pallas import mosaic_geometry_ok as _mgo
+
+            packed = (jax.default_backend() == "tpu"
+                      and self.mesh is None and not self._mh
+                      and not cfg.is_moe and not _bad_buckets
+                      and _mgo(cfg.num_kv_heads * cfg.head_dim,
+                               self.block_size))
+        elif packed:
+            if _bad_buckets:
+                raise ValueError(
+                    f"packed_prefill=True but the token buckets derived "
+                    f"from prefill_buckets are not {_pack_align}-aligned "
+                    f"({_bad_buckets}) — the packed kernel's PACK_ALIGN "
+                    "contract; align prefill_buckets or set "
+                    "packed_prefill_buckets explicitly")
+            if self.mesh is not None or self._mh:
+                raise ValueError(
+                    "packed_prefill is meshless v1 (the packed step has "
+                    "no sharded variant yet); drop packed_prefill or the "
+                    "mesh — sharded engines keep the padded plane")
+            if cfg.is_moe:
+                raise ValueError(
+                    "packed_prefill has no MoE variant; MoE models serve "
+                    "prefill through the padded plane")
+            if jax.default_backend() == "tpu":
+                from dynamo_tpu.ops.pallas import (
+                    mosaic_geometry_ok as _mgo)
+
+                # Same eligibility the auto rule applies: fail at
+                # construction with a pointed config error instead of a
+                # Mosaic lowering error on the first prefill (off-TPU
+                # the kernel runs in interpret mode, any geometry).
+                if not _mgo(cfg.num_kv_heads * cfg.head_dim,
+                            self.block_size):
+                    raise ValueError(
+                        "packed_prefill=True but the geometry is not "
+                        "Mosaic-eligible (needs num_kv_heads*head_dim % "
+                        "128 == 0 and block_size % 8 == 0; got "
+                        f"F={cfg.num_kv_heads * cfg.head_dim}, "
+                        f"block_size={self.block_size}) — drop the flag "
+                        "to serve this model through the padded plane")
+        self._use_packed_prefill = bool(packed)
+        self._packed_step: Optional[Callable] = None  # lazily jitted
+        # Mixed-cost calibration state: prefill tokens dispatched since
+        # the last window dispatch (attributed to the window whose sync
+        # interval absorbs their execution) and the previous window-sync
+        # timestamp (None across pipeline drains — fill/drain intervals
+        # are not steady-state samples).
+        self._prefill_cost_tokens = 0
+        self._last_window_sync_ts: Optional[float] = None
         # Speculative decoding: pluggable drafter + lazily-jitted batched
         # verify (sampling.speculative_verify).
         self._spec_verify: Optional[Callable] = None
@@ -568,6 +641,7 @@ class EngineCore:
         self._windows_since_prefill = 0
         self._mixed_duty = config.mixed_prefill_duty
         self._mixed_ctl: Optional[MixedPrefillController] = None
+        self._mixed_cost_seen = 0
         if config.mixed_prefill_adaptive and config.decode_window > 1:
             self._mixed_ctl = MixedPrefillController(
                 target=config.mixed_prefill_target,
@@ -754,6 +828,24 @@ class EngineCore:
         multihost followers derive identical plans."""
         if self._mixed_ctl is None:
             return
+        # Calibration: fold the measured packed-chunk cost (window-sync
+        # wall intervals, EngineStepCounters) into the controller's
+        # EWMA, replacing the hardcoded r5-era cost_ratio prior.
+        # Multihost keeps the static prior: the measurement is per-host
+        # wall clock, and folding it in would diverge the EWMA across
+        # lockstep processes — plans must stay derivable from replicated
+        # state alone.
+        # Fold each measured sample ONCE (gated on the sample counter):
+        # _plan_mixed_budget runs every step but the ratio only moves at
+        # window syncs, and re-folding the same value would converge the
+        # controller EWMA onto it at ~full weight, defeating the damping
+        # observe_cost_ratio exists to provide.
+        if not self._mh and self._mixed_cost_seen != (
+                self.counters.prefill_cost_samples):
+            self._mixed_cost_seen = self.counters.prefill_cost_samples
+            measured = self.counters.measured_prefill_cost_ratio
+            if measured is not None:
+                self._mixed_ctl.observe_cost_ratio(measured)
         decoding = sum(1 for r in self.scheduler.running
                        if r.state is RequestState.DECODE)
         backlog = sum(len(r.prompt_tokens) - r.prefilled
@@ -1131,8 +1223,17 @@ class EngineCore:
         window mode must not serialize every window behind a device
         sync).  Until settled, the request sits in _pending_first and is
         excluded from decode work."""
+        if (self._use_packed_prefill and not self._sp_eligible(batch)
+                and not any(w.request.prompt_embeds is not None
+                            for w in batch.items)):
+            # Packed ragged plane (ISSUE 10): one flat token axis with
+            # segment block tables through the Pallas flash-prefill
+            # kernel.  Multimodal batches (input-embeds step variant)
+            # and ring-SP-eligible batches keep their dedicated paths.
+            return self._run_packed_prefill(batch, async_first)
         R, T, P = self._pad_rows(batch.rows), batch.chunk, batch.pages
         self.counters.prefill_dispatches += 1
+        self._prefill_cost_tokens += sum(w.length for w in batch.items)
         tokens = np.zeros((R, T), np.int32)
         positions = np.full((R, T), self._pad_position, np.int32)
         seq_lens = np.zeros((R,), np.int32)
@@ -1211,9 +1312,17 @@ class EngineCore:
                 self._dev(seq_lens), self._dev(bts),
                 self._dev(sample_pos))
 
+        return self._finish_prefill_items(batch.items, logits, async_first)
+
+    def _finish_prefill_items(self, items, logits,
+                              async_first: bool) -> List[TokenDelta]:
+        """Shared prefill completion tail (padded and packed planes):
+        advance scheduler state, seal blocks, and sample first tokens
+        for rows whose prompt completed — row i of `logits` belongs to
+        items[i] on both planes (padded rows / packed segments)."""
         deltas: List[TokenDelta] = []
         done_rows: List[int] = []
-        for i, work in enumerate(batch.items):
+        for i, work in enumerate(items):
             self.scheduler.prefill_done(work)
             self._publish_completed_blocks(work.request)
             if work.request.state is RequestState.DECODE:
@@ -1222,7 +1331,7 @@ class EngineCore:
             # Sample first tokens for rows whose prompt completed (logits
             # already point at each row's last real chunk position).
             sel = self._select_rows(logits, done_rows)
-            reqs = [batch.items[i].request for i in done_rows]
+            reqs = [items[i].request for i in done_rows]
             if async_first:
                 fut = self._sample_rows(sel, reqs, async_fetch=True)
                 for req in reqs:
@@ -1235,6 +1344,122 @@ class EngineCore:
                     req, int(sampled[j]),
                     float(lps[j]) if lps is not None else None))
         return deltas
+
+    # -- packed ragged prefill (ISSUE 10) ----------------------------------
+
+    def _packed_prefill_fn(self):
+        """Lazily-jitted packed ragged prefill step (donated cache)."""
+        if self._packed_step is None:
+            from dynamo_tpu.models.llama import make_packed_prefill_step
+
+            self._packed_step = jax.jit(
+                make_packed_prefill_step(self.config.model,
+                                         self.block_size),
+                donate_argnums=(1,))
+        return self._packed_step
+
+    @hot_path
+    def _run_packed_prefill(self, batch: PrefillBatch,
+                            async_first: bool = False) -> List[TokenDelta]:
+        """Packed ragged prefill: the scheduler's chunks pack into flat
+        [T] programs (scheduler.pack_prefill_chunks sizes each pack to
+        the packed token budget with PACK_ALIGN'd segment starts), each
+        dispatched once through the Pallas flash-prefill kernel — no
+        [rows, chunk] bucket padding, no gather materialisation."""
+        from dynamo_tpu.engine.scheduler import pack_prefill_chunks
+        from dynamo_tpu.ops.pallas import PACK_ALIGN
+
+        sched = self.scheduler.config
+        deltas: List[TokenDelta] = []
+        for items in pack_prefill_chunks(
+                batch.items, sched.packed_prefill_budget(),
+                sched.packed_prefill_segments, align=PACK_ALIGN):
+            deltas.extend(self._dispatch_packed_prefill(items, async_first))
+        return deltas
+
+    @hot_path
+    def _dispatch_packed_prefill(self, items,
+                                 async_first: bool) -> List[TokenDelta]:
+        from dynamo_tpu.ops.pallas import PACK_ALIGN
+
+        sched = self.scheduler.config
+        bs = self.block_size
+        R = sched.packed_prefill_segments
+        aligned = sum(-(-w.length // PACK_ALIGN) * PACK_ALIGN
+                      for w in items)
+        T = sched.bucket_for_packed(aligned)
+        P = sched.bucket_for_pages(max(
+            (w.start + w.length + bs - 1) // bs for w in items))
+        tokens = np.zeros((T,), np.int32)
+        positions = np.full((T,), self._pad_position, np.int32)
+        seg_ids = np.zeros((T,), np.int32)
+        bts = np.zeros((R, P), np.int32)
+        q_starts = np.zeros((R,), np.int32)
+        q_lens = np.zeros((R,), np.int32)
+        seq_lens = np.zeros((R,), np.int32)
+        sample_pos = np.zeros((R,), np.int32)
+        off = 0
+        for i, work in enumerate(items):
+            req = work.request
+            L = work.length
+            tokens[off: off + L] = req.prompt_tokens[
+                work.start: work.start + L]
+            positions[off: off + L] = np.arange(work.start, work.start + L)
+            seg_ids[off: off + L] = i
+            q_starts[i] = off
+            q_lens[i] = L
+            seq_lens[i] = work.start + L
+            sample_pos[i] = off + L - 1
+            n = min(len(req.pages), P)
+            bts[i, :n] = req.pages[:n]
+            off += -(-L // PACK_ALIGN) * PACK_ALIGN
+        self.counters.prefill_dispatches += 1
+        self.counters.packed_prefill_dispatches += 1
+        self.counters.note_dispatch("prefill_packed", T, R, P)
+        self._prefill_cost_tokens += sum(w.length for w in items)
+        logits, self.cache = self._packed_prefill_fn()(
+            self.params, self.cache, self._dev(tokens),
+            self._dev(positions), self._dev(seg_ids), self._dev(bts),
+            self._dev(q_starts), self._dev(q_lens), self._dev(seq_lens),
+            self._dev(sample_pos))
+        return self._finish_prefill_items(items, logits, async_first)
+
+    @engine_thread_only
+    def packed_prefill_shape_set(self) -> List[Tuple[int, int, int]]:
+        """The complete (packed tokens, segments, pages) lattice the
+        packed plane can dispatch — small by construction (≤2 token
+        buckets × the page-bucket ladder), which is what makes
+        `prewarm_prefill` affordable where prewarming the padded
+        rows × chunks × pages lattice never was."""
+        sched = self.scheduler.config
+        return [(t, sched.packed_prefill_segments, p)
+                for t in sched.packed_buckets()
+                for p in sched.page_bucket_ladder()]
+
+    @engine_thread_only
+    def prewarm_prefill(self) -> int:
+        """Compile every packed prefill shape now (worker
+        `--prewarm-prefill`), through the persistent XLA compile cache,
+        so the first real request doesn't pay the cold-prefill cliff.
+        All-pad dispatches (q_lens 0, null tables) — the kernel skips
+        the loops but the program still compiles and caches.  Returns
+        the number of shapes compiled; 0 when the packed plane is off."""
+        if not self._use_packed_prefill:
+            return 0
+        fn = self._packed_prefill_fn()
+        shapes = self.packed_prefill_shape_set()
+        for (T, R, P) in shapes:
+            tokens = np.zeros((T,), np.int32)
+            positions = np.full((T,), self._pad_position, np.int32)
+            seg_ids = np.zeros((T,), np.int32)
+            zeros_r = self._dev(np.zeros((R,), np.int32))
+            _, self.cache = fn(
+                self.params, self.cache, self._dev(tokens),
+                self._dev(positions), self._dev(seg_ids),
+                self._dev(np.zeros((R, P), np.int32)), zeros_r, zeros_r,
+                zeros_r, zeros_r)
+            self.counters.note_dispatch("prefill_packed", T, R, P)
+        return len(shapes)
 
     def _decode_row(self, req: Request, compact_index: int) -> int:
         """Device row for a decoding request: its SLOT under dp-attention
@@ -1520,8 +1745,14 @@ class EngineCore:
             "reqs": list(reqs),
             "rows": rows,
             "out": out,
+            # Prefill tokens dispatched since the previous window ride
+            # the device queue BEFORE this window, so this window's sync
+            # interval absorbs their execution time — the attribution
+            # the measured-cost EWMA needs (note_window_interval).
+            "prefill_tokens": self._prefill_cost_tokens,
             "fetch": self._fetch_pool.submit(np.asarray, out),
         })
+        self._prefill_cost_tokens = 0
         if len(self._inflight) > self.config.window_pipeline_depth:
             return self._sync_one_window()
         return []
@@ -1588,6 +1819,19 @@ class EngineCore:
         self.counters.window_syncs += 1
         # dynamo-lint: disable=DL001 THE one counted sync per window
         tokens = entry["fetch"].result()                   # [K, bucket]
+        # Measured mixed-prefill cost (ISSUE 10 satellite): in a full
+        # pipeline the wall interval between consecutive syncs tracks
+        # device window time; windows with a chunk behind them carry the
+        # chunk's cost as excess.  Host clock only — no device work.
+        now = time.monotonic()
+        if self._last_window_sync_ts is not None:
+            self.counters.note_window_interval(
+                now - self._last_window_sync_ts,
+                tokens.shape[0] * len(entry["rows"]),
+                entry.get("prefill_tokens", 0))
+        # A draining pipeline's next interval is fill-distorted; only
+        # back-to-back syncs with work still in flight are samples.
+        self._last_window_sync_ts = now if self._inflight else None
         deltas: List[TokenDelta] = []
         for i in range(tokens.shape[0]):
             for col, req in zip(entry["rows"], entry["reqs"]):

@@ -229,6 +229,15 @@ class SchedulerConfig:
     # None = shard-less allocation).  A request's pages then come from
     # the cache range local to its decode rows' tp shard.
     shard_of_slot: Optional[Callable] = None
+    # Packed ragged prefill (ISSUE 10): chunks pack into one flat token
+    # axis (segments) instead of padded [R, T] rows.  Segment count per
+    # pack is FIXED (one shape dim constant); the token axis snaps to
+    # `packed_buckets()` — by default just (min(128, top), top) where
+    # top covers max_prefill_chunk, so the prefill shape lattice is
+    # (≤2 token buckets) × (page buckets) instead of rows × chunks ×
+    # pages.  () = derive from prefill_buckets.
+    packed_prefill_segments: int = 8
+    packed_prefill_buckets: tuple = ()
 
     def __post_init__(self):
         if self.max_seqs > max(self.decode_buckets):
@@ -239,6 +248,27 @@ class SchedulerConfig:
             raise ValueError(
                 f"max_prefill_chunk={self.max_prefill_chunk} exceeds largest "
                 f"prefill bucket {max(self.prefill_buckets)}")
+        if self.packed_prefill_buckets:
+            # The pack builder promises an over-budget chunk "a pack of
+            # its own", and the dispatch buffer is the top packed
+            # bucket — so the top bucket must cover the align-rounded
+            # max_prefill_chunk, and every bucket must satisfy the
+            # kernel's PACK_ALIGN=8 sublane contract.  Validated here
+            # so a bad config fails at construction, not as a numpy
+            # broadcast error inside the hot serving loop.
+            align = 8  # ops.pallas.PACK_ALIGN (not imported: no jax dep)
+            bad = [b for b in self.packed_prefill_buckets if b % align]
+            if bad:
+                raise ValueError(
+                    f"packed_prefill_buckets must be multiples of "
+                    f"{align} (kernel PACK_ALIGN); got {bad}")
+            need = -(-self.max_prefill_chunk // align) * align
+            if need > max(self.packed_prefill_buckets):
+                raise ValueError(
+                    f"largest packed prefill bucket "
+                    f"{max(self.packed_prefill_buckets)} cannot hold an "
+                    f"aligned max_prefill_chunk ({need} tokens); raise "
+                    "the bucket or lower max_prefill_chunk")
 
     def bucket_for_decode(self, n: int) -> int:
         for b in self.decode_buckets:
@@ -258,6 +288,43 @@ class SchedulerConfig:
                 return b
         return self.prefill_row_buckets[-1]
 
+    def packed_buckets(self) -> tuple:
+        """Token-axis buckets for packed ragged prefill.  Two by
+        default: a small one so mixed-mode chunks behind decode windows
+        don't pay a full-width program, and the top one covering
+        max_prefill_chunk.  The whole packed shape set is these ×
+        `page_bucket_ladder()` — what `--prewarm-prefill` compiles."""
+        if self.packed_prefill_buckets:
+            return tuple(sorted(self.packed_prefill_buckets))
+        top = self.bucket_for_prefill(self.max_prefill_chunk)
+        small = min(128, top)
+        return (small, top) if small < top else (top,)
+
+    def bucket_for_packed(self, n: int) -> int:
+        for b in self.packed_buckets():
+            if n <= b:
+                return b
+        return self.packed_buckets()[-1]
+
+    def packed_prefill_budget(self) -> int:
+        """Aligned-token capacity of one packed prefill dispatch."""
+        return self.packed_buckets()[-1]
+
+    def page_bucket_ladder(self) -> tuple:
+        """Every value `bucket_for_pages` can return — the page-bucket
+        half of the packed prefill shape set.  Probed through
+        `bucket_for_pages` itself so the prewarm set can never desync
+        from the buckets serving actually dispatches."""
+        ladder = []
+        n = 1
+        while True:
+            b = self.bucket_for_pages(n)
+            if not ladder or b != ladder[-1]:
+                ladder.append(b)
+            if b >= self.max_pages_per_seq:
+                return tuple(ladder)
+            n = b + 1
+
     def bucket_for_pages(self, n: int) -> int:
         """Block-table width bucket: the device step's context gather costs
         O(width × block_size), so tables are sliced to the smallest
@@ -271,6 +338,34 @@ class SchedulerConfig:
         return min(b, self.max_pages_per_seq)
 
 
+def pack_prefill_chunks(items: List["PrefillWork"], budget: int,
+                        max_segments: int,
+                        align: int = 8) -> List[List["PrefillWork"]]:
+    """Size packed ragged prefill dispatches to a token budget.
+
+    Greedy in-order (FCFS — the plan's item order is admission order)
+    first-fit: each pack holds at most `max_segments` chunks whose
+    `align`-rounded lengths sum to at most `budget` tokens (align is the
+    kernel's PACK_ALIGN sublane contract — segment starts land on
+    8-token boundaries).  A chunk longer than the budget still gets a
+    pack of its own (chunk lengths are capped at max_prefill_chunk ≤ the
+    top packed bucket, so this only triggers on degenerate configs)."""
+    packs: List[List[PrefillWork]] = []
+    cur: List[PrefillWork] = []
+    cur_tokens = 0
+    for w in items:
+        need = -(-w.length // align) * align
+        if cur and (cur_tokens + need > budget
+                    or len(cur) >= max_segments):
+            packs.append(cur)
+            cur, cur_tokens = [], 0
+        cur.append(w)
+        cur_tokens += need
+    if cur:
+        packs.append(cur)
+    return packs
+
+
 @dataclass
 class MixedPrefillController:
     """Adaptive mixed-mode admission: picks (duty, chunk budget) from the
@@ -281,10 +376,8 @@ class MixedPrefillController:
     Model: the decode fleet's work between consecutive prefill chunks is
     `duty x n_decoding x window` token units; a chunk of C prefill tokens
     costs `C x cost_ratio` of the same units (cost_ratio = modeled cost
-    of one chunked-prefill token relative to one window-decode token,
-    calibrated so BENCH_r05's geometry — duty 2, 128-token chunks behind
-    32 rows x window 8 — reproduces its measured 0.778).  Modeled
-    interference is then
+    of one chunked-prefill token relative to one window-decode token).
+    Modeled interference is then
 
         duty·n·K / (duty·n·K + C·cost_ratio)
 
@@ -293,23 +386,53 @@ class MixedPrefillController:
     equal modeled interference), else the largest chunk max_duty affords
     — floored at `floor_tokens` so prefill never starves, accepting
     below-target interference only when the floor forces it (tiny decode
-    fleets, where absolute decode throughput is small anyway)."""
+    fleets, where absolute decode throughput is small anyway).
+
+    Cost calibration (ISSUE 10 satellite): `cost_ratio` is only the
+    PRIOR — 1.15 was hand-calibrated so BENCH_r05's geometry (duty 2,
+    128-token chunks behind 32 rows x window 8) reproduces its measured
+    0.778, an r5-era constant that goes stale every time the prefill
+    kernel changes.  The engine feeds `observe_cost_ratio` with the
+    MEASURED packed-chunk cost (EngineStepCounters.
+    measured_prefill_cost_ratio, from window-sync wall intervals), and
+    an EWMA of those measurements replaces the prior in every model
+    query, so adaptive duty tracks the real kernel."""
 
     target: float = 0.85
-    cost_ratio: float = 1.15
+    cost_ratio: float = 1.15          # prior until measurements arrive
     max_duty: int = 8
     floor_tokens: int = 64
+    cost_ewma_alpha: float = 0.25
+    measured_cost: Optional[float] = None
+
+    @property
+    def effective_cost_ratio(self) -> float:
+        """Measured EWMA when available, the static prior otherwise."""
+        return (self.measured_cost if self.measured_cost is not None
+                else self.cost_ratio)
+
+    def observe_cost_ratio(self, ratio: float) -> None:
+        """Fold one measured prefill-token / decode-token cost ratio
+        into the EWMA; clamped so a single mistimed interval (tenancy
+        pause inside a window sync) can't swing duty to an extreme."""
+        ratio = min(max(float(ratio), 0.1), 10.0)
+        if self.measured_cost is None:
+            self.measured_cost = ratio
+        else:
+            a = self.cost_ewma_alpha
+            self.measured_cost = (1.0 - a) * self.measured_cost + a * ratio
 
     def budget_for(self, duty: int, n_decoding: int, window: int) -> int:
         """Largest chunk (tokens) whose modeled interference stays at or
         above target when dispatched behind every `duty`-th window."""
         w = duty * n_decoding * window
-        return int(w * (1.0 - self.target) / (self.target * self.cost_ratio))
+        return int(w * (1.0 - self.target)
+                   / (self.target * self.effective_cost_ratio))
 
     def modeled_interference(self, duty: int, n_decoding: int, window: int,
                              chunk_tokens: int) -> float:
         w = duty * n_decoding * window
-        c = chunk_tokens * self.cost_ratio
+        c = chunk_tokens * self.effective_cost_ratio
         return w / (w + c) if (w + c) > 0 else 1.0
 
     def plan(self, n_decoding: int, window: int,

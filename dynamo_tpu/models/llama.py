@@ -522,6 +522,136 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
 
 
 # ---------------------------------------------------------------------------
+# Packed ragged prefill
+
+
+def make_packed_prefill_step(cfg: ModelConfig, block_size: int):
+    """Build the packed ragged prefill step (ISSUE 10 tentpole leg 2).
+
+    Several sequences' prefill chunks ride ONE flat `[T]` token axis
+    ("segments") instead of padded `[R, T]` rows, and attention streams
+    K/V pages straight from the block pool through the Pallas
+    flash-prefill kernel (ops/pallas/paged_prefill.py) — no `gather_kv`
+    materialisation, no per-(rows, chunk) bucket lattice.  One compiled
+    shape per (packed-token bucket, page bucket) serves any mix of chunk
+    lengths, so the cold-prefill shape set collapses to a handful the
+    worker can prewarm at startup.
+
+    Signature:
+
+        logits, cache = step(params, cache, tokens[T], positions[T],
+                             seg_ids[T], block_tables[R, P], q_starts[R],
+                             q_lens[R], seq_lens[R], sample_positions[R])
+
+    - tokens/positions: the packed chunks; pad rows (alignment gaps,
+      tail) carry the engine's pad position, which resolves to the null
+      block.
+    - seg_ids: owning segment per token (selects the block-table row for
+      the KV scatter); pad rows may carry any id — their pad position
+      nulls the write.
+    - q_starts/q_lens: each segment's packed row range (PACK_ALIGN'd
+      starts); q_len 0 marks a pad segment.
+    - seq_lens: total valid context per segment AFTER this chunk —
+      cached-prefix residual prefill just starts the chunk positions
+      past the resident prefix.
+    - sample_positions: packed row whose logits each segment wants (its
+      last real token); logits come back `[R, V]`.
+
+    int8 pools route through the kernel's dequant-in-VMEM variant
+    (static branch on the cache pytree, like the padded step).  MoE
+    models keep the padded plane (no packed MoE variant); the engine
+    enforces that.  The kernel runs in interpret mode off-TPU, so the
+    packed plane is CPU-testable like the decode kernel.
+    """
+    cfg.validate()
+    if cfg.is_moe:
+        raise ValueError("packed prefill has no MoE variant; MoE models "
+                         "serve prefill through the padded plane")
+    from dynamo_tpu.ops.pallas import paged_prefill_attention
+
+    def step(params, cache, tokens, positions, seg_ids, block_tables,
+             q_starts, q_lens, seq_lens, sample_positions):
+        T = tokens.shape[0]
+        interp = jax.default_backend() != "tpu"
+        quant = kvc.cache_is_quantized(cache)
+        # Per-token write slots through the owning segment's table.
+        bt_tok = jnp.take(block_tables, seg_ids, axis=0)        # [T, P]
+        write_slots = kvc.slots_for_positions(
+            bt_tok, positions[:, None], block_size).reshape(T)
+
+        x = jnp.take(params["embed"], tokens, axis=0)[None]     # [1, T, H]
+        if cfg.embed_scale:
+            x = x * jnp.asarray(cfg.hidden_size ** 0.5, x.dtype)
+        pos2 = positions[None]                                  # [1, T]
+        k_layers = list(cache["k"])
+        v_layers = list(cache["v"])
+        ks_layers = (list(cache["k_scale"]) if quant
+                     else [None] * cfg.num_layers)
+        vs_layers = (list(cache["v_scale"]) if quant
+                     else [None] * cfg.num_layers)
+        off = cfg.rms_offset
+        for i, layer in enumerate(params["layers"]):
+            p_attn = layer["attn"]
+            h_in = rms_norm(x, layer["attn_norm"], cfg.rms_norm_eps, off)
+            q = (h_in @ p_attn["wq"]).reshape(1, T, cfg.num_heads,
+                                              cfg.head_dim)
+            k = (h_in @ p_attn["wk"]).reshape(1, T, cfg.num_kv_heads,
+                                              cfg.head_dim)
+            v = (h_in @ p_attn["wv"]).reshape(1, T, cfg.num_kv_heads,
+                                              cfg.head_dim)
+            q = rope(q, pos2, cfg.rope_theta)
+            k = rope(k, pos2, cfg.rope_theta)
+            if quant:
+                (k_layers[i], v_layers[i],
+                 ks_layers[i], vs_layers[i]) = kvc.write_kv_quant(
+                    k_layers[i], v_layers[i], ks_layers[i], vs_layers[i],
+                    write_slots,
+                    k.reshape(T, cfg.kv_size), v.reshape(T, cfg.kv_size))
+            else:
+                k_layers[i], v_layers[i] = kvc.write_kv(
+                    k_layers[i], v_layers[i], write_slots,
+                    k.reshape(T, cfg.kv_size), v.reshape(T, cfg.kv_size))
+            # Write-then-attend: the chunk's own K/V are pool-resident
+            # rows now, so cached prefix and in-chunk causality are one
+            # position mask inside the kernel.
+            attn = paged_prefill_attention(
+                q[0], k_layers[i], v_layers[i], block_tables, seq_lens,
+                q_starts, q_lens, block_size=block_size,
+                scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap,
+                interpret=interp,
+                k_scale=ks_layers[i], v_scale=vs_layers[i])
+            attn = attn.reshape(1, T, cfg.q_size) @ p_attn["wo"]
+            if cfg.post_norms:
+                attn = rms_norm(attn, layer["post_attn_norm"],
+                                cfg.rms_norm_eps, off)
+            x = x + attn
+            h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps, off)
+            mlp_out = _dense_mlp(layer["mlp"], h, cfg.activation)
+            if cfg.post_norms:
+                mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"],
+                                   cfg.rms_norm_eps, off)
+            x = x + mlp_out
+
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, off)
+        # LM head on one packed row per segment ([R, H] @ [H, V]).
+        sel = jnp.take(x[0], sample_positions.astype(jnp.int32), axis=0)
+        head = params.get("lm_head")
+        if head is None:
+            head = params["embed"].T
+        logits = (sel @ head).astype(jnp.float32)
+        if cfg.final_soft_cap is not None:
+            logits = cfg.final_soft_cap * jnp.tanh(
+                logits / cfg.final_soft_cap)
+        new_cache = {"k": k_layers, "v": v_layers}
+        if quant:
+            new_cache["k_scale"] = ks_layers
+            new_cache["v_scale"] = vs_layers
+        return logits, new_cache
+
+    return step
+
+
+# ---------------------------------------------------------------------------
 # Forward
 
 

@@ -11,5 +11,10 @@ from dynamo_tpu.ops.pallas.paged_attention import (
     mosaic_geometry_ok,
     paged_decode_attention,
 )
+from dynamo_tpu.ops.pallas.paged_prefill import (
+    PACK_ALIGN,
+    paged_prefill_attention,
+)
 
-__all__ = ["paged_decode_attention", "mosaic_geometry_ok"]
+__all__ = ["paged_decode_attention", "paged_prefill_attention",
+           "mosaic_geometry_ok", "PACK_ALIGN"]

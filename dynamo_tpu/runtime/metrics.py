@@ -264,10 +264,26 @@ class EngineStepCounters:
         self.window_syncs = 0
         self.single_step_dispatches = 0
         self.prefill_dispatches = 0
+        self.packed_prefill_dispatches = 0
         self.spec_dispatches = 0
         self.h2d_uploads = 0
         self.kv_read_bytes_modeled = 0
         self.decode_tokens_emitted = 0
+        # Mixed-prefill cost calibration (ISSUE 10 satellite): EWMAs of
+        # engine-thread wall seconds per window-decode token (plain
+        # windows) and per concurrently-dispatched prefill token (the
+        # excess on windows with a chunk riding behind them).  Host
+        # floats fed by note_window_interval at the window sync — the
+        # engine's ONE existing blocking point — so calibration costs
+        # zero extra syncs.  Deliberately NOT in to_dict(): delta-pinned
+        # counter tests compare exact ints; wall-clock EWMAs would make
+        # "byte-identical" assertions flaky.  None = no sample yet — a
+        # measured cost of exactly 0.0 (zero-excess mixed window) is a
+        # real sample and must seed/damp the EWMA, not restart it.
+        self.decode_token_cost_ewma: Optional[float] = None
+        self.prefill_token_cost_ewma: Optional[float] = None
+        self.prefill_cost_samples = 0
+        self._cost_ewma_alpha = 0.25
         self._seen_shapes: set = set()
 
     def note_dispatch(self, tag: str, *sig) -> None:
@@ -283,6 +299,42 @@ class EngineStepCounters:
         it emitted; host-int arithmetic only."""
         self.kv_read_bytes_modeled += int(nbytes)
         self.decode_tokens_emitted += int(tokens)
+
+    def note_window_interval(self, wall_s: float, window_tokens: int,
+                             prefill_tokens: int) -> None:
+        """Wall time between consecutive steady window syncs.  Plain
+        windows (no chunk behind them) calibrate the per-decode-token
+        cost; windows with `prefill_tokens` dispatched behind them
+        attribute the excess over the calibrated decode cost to the
+        chunk.  In a pipelined steady state the sync interval tracks the
+        device's window execution time, so the ratio of the two EWMAs is
+        the measured `cost_ratio` the MixedPrefillController needs —
+        without adding a single device sync."""
+        if wall_s <= 0 or window_tokens <= 0:
+            return
+        a = self._cost_ewma_alpha
+        if prefill_tokens <= 0:
+            per = wall_s / window_tokens
+            self.decode_token_cost_ewma = (
+                per if self.decode_token_cost_ewma is None
+                else (1.0 - a) * self.decode_token_cost_ewma + a * per)
+        elif self.decode_token_cost_ewma is not None:
+            excess = wall_s - window_tokens * self.decode_token_cost_ewma
+            per = max(excess, 0.0) / prefill_tokens
+            self.prefill_token_cost_ewma = (
+                per if self.prefill_token_cost_ewma is None
+                else (1.0 - a) * self.prefill_token_cost_ewma + a * per)
+            self.prefill_cost_samples += 1
+
+    @property
+    def measured_prefill_cost_ratio(self):
+        """Measured chunked-prefill-token / window-decode-token cost, or
+        None before both EWMAs have samples.  Clamped at the consumer
+        (MixedPrefillController.observe_cost_ratio)."""
+        if (self.decode_token_cost_ewma is None
+                or self.prefill_token_cost_ewma is None):
+            return None
+        return self.prefill_token_cost_ewma / self.decode_token_cost_ewma
 
     @property
     def effective_bytes_per_token(self) -> float:
@@ -300,6 +352,7 @@ class EngineStepCounters:
             "window_syncs": self.window_syncs,
             "single_step_dispatches": self.single_step_dispatches,
             "prefill_dispatches": self.prefill_dispatches,
+            "packed_prefill_dispatches": self.packed_prefill_dispatches,
             "spec_dispatches": self.spec_dispatches,
             "h2d_uploads": self.h2d_uploads,
             "kv_read_bytes_modeled": self.kv_read_bytes_modeled,

@@ -138,6 +138,22 @@ def parse_args(argv=None):
                         "(rejection-sampling fallback).  0 disables")
     p.add_argument("--spec-ngram", type=int, default=3,
                    help="n-gram length for the prompt-lookup drafter")
+    p.add_argument("--packed-prefill", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="packed ragged prefill plane: chunks pack into "
+                        "one flat token axis with per-segment block "
+                        "tables and attention streams pages from the "
+                        "pool via the Pallas flash-prefill kernel.  "
+                        "'auto' = on for TPU meshless non-MoE engines "
+                        "whose geometry passes the Mosaic eligibility "
+                        "rule; 'on' forces it (interpret mode off-TPU); "
+                        "'off' keeps the padded gather plane")
+    p.add_argument("--prewarm-prefill", action="store_true",
+                   help="compile the packed prefill shape set at "
+                        "startup (through the persistent XLA compile "
+                        "cache) so the first request's TTFT doesn't pay "
+                        "the cold-prefill compile cliff; no-op when the "
+                        "packed plane is off")
     p.add_argument("--speedup-ratio", type=float, default=10.0)
     p.add_argument("--metrics-interval", type=float, default=1.0)
     p.add_argument("--health-port", type=int, default=0,
@@ -282,11 +298,20 @@ async def build_engine(args, kv_event_sink):
                      kv_quant=getattr(args, "kv_quant", "none"),
                      speculative_tokens=getattr(args, "spec_decode", 0),
                      speculative_ngram=getattr(args, "spec_ngram", 3),
+                     packed_prefill={"auto": None, "on": True,
+                                     "off": False}[
+                         getattr(args, "packed_prefill", "auto")],
                      scheduler=SchedulerConfig(
                          block_size=args.block_size,
                          max_prefill_chunk=args.max_prefill_chunk)),
         params=params,
         kv_event_sink=kv_event_sink)
+    if getattr(args, "prewarm_prefill", False):
+        # Before the step-loop thread exists the constructing thread
+        # owns the core, so the prewarm compiles run here and the first
+        # request finds every packed shape in the jit cache.
+        n_shapes = core.prewarm_prefill()
+        print(f"prewarmed {n_shapes} packed prefill shapes", flush=True)
     engine = InferenceEngine(core)
     await engine.start()
     card_fields = {

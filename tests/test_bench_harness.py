@@ -192,6 +192,8 @@ def test_bench_gate_smoke_cli():
     assert out["interference_fails"] is True
     assert out["sharded_floor_fails"] is True
     assert out["sharded_decode_section_ok"] is True
+    assert out["slow_prefill_plane_fails"] is True
+    assert out["prefill_plane_token_parity"] is True
 
 
 def test_gate_tpu_floors():

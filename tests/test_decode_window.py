@@ -304,7 +304,8 @@ def test_counters_expose_dict():
     assert set(d) == {"host_syncs", "xla_cache_misses",
                       "window_dispatches", "window_syncs",
                       "single_step_dispatches", "prefill_dispatches",
-                      "spec_dispatches", "h2d_uploads",
-                      "kv_read_bytes_modeled", "decode_tokens_emitted"}
+                      "packed_prefill_dispatches", "spec_dispatches",
+                      "h2d_uploads", "kv_read_bytes_modeled",
+                      "decode_tokens_emitted"}
     assert d["prefill_dispatches"] >= 1
     assert d["xla_cache_misses"] >= 1  # cold engine must compile

@@ -298,6 +298,30 @@ def sharded_decode_checks() -> dict:
     }
 
 
+def prefill_plane_checks() -> dict:
+    """ISSUE 10 smoke: the packed ragged prefill plane measured on CPU
+    with the tiny model — both planes serve the same ragged prompt set
+    through real EngineCores (packed runs the Pallas flash-prefill
+    kernel in interpret mode), the section must carry the gated ratio,
+    and the first tokens must be byte-identical plane-to-plane.
+
+    The CPU ratio itself is NOT gated: interpret-mode kernel cost
+    swamps it; only presence + parity + packed-dispatch plumbing are
+    asserted here, the 1.2 floor binds on TPU rounds."""
+    from dynamo_tpu.bench.prefill_plane import run_tiny_prefill_plane
+
+    out = run_tiny_prefill_plane()
+    ratio = out.get("packed_vs_padded_tok_s_ratio")
+    return {
+        "prefill_plane_ratio": ratio,
+        "prefill_plane_section_ok": (
+            isinstance(ratio, float) and ratio > 0
+            and out["packed"]["packed_dispatches"] > 0
+            and out["padded"]["packed_dispatches"] == 0),
+        "prefill_plane_token_parity": out["token_parity"],
+    }
+
+
 def prefix_fleet_checks() -> dict:
     """ISSUE 7 smoke: fleet-wide prefix reuse measured on CPU — the real
     router must hand out remote-prefix hints on the shared-prefix
@@ -348,7 +372,11 @@ def run_smoke(args) -> int:
     9. sharded fast-decode plane (ISSUE 9): tp2 fused window + fused
        greedy single step + int8 window measured on the CPU mesh rig,
        and the tok_s_per_chip_ratio floor verified to fail a fabricated
-       slow-sharded run.
+       slow-sharded run;
+    10. prefill plane (ISSUE 10): packed ragged vs padded prefill on the
+        tiny model with byte-identical first tokens, and the
+        packed_vs_padded_tok_s_ratio floor verified to fail a
+        fabricated slow-packed run.
     """
     # The sharded checks need a multi-device rig: force the 8-way
     # virtual-CPU platform BEFORE anything imports jax (this smoke is
@@ -414,7 +442,9 @@ def run_smoke(args) -> int:
                     spec_decode={"acceptance_rate": 0.9,
                                  "modeled_decode_speedup": 1.9},
                     prefix_fleet={"remote_hit_rate": 0.34},
-                    sharded_decode={"tok_s_per_chip_ratio": 0.91})
+                    sharded_decode={"tok_s_per_chip_ratio": 0.91},
+                    prefill_plane={
+                        "packed_vs_padded_tok_s_ratio": 1.45})
     tpu_low_mbu = dict(tpu_good, mbu=0.60)
     tpu_interfered = dict(
         tpu_good, mixed_prefill_decode={"interference_ratio": 0.70})
@@ -432,6 +462,10 @@ def run_smoke(args) -> int:
     # path (per-chip throughput collapsed vs meshless) must fail.
     tpu_sharded_slow = dict(
         tpu_good, sharded_decode={"tok_s_per_chip_ratio": 0.5})
+    # ISSUE-10 floor: a packed prefill plane that stopped beating the
+    # padded one (regressed to the gather path) must fail.
+    tpu_slow_prefill = dict(
+        tpu_good, prefill_plane={"packed_vs_padded_tok_s_ratio": 0.9})
 
     from dynamo_tpu.bench.disagg import run_disagg_ttft_model
 
@@ -457,6 +491,8 @@ def run_smoke(args) -> int:
                                                  tpu_no_remote).ok,
         "sharded_floor_fails": not gate.compare(tpu_sharded_slow,
                                                 tpu_sharded_slow).ok,
+        "slow_prefill_plane_fails": not gate.compare(tpu_slow_prefill,
+                                                     tpu_slow_prefill).ok,
         "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),
         "disagg_ttft_streamed_ms": round(
             disagg["ttft_streamed_s"] * 1e3, 1),
@@ -467,6 +503,7 @@ def run_smoke(args) -> int:
         **tracing_overhead_checks(),
         **telemetry_overhead_checks(),
         **decode_wall_checks(),
+        **prefill_plane_checks(),
         **prefix_fleet_checks(),
         **sharded_decode_checks(),
     }

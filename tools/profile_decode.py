@@ -330,6 +330,12 @@ def main(argv=None):
                    help="also measure the fused window with the "
                         "quantized KV cache (modeled int8 rooflines are "
                         "always reported)")
+    p.add_argument("--prefill-attn", action="store_true",
+                   help="also slope-time prefill attention: the Pallas "
+                        "paged flash-prefill kernel vs the gather_kv "
+                        "path at this geometry (ISSUE 10; interpret "
+                        "mode off-TPU — times then show plumbing, not "
+                        "silicon)")
     args = p.parse_args(argv)
 
     # Same env override as bench.py: lets the tier-1 subprocess tests
@@ -426,6 +432,18 @@ def main(argv=None):
             batch=args.batch, ctx=args.ctx, block=args.block,
             width=args.width, window=args.window,
             kv_quant=args.kv_quant, mesh=mesh) * 1e3, 6)
+
+    if args.prefill_attn:
+        # Prefill-plane attention phase (ISSUE 10): one measurement
+        # methodology with the gated bench — import, don't fork.
+        from dynamo_tpu.bench.prefill_plane import measure_prefill_attention
+
+        out["prefill_attention"] = measure_prefill_attention(
+            cfg, block_size=args.block,
+            ctx=min(args.ctx, args.width * args.block),
+            chunk=min(args.ctx, args.width * args.block),
+            segments=4,
+            interpret=jax.default_backend() != "tpu")
 
     if args.json:
         print(json.dumps(out))
