@@ -728,6 +728,15 @@ class EngineCore:
         and cancelled requests only surface through _collect_dead)."""
         return bool(self._requests)
 
+    @property
+    def has_pending_prefill(self) -> bool:
+        """True while any request still owes prefill work (queued or
+        mid-chunk) — the public form of the "drain prefill before timing
+        decode" loop profilers and benchmarks need, so external drivers
+        never reach into `_requests`."""
+        return any(r.state in (RequestState.WAITING, RequestState.PREFILL)
+                   for r in self._requests.values())
+
     # -- stepping ---------------------------------------------------------
 
     @engine_thread_only

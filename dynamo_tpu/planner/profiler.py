@@ -90,8 +90,7 @@ def profile_engine(
                         rng.integers(1, vocab, size=ctx).tolist(),
                         SamplingParams(max_tokens=decode_tokens))
                 # Prefill everything first (excluded from the ITL window).
-                while any(r.state.value in ("waiting", "prefill")
-                          for r in core._requests.values()):
+                while core.has_pending_prefill:
                     core.step()
                 produced = 0
                 t0 = time.perf_counter()
@@ -114,18 +113,54 @@ def default_core_factory(model: str = "llama-3-1b",
                          decode_window: int = 8,
                          max_seqs: int = 64):
     """EngineCore factory matching the serving geometry."""
+    return cell_core_factory(model, num_blocks=num_blocks,
+                             block_size=block_size,
+                             decode_window=decode_window,
+                             max_seqs=max_seqs)
+
+
+def cell_core_factory(model: str = "llama-3-1b", *,
+                      num_blocks: int = 2048,
+                      block_size: int = 64,
+                      decode_window: int = 8,
+                      max_seqs: int = 64,
+                      tp: int = 1,
+                      kv_quant: str = "none",
+                      spec_decode: int = 0,
+                      packed_prefill: Optional[bool] = None,
+                      mixed_prefill_duty: Optional[int] = None):
+    """EngineCore factory over the serving feature axes PRs 6-10
+    shipped — the real-engine half of one sweep cell
+    (benchmarks/sla_profiler.py drives this on TPU; the mocker cells
+    cover CPU CI).  `tp > 1` builds a tensor-parallel mesh the same way
+    the worker's `--tp` flag does."""
 
     from dynamo_tpu.models.loader import resolve_model
 
     cfg, params, _, _ = resolve_model(model)
 
     def make():
+        mesh = None
+        if tp > 1:
+            import jax
+
+            from dynamo_tpu.parallel import MeshConfig, make_mesh
+            cfg_m = MeshConfig(tp=tp)
+            mesh = make_mesh(cfg_m, jax.devices()[:cfg_m.size])
+        kw = {}
+        if mixed_prefill_duty is not None:
+            kw["mixed_prefill_duty"] = mixed_prefill_duty
         return EngineCore(EngineConfig(
             model=cfg, num_blocks=num_blocks,
+            mesh=mesh,
             enable_prefix_cache=False,
             decode_window=decode_window,
+            kv_quant=kv_quant,
+            speculative_tokens=spec_decode,
+            packed_prefill=packed_prefill,
             scheduler=SchedulerConfig(
-                max_seqs=max_seqs, block_size=block_size)), params=params)
+                max_seqs=max_seqs, block_size=block_size), **kw),
+            params=params)
 
     return make
 

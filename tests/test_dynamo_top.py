@@ -7,6 +7,8 @@ import json
 import os
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
@@ -93,6 +95,46 @@ def test_summarize_row_from_series():
     assert row["hbm_used_bytes"] == 2.0e9
     assert row["slo_state"] == "WARN"
     assert row["slo_max_burn"] == 4.5
+
+
+def test_capacity_headroom_from_profile_knee():
+    """--profile wires the SLA profiler's knee concurrency into a
+    per-row headroom: 1 at idle, 0 at the knee, negative past it."""
+    samples = [("dynamo_worker_request_active_slots", {}, 3.0)]
+    row = dynamo_top.summarize("w", "a:1", samples, None,
+                               knee_concurrency=6.0)
+    assert row["capacity_headroom"] == 0.5
+    over = dynamo_top.summarize(
+        "w", "a:1", [("dynamo_worker_request_active_slots", {}, 9.0)],
+        None, knee_concurrency=6.0)
+    assert over["capacity_headroom"] == pytest.approx(-0.5)
+    # No knee / no inflight series → the column stays empty, never 0.
+    assert dynamo_top.summarize("w", "a:1", samples,
+                                None)["capacity_headroom"] is None
+    assert dynamo_top.summarize("w", "a:1", [], None,
+                                knee_concurrency=6.0)[
+        "capacity_headroom"] is None
+    # Frontend rows NEVER get headroom: their inflight gauge is the
+    # fleet-wide total, which a per-worker knee would misread as
+    # catastrophic overload (300 inflight / knee 6 → -4900%).
+    fe = dynamo_top.summarize(
+        "frontend", "a:1",
+        [("dynamo_frontend_inflight_requests", {}, 300.0)],
+        None, knee_concurrency=6.0)
+    assert fe["inflight"] == 300.0
+    assert fe["capacity_headroom"] is None
+
+
+def test_knee_concurrency_extraction():
+    prof = {"prefill": {}, "decode": {},
+            "meta": {"capacity": {"knee_concurrency_per_worker": 2.5}}}
+    assert dynamo_top.knee_concurrency_from_profile(prof) == 2.5
+    # v1 profiles (planner/profiler.py) and kneeless sweeps → None.
+    assert dynamo_top.knee_concurrency_from_profile(
+        {"prefill": {}, "decode": {}}) is None
+    assert dynamo_top.knee_concurrency_from_profile(
+        {"meta": {"capacity": {"knee_concurrency_per_worker": None}}}
+    ) is None
 
 
 # -- mini-fleet e2e ----------------------------------------------------------

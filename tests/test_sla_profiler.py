@@ -1,0 +1,448 @@
+"""SLA profiler + capacity frontier (`benchmarks/sla_profiler.py`):
+knee detection on synthetic curves, the mocker-parity simulator's
+feature axes, profile schema round-trip through
+`load_profile`/`save_profile`, `SlaPlanner` consuming a
+profiler-produced profile end to end, the PINNED cheapest-fleet fixture
+the deterministic sweep guarantees, and (slow-marked) the 100-worker
+mocker fleet cross-checked against the model via the real
+`tools/dynamo_top.py --once --json` CLI with `--profile` headroom.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from benchmarks.sla_profiler import (
+    AGREEMENT_ATOL_S,
+    AGREEMENT_FACTOR,
+    CellConfig,
+    SMOKE_SLO,
+    SloTarget,
+    agreement,
+    cell_timing,
+    find_knee,
+    make_traffic,
+    plan_capacity,
+    profile_cell,
+    run_fleet,
+    run_smoke,
+    scale_to_rate,
+    simulate_cell,
+    sustainable_rps,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def smoke():
+    """One shared deterministic sweep for every consumer below (~1 s)."""
+    return run_smoke(None)
+
+
+# -- knee detection ----------------------------------------------------------
+
+
+def test_knee_on_hockey_stick():
+    # Flat then exploding: kneedle flags the max-deviation point — the
+    # middle of the bend.
+    idx = find_knee([1, 2, 4, 8, 16, 32],
+                    [10.0, 10.5, 11.0, 12.0, 80.0, 400.0])
+    assert idx == 4
+
+
+def test_knee_absent_on_flat_and_linear_curves():
+    # A curve that never saturates has no knee — inventing one would
+    # cap capacity at an arbitrary load.
+    assert find_knee([1, 2, 4, 8], [10.0, 10.1, 10.2, 10.3]) is None
+    assert find_knee([1, 2, 3], [1.0, 1.0, 1.0]) is None
+    # Too few points to call a bend.
+    assert find_knee([1, 2], [1.0, 100.0]) is None
+    # A 0.0 point must not defeat the no-saturation guard (the relative
+    # 1.3x threshold divides by ~zero): a microsecond-scale linear
+    # curve starting at 0 has no knee either.
+    assert find_knee([1, 2, 4, 8, 16],
+                     [0.0, 1e-6, 2e-6, 3e-6, 4e-6]) is None
+    # ...but a real climb from 0.0 still gets one.
+    assert find_knee([1, 2, 4, 8, 16],
+                     [0.0, 0.001, 0.002, 0.05, 0.4]) is not None
+
+
+def test_knee_input_validation():
+    with pytest.raises(ValueError):
+        find_knee([1, 2, 3], [1.0, 2.0])
+    with pytest.raises(ValueError):
+        find_knee([1, 1, 2], [1.0, 2.0, 3.0])
+
+
+def test_closed_loop_knee_survives_saturation_plateau():
+    # A closed-loop frontier's offered_rps = conc/wall plateaus once
+    # the engine saturates — find_knee would raise on the repeated
+    # loads; closed_loop_knee must keep working (the --tpu sweep path).
+    from benchmarks.sla_profiler import FrontierPoint, closed_loop_knee
+
+    def pt(rps, ttft):
+        return FrontierPoint(offered_rps=rps, ttft_p50_s=ttft,
+                             ttft_p99_s=ttft, tpot_p50_s=0.0,
+                             tpot_p99_s=0.0, throughput_tok_s=0.0,
+                             mean_inflight=0.0)
+
+    # Bend inside the increasing prefix → kneedle's pick (index 3, the
+    # max-deviation-below-the-chord point of the 5-point prefix).
+    bent = [pt(r, t) for r, t in
+            [(10, 0.01), (20, 0.011), (40, 0.012), (60, 0.05),
+             (70, 0.4), (70, 1.6)]]
+    assert closed_loop_knee(bent) == 3
+    # Flat latency until the throughput plateau → the last point still
+    # on the rise is the saturation onset.
+    flat = [pt(r, 0.01) for r in [10, 20, 40, 60]] + [pt(60, 0.011)]
+    assert closed_loop_knee(flat) == 3
+    # Strictly increasing, never saturating → no knee, as find_knee.
+    assert closed_loop_knee(
+        [pt(r, 0.01) for r in [10, 20, 40, 80]]) is None
+
+
+def test_refusal_reason_quotes_min_load_point():
+    # When every point misses the SLO, the rejection must quote the
+    # MIN-load latency (how far off the config is at its best), not the
+    # saturated tail.
+    f = profile_cell(CellConfig("base"), "agentic", [4.0, 32.0, 128.0],
+                     num_requests=48)
+    rps, reason = sustainable_rps(
+        f, SloTarget(ttft_p99_s=1e-6, tpot_p99_s=1e-9))
+    assert rps == 0.0
+    lo = f.points[0]
+    assert f"ttft_p99={lo.ttft_p99_s:.4f}s" in reason
+
+
+# -- the mocker-parity simulator ---------------------------------------------
+
+
+def test_feature_axes_change_timing():
+    base = cell_timing(CellConfig("base"))
+    int8 = cell_timing(CellConfig("i", kv_quant="int8"))
+    spec = cell_timing(CellConfig("s", spec_decode=4))
+    packed = cell_timing(CellConfig("p", packed_prefill=True))
+    tp2 = cell_timing(CellConfig("t", tp=2))
+    # int8 shrinks the KV-bandwidth (per-seq) term only.
+    assert int8.decode_ms_per_seq < base.decode_ms_per_seq
+    assert int8.decode_base_ms == base.decode_base_ms
+    assert int8.prefill_ms_per_token == base.prefill_ms_per_token
+    # spec decode speeds both decode terms, not prefill.
+    assert spec.decode_base_ms < base.decode_base_ms
+    assert spec.prefill_ms_per_token == base.prefill_ms_per_token
+    # packed prefill speeds prefill only.
+    assert packed.prefill_ms_per_token < base.prefill_ms_per_token
+    assert packed.decode_base_ms == base.decode_base_ms
+    # tp2 speeds everything, sublinearly per chip (0.91 efficiency).
+    assert tp2.prefill_ms_per_token > base.prefill_ms_per_token / 2
+    assert tp2.prefill_ms_per_token < base.prefill_ms_per_token
+
+
+def test_duty_axis_binds():
+    # duty < 1 gates prefill to every round(1/duty)-th step while the
+    # fleet decodes (the engine's mixed_prefill_duty semantics) — it
+    # must actually show up in the frontier, not profile identically to
+    # base (budget-scaling never bound at swept traffic).
+    loads = [8.0, 32.0]
+    base = profile_cell(CellConfig("base"), "agentic", loads,
+                        num_requests=48)
+    half = profile_cell(CellConfig("duty-half", duty=0.5), "agentic",
+                        loads, num_requests=48)
+    assert half.points[0].ttft_p99_s > base.points[0].ttft_p99_s
+
+
+def test_knee_concurrency_tracks_planned_cell(smoke):
+    # dynamo_top HEADRM measures live workers against the knee of the
+    # cell the plan DEPLOYS, not whatever cell happened to be swept
+    # first.
+    plan = smoke["plan"]
+    meta = smoke["profile"]["meta"]["capacity"]
+    chosen = next(f for f in smoke["frontiers"]
+                  if f.cell.name == plan.cell["name"])
+    assert meta["knee_concurrency_per_worker"] == pytest.approx(
+        chosen.knee.mean_inflight / chosen.cell.workers)
+
+
+def test_prefix_cache_hits_skip_prefill_work():
+    recs = make_traffic("agentic", 32)
+    s = simulate_cell(CellConfig("base"), recs)
+    assert len(s.ttft_busy_s) == 32
+    # The first request of a root pays the full context prefill; later
+    # sharers skip the cached blocks — busy TTFT must reflect that.
+    assert min(s.ttft_busy_s) < max(s.ttft_busy_s) / 2
+
+
+def test_simulator_is_deterministic(smoke):
+    again = run_smoke(None)
+    assert (json.dumps(again["profile"], sort_keys=True)
+            == json.dumps(smoke["profile"], sort_keys=True))
+    assert again["plan"].to_dict() == smoke["plan"].to_dict()
+
+
+def test_frontier_latency_rises_with_load(smoke):
+    for f in smoke["frontiers"]:
+        lats = [p.ttft_p99_s for p in f.points]
+        # Saturated end must be far above the unloaded end (that's what
+        # makes a knee findable), and the knee must exist in-range.
+        assert lats[-1] > 2 * max(lats[0], 1e-6)
+        assert f.knee_idx is not None
+        assert 0 <= f.knee_idx < len(f.points)
+
+
+# -- profile schema ----------------------------------------------------------
+
+
+def test_profile_round_trips_and_planner_consumes_it(smoke, tmp_path):
+    from dynamo_tpu.planner.interpolation import (
+        DecodeInterpolator,
+        PrefillInterpolator,
+        load_profile,
+        save_profile,
+    )
+    from dynamo_tpu.planner.sla import SlaObservation, SlaPlanner
+
+    path = str(tmp_path / "sla_profile.json")
+    save_profile(smoke["profile"], path)
+    loaded = load_profile(path)
+    assert loaded == json.loads(json.dumps(smoke["profile"]))
+    assert loaded["meta"]["schema_version"] == 2
+    assert loaded["meta"]["capacity"]["plan"]["feasible"] is True
+
+    # The interpolators read the v1 grids and ignore meta entirely.
+    pre = PrefillInterpolator(loaded)
+    dec = DecodeInterpolator(loaded)
+    assert pre.interpolate_ttft(256) > 0
+    assert dec.interpolate_itl(0.5, 256) > 0
+
+    class Conn:
+        def __init__(self):
+            self.n = 1
+
+        def replicas(self):
+            return self.n
+
+        async def add_worker(self):
+            self.n += 1
+
+        async def remove_worker(self):
+            self.n -= 1
+
+    planner = SlaPlanner(loaded, observe=lambda: SlaObservation(),
+                         decode_connector=Conn(),
+                         prefill_connector=Conn())
+    d = None
+    for _ in range(3):
+        d = planner.decide(SlaObservation(
+            num_requests=200, avg_isl=216, avg_osl=16,
+            ttft_s=0.05, itl_s=0.008))
+    assert d.num_prefill >= 1 and d.num_decode >= 1
+
+
+# -- capacity model ----------------------------------------------------------
+
+
+def test_pinned_cheapest_fleet(smoke):
+    """The acceptance fixture: SMOKE_SLO at 40 rps on the agentic mix.
+    The sweep is a pure virtual clock, so this is byte-stable; drift
+    means the timing model changed and the pin must be re-derived
+    consciously."""
+    plan = smoke["plan"]
+    assert plan.feasible
+    assert plan.cell["name"] == "int8+spec+packed"
+    assert plan.replicas == 3
+    assert plan.total_chips == 3
+    assert plan.per_replica_rps == 16.0
+    # The composed cell must beat the plain ones: base sustains less.
+    by_name = {f.cell.name: f for f in smoke["frontiers"]}
+    base_rps, _ = sustainable_rps(by_name["base"], SMOKE_SLO)
+    assert base_rps < plan.per_replica_rps
+
+
+def test_capacity_refuses_over_slo(smoke):
+    plan = plan_capacity(smoke["frontiers"],
+                         SloTarget(ttft_p99_s=0.001, tpot_p99_s=1e-4),
+                         40.0)
+    assert not plan.feasible
+    assert plan.cell is None
+    assert len(plan.rejected) == len(smoke["frontiers"])
+    assert all("over SLO" in r["reason"] for r in plan.rejected)
+
+
+def test_capacity_respects_replica_cap(smoke):
+    plan = plan_capacity(smoke["frontiers"], SMOKE_SLO, 10_000.0,
+                         max_replicas=3)
+    assert not plan.feasible
+    assert any("replicas" in r["reason"] for r in plan.rejected)
+
+
+def test_agreement_tolerance_semantics():
+    assert agreement(0.1, 0.15)                       # within factor
+    assert agreement(0.0, 0.005)                      # within atol
+    assert not agreement(0.1, 0.1 * (AGREEMENT_FACTOR + 1))
+    assert not agreement(0.0, AGREEMENT_ATOL_S * 20)  # zero + far: no
+    assert not agreement(0.1, 0.0)                    # no scrape data
+
+
+# -- traffic mixes -----------------------------------------------------------
+
+
+def test_traffic_mixes_shapes():
+    ag = make_traffic("agentic", 48)
+    lc = make_traffic("long_context", 48)
+    di = make_traffic("diurnal", 48)
+    assert len(ag) == len(lc) == len(di) == 48
+    # Agentic shares prefixes; long-context never does.
+    assert len({tuple(r.hash_ids) for r in ag}) < 48
+    assert len({tuple(r.hash_ids) for r in lc}) == 48
+    assert lc[0].input_length > ag[0].input_length
+    # Diurnal: bursty — inter-arrival gaps vary ~4x trough-to-peak.
+    gaps = [b.timestamp - a.timestamp for a, b in zip(di, di[1:])]
+    assert max(gaps) > 2.5 * min(gaps)
+    with pytest.raises(ValueError):
+        make_traffic("nope", 8)
+
+
+def test_scale_to_rate_preserves_shape():
+    di = make_traffic("diurnal", 48)
+    scaled = scale_to_rate(di, 100.0)
+    span_s = (scaled[-1].timestamp - scaled[0].timestamp) / 1e3
+    assert (len(scaled) - 1) / span_s == pytest.approx(100.0, rel=1e-6)
+    gaps0 = [b.timestamp - a.timestamp for a, b in zip(di, di[1:])]
+    gaps1 = [b.timestamp - a.timestamp
+             for a, b in zip(scaled, scaled[1:])]
+    ratios = [g1 / g0 for g0, g1 in zip(gaps0, gaps1)]
+    assert max(ratios) == pytest.approx(min(ratios), rel=1e-9)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_smoke_emits_planner_loadable_profile(tmp_path):
+    """The acceptance command: `python -m benchmarks.sla_profiler
+    --smoke` emits a profile SlaPlanner loads unchanged and prints the
+    pinned capacity answer."""
+    from benchmarks.sla_profiler import main
+
+    out = str(tmp_path / "prof.json")
+    assert main(["--smoke", "--out", out]) == 0
+    from dynamo_tpu.planner.interpolation import load_profile
+    from dynamo_tpu.planner.sla import SlaObservation, SlaPlanner
+
+    prof = load_profile(out)
+
+    class Conn:
+        n = 1
+
+        def replicas(self):
+            return self.n
+
+    SlaPlanner(prof, observe=lambda: SlaObservation(),
+               decode_connector=Conn())
+    plan = prof["meta"]["capacity"]["plan"]
+    assert plan["feasible"] and plan["cell"]["name"] == "int8+spec+packed"
+
+
+# -- fleet validation (the observability-plane cross-check) ------------------
+
+
+def _drive_fleet_and_scrape(num_workers, num_requests, rps,
+                            profile_path, speedup=0.1):
+    """Run the mocker fleet, scrape it with the REAL dynamo_top CLI
+    (--once --json --profile), return (modeled stats, snapshot).
+
+    `speedup < 1` STRETCHES the mocker's simulated time: per-step
+    event-loop overhead (which a 100-engine loop pays in milliseconds)
+    shrinks relative to simulated latency, so the scrape measures the
+    queueing model instead of asyncio scheduling.  0.1 keeps the
+    overhead term under the documented 10 ms absolute tolerance even
+    with the rest of the suite contending for the CPU (0.25 was
+    observed marginal there: ~46 ms wall overhead → 11.6 ms sim)."""
+    cell = CellConfig("fleet", workers=num_workers)
+    records = scale_to_rate(make_traffic("agentic", num_requests), rps)
+    modeled = simulate_cell(cell, records)
+
+    async def drive():
+        cp_port, summary, teardown = await run_fleet(
+            cell, records, num_workers=num_workers, slo=SMOKE_SLO,
+            speedup_ratio=speedup)
+        try:
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable,
+                os.path.join(REPO, "tools", "dynamo_top.py"),
+                "--control-plane", f"127.0.0.1:{cp_port}",
+                "--once", "--json", "--profile", profile_path,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE, cwd=REPO)
+            out, err = await asyncio.wait_for(proc.communicate(), 120)
+            assert proc.returncode == 0, err.decode()[-2000:]
+            return summary, json.loads(out.decode())
+        finally:
+            await teardown()
+
+    summary, snapshot = asyncio.run(asyncio.wait_for(drive(), 300))
+    return modeled, summary, snapshot
+
+
+@pytest.mark.slow
+def test_fleet_100_workers_matches_model(tmp_path):
+    """The fleet-scale acceptance check: 100 real MockEngine workers,
+    each with its own status server, driven under generated agentic
+    load; TTFT/TPOT scraped via the real `dynamo_top --once --json`
+    must agree with the modeled values within the documented tolerance,
+    every worker row must carry an SLO verdict, and `--profile` must
+    fill the capacity-headroom column."""
+    from benchmarks.sla_profiler import (
+        fleet_quantiles_from_snapshot,
+        percentile,
+    )
+
+    profile_path = str(tmp_path / "prof.json")
+    run_smoke(profile_path)
+    modeled, summary, snapshot = _drive_fleet_and_scrape(
+        num_workers=100, num_requests=300, rps=1200.0,
+        profile_path=profile_path)
+
+    rows = [p for p in snapshot["processes"]
+            if not p.get("unreachable")]
+    assert len(rows) == 100
+    scraped = fleet_quantiles_from_snapshot(snapshot)
+    assert scraped["workers"] == 100
+    # Every worker carries an SLO verdict from its own monitor.
+    assert all(r.get("slo_state") in ("OK", "WARN", "PAGE")
+               for r in rows)
+    # --profile fills headroom: drained fleet, inflight 0 → 100%.
+    assert all(r.get("capacity_headroom") == pytest.approx(1.0)
+               for r in rows)
+
+    mod_ttft = percentile(modeled.ttft_s, 50)
+    mod_tpot = percentile(modeled.tpot_s, 50)
+    assert agreement(mod_ttft, scraped["ttft_p50_s"]), (
+        f"modeled ttft_p50 {mod_ttft} vs scraped "
+        f"{scraped['ttft_p50_s']}")
+    assert agreement(mod_tpot, scraped["tpot_p50_s"]), (
+        f"modeled tpot_p50 {mod_tpot} vs scraped "
+        f"{scraped['tpot_p50_s']}")
+    # The driver's own wall measurements corroborate the scrape (same
+    # histograms, so quantiles can only differ by bucket rounding).
+    assert agreement(summary["ttft_p50_s"], scraped["ttft_p50_s"],
+                     factor=1.5)
+
+
+def test_fleet_smoke_cell_agrees_inprocess():
+    """Tier-1-sized version: 4 workers through the in-process collector
+    (the bench_gate smoke runs the same path; this keeps the contract
+    pinned even when the gate is skipped)."""
+    from benchmarks.sla_profiler import validate_fleet_model
+
+    res = validate_fleet_model(
+        CellConfig("base"), "agentic", 30.0, num_workers=4,
+        num_requests=24, slo=SMOKE_SLO)
+    assert res["ttft_p50_agree"], res
+    assert res["tpot_p50_agree"], res
+    assert res["scraped"]["workers"] == 4
+    assert res["scraped"]["slo_states"]

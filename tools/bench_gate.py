@@ -347,6 +347,97 @@ def prefix_fleet_checks() -> dict:
     }
 
 
+def sla_profiler_checks() -> dict:
+    """ISSUE 11 smoke: the SLA profiler + capacity frontier on CPU —
+    the deterministic mocker-cell sweep must emit a profile SlaPlanner
+    loads unchanged, the capacity model must name the PINNED cheapest
+    fleet for the smoke (SLO, traffic-mix) fixture, a fabricated
+    over-SLO requirement must make it REFUSE (naming every rejected
+    config), and a mocker fleet cell driven through real MockEngines +
+    status servers must agree with the modeled TTFT/TPOT when scraped
+    through dynamo_top's collector (the documented factor-2/10ms
+    tolerance)."""
+    from benchmarks.sla_profiler import (
+        CellConfig,
+        SloTarget,
+        find_knee,
+        plan_capacity,
+        run_smoke as profiler_smoke,
+        validate_fleet_model,
+    )
+    from dynamo_tpu.planner.interpolation import (
+        DecodeInterpolator,
+        PrefillInterpolator,
+    )
+    from dynamo_tpu.planner.sla import SlaObservation, SlaPlanner
+
+    res = profiler_smoke(None)
+    plan = res["plan"]
+    profile = res["profile"]
+
+    # The planner consumes the profiler's profile UNCHANGED (meta and
+    # all), and a loaded interval produces a real scaling decision.
+    planner_ok = True
+    try:
+        PrefillInterpolator(profile)
+        DecodeInterpolator(profile)
+
+        class _Conn:
+            n = 1
+
+            def replicas(self):
+                return self.n
+
+        planner = SlaPlanner(profile, observe=lambda: SlaObservation(),
+                             decode_connector=_Conn())
+        d = planner.decide(SlaObservation(
+            num_requests=100, avg_isl=216, avg_osl=16,
+            ttft_s=0.05, itl_s=0.008))
+        planner_ok = d.num_decode >= 1
+    except Exception:
+        planner_ok = False
+
+    # Fabricated over-SLO requirement: no profiled config can hold a
+    # 1ms TTFT / 0.1ms TPOT SLO — the model must refuse, not deploy.
+    refused = plan_capacity(res["frontiers"],
+                            SloTarget(ttft_p99_s=0.001,
+                                      tpot_p99_s=0.0001), 40.0)
+
+    # Mocker fleet cell: real MockEngines + per-worker /metrics +
+    # /debug/slo scraped via dynamo_top's collector, vs the model.
+    fleet = validate_fleet_model(
+        CellConfig("base"), "agentic", 30.0, num_workers=4,
+        num_requests=32, slo=SloTarget(ttft_p99_s=0.25,
+                                       tpot_p99_s=0.012))
+
+    # Kneedle flags the max-deviation-below-the-chord point — the middle
+    # of the bend (idx 4 = load 16 here), not its onset.
+    knee = find_knee([1, 2, 4, 8, 16, 32],
+                     [10.0, 10.5, 11.0, 12.0, 80.0, 400.0])
+    return {
+        "sla_profile_loads_in_planner": planner_ok,
+        "sla_plan_feasible": plan.feasible,
+        # Pinned fixture (SMOKE_SLO at SMOKE_RPS on the agentic mix):
+        # the sweep is a pure virtual clock, so the cheapest fleet is
+        # byte-stable — any drift is a model change and must be looked
+        # at, not averaged away.
+        "sla_plan_cell": (plan.cell or {}).get("name"),
+        "sla_plan_pinned": ((plan.cell or {}).get("name")
+                            == "int8+spec+packed"
+                            and plan.replicas == 3
+                            and plan.total_chips == 3),
+        "sla_over_slo_refused": (not refused.feasible
+                                 and len(refused.rejected) > 0),
+        "sla_fleet_ttft_agree": fleet["ttft_p50_agree"],
+        "sla_fleet_tpot_agree": fleet["tpot_p50_agree"],
+        # Boolean, not the raw count: the gate only fails on literal
+        # False, so a partial scrape (3/4, or None) must not slip by.
+        "sla_fleet_all_workers_scraped": (
+            fleet["scraped"].get("workers") == 4),
+        "sla_knee_detected_at_bend": knee == 4,
+    }
+
+
 def run_smoke(args) -> int:
     """Mocker-backed smoke of the whole measurement loop — CPU-only, no
     JAX device work, fast enough for tier-1.
@@ -376,7 +467,13 @@ def run_smoke(args) -> int:
     10. prefill plane (ISSUE 10): packed ragged vs padded prefill on the
         tiny model with byte-identical first tokens, and the
         packed_vs_padded_tok_s_ratio floor verified to fail a
-        fabricated slow-packed run.
+        fabricated slow-packed run;
+    11. SLA profiler + capacity frontier (ISSUE 11): the deterministic
+        mocker-cell sweep emits a profile SlaPlanner loads unchanged,
+        the capacity model names the pinned cheapest fleet and REFUSES
+        a fabricated over-SLO requirement, and a mocker fleet cell
+        scraped through dynamo_top agrees with the model within the
+        documented tolerance.
     """
     # The sharded checks need a multi-device rig: force the 8-way
     # virtual-CPU platform BEFORE anything imports jax (this smoke is
@@ -506,6 +603,7 @@ def run_smoke(args) -> int:
         **prefill_plane_checks(),
         **prefix_fleet_checks(),
         **sharded_decode_checks(),
+        **sla_profiler_checks(),
     }
     ok = all(v is not False for v in checks.values())
     print(json.dumps({"smoke": "pass" if ok else "fail", **checks},

@@ -118,12 +118,34 @@ def hist_quantile(samples: List[Sample], name: str,
 # -- per-process summarization ------------------------------------------
 
 
+def knee_concurrency_from_profile(profile: dict) -> Optional[float]:
+    """Per-worker knee concurrency out of an SLA-profiler profile
+    (`benchmarks/sla_profiler.py` meta schema v2); None for v1 profiles
+    or profiles whose sweep never found a knee."""
+    try:
+        v = profile["meta"]["capacity"]["knee_concurrency_per_worker"]
+    except (KeyError, TypeError):
+        return None
+    return float(v) if v else None
+
+
 def summarize(component: str, address: str, samples: List[Sample],
-              slo: Optional[dict]) -> dict:
-    """One `dynamo top` row from a process's scraped series."""
-    inflight = total(samples, "dynamo_frontend_inflight_requests")
-    if inflight is None:
-        inflight = total(samples, "dynamo_worker_request_active_slots")
+              slo: Optional[dict],
+              knee_concurrency: Optional[float] = None) -> dict:
+    """One `dynamo top` row from a process's scraped series.
+
+    `knee_concurrency`: the profiled per-worker saturation knee
+    (`--profile sla_profile.json`) — fills the HEADRM column with how
+    far this worker's observed inflight load sits from the knee
+    (1.0 = idle, 0 = at the knee, negative = past it).  Worker rows
+    only: a frontend's inflight gauge is the FLEET total, which a
+    per-worker knee would misread as catastrophic overload."""
+    frontend_inflight = total(samples,
+                              "dynamo_frontend_inflight_requests")
+    worker_inflight = total(samples,
+                            "dynamo_worker_request_active_slots")
+    inflight = (frontend_inflight if frontend_inflight is not None
+                else worker_inflight)
     kv_active = total(samples, "dynamo_kv_pool_active_blocks",
                       tier="device")
     kv_capacity = total(samples, "dynamo_kv_pool_capacity_blocks",
@@ -146,6 +168,11 @@ def summarize(component: str, address: str, samples: List[Sample],
     slo_state = None
     if slo is not None:
         slo_state = slo.get("state") if slo.get("enabled") else "—"
+    headroom = None
+    if (knee_concurrency and knee_concurrency > 0
+            and worker_inflight is not None
+            and frontend_inflight is None):
+        headroom = 1.0 - worker_inflight / knee_concurrency
     return {
         "component": component,
         "address": address,
@@ -171,6 +198,7 @@ def summarize(component: str, address: str, samples: List[Sample],
         "slo_state": slo_state,
         "slo_max_burn": (max_burn(slo)
                          if slo and slo.get("enabled") else None),
+        "capacity_headroom": headroom,
     }
 
 
@@ -206,10 +234,13 @@ async def _scrape(addr: str, timeout: float) -> Tuple[Optional[str],
     return metrics_text, slo
 
 
-async def collect(cp_addr: str, timeout: float = 3.0) -> dict:
+async def collect(cp_addr: str, timeout: float = 3.0,
+                  knee_concurrency: Optional[float] = None) -> dict:
     """One fleet snapshot: discover via `status_endpoints/`, scrape
     every process concurrently, summarize.  Importable (the mini-fleet
-    e2e test calls this in-process; the CLI wraps it)."""
+    e2e test calls this in-process; the CLI wraps it).
+    `knee_concurrency` (from `--profile`) fills per-row capacity
+    headroom."""
     host, _, port = cp_addr.rpartition(":")
     cp = ControlPlaneClient(host or "127.0.0.1", int(port))
     await cp.start()
@@ -237,7 +268,8 @@ async def collect(cp_addr: str, timeout: float = 3.0) -> dict:
                               "unreachable": True})
             continue
         processes.append(summarize(component, addr,
-                                   parse_prom(text or ""), slo))
+                                   parse_prom(text or ""), slo,
+                                   knee_concurrency=knee_concurrency))
     return {"generated_at": time.time(), "control_plane": cp_addr,
             "processes": processes}
 
@@ -279,6 +311,9 @@ COLUMNS = (
     ("TPOTp50", 8, lambda r: _fmt(r.get("tpot_p50_s"), "ms")),
     ("TPOTp99", 8, lambda r: _fmt(r.get("tpot_p99_s"), "ms")),
     ("SLO", 5, lambda r: r.get("slo_state") or "—"),
+    # How far from the profiled saturation knee (--profile): 100% idle,
+    # 0% at the knee, negative past it.
+    ("HEADRM", 7, lambda r: _fmt(r.get("capacity_headroom"), "pct")),
 )
 
 
@@ -298,8 +333,18 @@ def render_table(snapshot: dict) -> str:
 
 
 async def _run(args) -> int:
+    knee = None
+    if args.profile:
+        from dynamo_tpu.planner.interpolation import load_profile
+
+        knee = knee_concurrency_from_profile(load_profile(args.profile))
+        if knee is None:
+            print(f"# profile {args.profile} carries no knee "
+                  "concurrency (v1 schema or kneeless sweep); HEADRM "
+                  "stays empty", file=sys.stderr)
     while True:
-        snapshot = await collect(args.control_plane, timeout=args.timeout)
+        snapshot = await collect(args.control_plane, timeout=args.timeout,
+                                 knee_concurrency=knee)
         if args.json:
             print(json.dumps(snapshot, indent=None if args.once else 2))
         else:
@@ -323,6 +368,10 @@ def main(argv=None) -> int:
                    help="refresh interval (seconds)")
     p.add_argument("--timeout", type=float, default=3.0,
                    help="per-process scrape timeout (seconds)")
+    p.add_argument("--profile", default=None,
+                   help="SLA-profiler profile JSON "
+                        "(benchmarks/sla_profiler.py); enables the "
+                        "HEADRM capacity-headroom column")
     args = p.parse_args(argv)
     try:
         return asyncio.run(_run(args))
