@@ -485,12 +485,16 @@ def main():
     prefix_fleet = _asyncio.run(
         _asyncio.wait_for(run_prefix_fleet(), 120))
 
-    # Sharded fast-decode plane (ISSUE 9): tok/s/chip + per-chip mbu at
-    # tp2/dp2 vs meshless, through the same make_sharded_window /
-    # make_sharded_greedy_step programs a served sharded engine runs.
-    # Gate floor: sharded_decode.tok_s_per_chip_ratio >= 0.8 on TPU
-    # rounds with >= 2 chips; single-chip rigs report the modes as
-    # skipped and the floor is skipped too (never silently passed).
+    # Sharded fast-decode plane (ISSUE 9; pp/sp + composition matrix by
+    # ISSUE 12): tok/s/chip + per-chip mbu at tp2/dp2/sp2/pp2 vs
+    # meshless, through the same unified-builder / stage programs a
+    # served sharded engine runs, plus fused-vs-unfused slopes and the
+    # compose_matrix cell statuses.  Gate floors:
+    # sharded_decode.tok_s_per_chip_ratio >= 0.8 and
+    # sharded_decode.pp_fused_vs_single >= 1.2 on TPU rounds with >= 2
+    # chips; any "rejected" compose_matrix cell fails outright.
+    # Single-chip rigs report the modes as skipped and the floors are
+    # skipped too (never silently passed).
     from dynamo_tpu.bench.sharded_decode import run_sharded_decode
 
     sharded_decode = run_sharded_decode(

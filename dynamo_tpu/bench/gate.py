@@ -108,6 +108,15 @@ class FloorSpec:
 #   below 0.8 means the fast decode plane regressed to the gather path
 #   or the sharded fused step broke.  Only present when the round ran on
 #   >= 2 chips (single-chip rigs skip the modes and the floor).
+# - sharded_decode.pp_fused_vs_single >= 1.2 — ISSUE 12: the all-in-one
+#   pp stage program (schedule + fused argmax, [B] tokens out) must beat
+#   the unfused loop it replaced (schedule dispatch returning [B, V] f32
+#   logits + a separate argmax dispatch + host feedback) by >= 1.2x per
+#   step.  The unfused loop pays an extra eager dispatch AND a
+#   full-vocab f32 device->host-visible output per token — on real
+#   dispatch-latency-bound serving that overhead is the r5 cliff, so
+#   parity-or-worse means the fused program silently fell back or the
+#   schedule regressed.  Only present when the round measured pp2.
 TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("mbu", minimum=0.75),
     FloorSpec("mixed_prefill_decode.interference_ratio", minimum=0.80),
@@ -116,6 +125,7 @@ TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("spec_decode.modeled_decode_speedup", minimum=1.3),
     FloorSpec("prefix_fleet.remote_hit_rate", minimum=0.2),
     FloorSpec("sharded_decode.tok_s_per_chip_ratio", minimum=0.8),
+    FloorSpec("sharded_decode.pp_fused_vs_single", minimum=1.2),
     FloorSpec("prefill_plane.packed_vs_padded_tok_s_ratio", minimum=1.2),
 )
 
@@ -194,6 +204,25 @@ def _check_floors(new: Dict, res: GateResult,
         if spec.maximum is not None and v > spec.maximum:
             res.floor_failures.append({
                 "metric": spec.key, "ceiling": spec.maximum, "new": v})
+            res.ok = False
+    _check_compose_matrix(new, res)
+
+
+def _check_compose_matrix(new: Dict, res: GateResult) -> None:
+    """ISSUE 12: the sharded_decode.compose_matrix summary must carry NO
+    "rejected" cell — a combo the capability table says composes but
+    whose builder raised during measurement.  "ok", "declared: ..." and
+    "skipped: ..." statuses are fine; a rejected cell fails the gate
+    outright (it is a broken composition, not a slow one)."""
+    cm = _lookup(new, "sharded_decode.compose_matrix")
+    if not isinstance(cm, dict):
+        return
+    for cell, info in cm.items():
+        status = info.get("status") if isinstance(info, dict) else info
+        if isinstance(status, str) and status.startswith("rejected"):
+            res.floor_failures.append({
+                "metric": f"sharded_decode.compose_matrix.{cell}",
+                "status": status})
             res.ok = False
 
 

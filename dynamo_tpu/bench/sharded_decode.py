@@ -1,4 +1,5 @@
-"""Sharded fast-decode plane benchmark (ISSUE 9 leg 4).
+"""Sharded fast-decode plane benchmark (ISSUE 9 leg 4; pp/sp + the
+composition matrix added by ISSUE 12).
 
 Measures whether tok/s/chip on a sharded engine approaches the meshless
 number — the composition claim of the fast decode plane (int8 KV, Pallas
@@ -7,7 +8,22 @@ PR every multi-chip engine decoded on the slow bf16 GSPMD-gather path
 with the r5 single-step cliff; this section is what keeps that from
 silently coming back.
 
-Per mesh mode (tp2 / dp2) the section reports:
+ISSUE 12 additions:
+
+- pp2 / sp2 modes with FUSED-vs-UNFUSED slope timings: `single_unfused_ms`
+  is the r5-cliff dispatch shape (step returning [B, V] logits + a
+  separate argmax dispatch + feedback) and `fused_vs_unfused` the ratio
+  the fused program must win; the headline `pp_fused_vs_single` (pp2's
+  ratio) carries a TPU gate floor >= 1.2 — the all-in-one stage program
+  must measurably kill the pp half of the cliff.
+- `compose_matrix`: one status per (feature x mesh) cell — "ok" with
+  tok/s/chip when measured, "declared: <reason>" when the capability
+  table (parallel.sharding.plane_capability) declares it impossible,
+  "skipped: ..." on small rigs, and "rejected: <error>" when a builder
+  that should compose raises — which FAILS the gate (bench/gate.py), so
+  a regressing cell can't hide behind a pretty headline number.
+
+Per mesh mode (tp2 / dp2 / sp2 / pp2) the section reports:
 
 - `window_step_ms` / `tok_s` / `tok_s_per_chip` — the fused K-token
   decode window through parallel.sharding.make_sharded_window, exactly
@@ -137,7 +153,8 @@ def _measure_meshless(cfg, params, batch, ctx, block, width, window,
 
 
 def _measure_mesh(cfg, params, mesh, batch, ctx, block, width, window,
-                  num_blocks, kv_quant=False):
+                  num_blocks, kv_quant=False, with_unfused=False,
+                  with_single=True):
     from dynamo_tpu.engine import kv_cache as kvc
     from dynamo_tpu.parallel.sharding import (
         cache_pspecs, make_sharded_greedy_step, make_sharded_window,
@@ -155,9 +172,6 @@ def _measure_mesh(cfg, params, mesh, batch, ctx, block, width, window,
     win = make_sharded_window(cfg, block, mesh, window, greedy_only=True,
                               use_pallas_decode=pallas,
                               kv_quant=kv_quant)
-    fused = make_sharded_greedy_step(cfg, block, mesh,
-                                     use_pallas_decode=pallas,
-                                     kv_quant=kv_quant)
     sparams = shard_pytree(params, param_pspecs(cfg), mesh)
     cache_specs = cache_pspecs(cfg.num_layers, kv_quant=kv_quant)
     bt = _block_tables(batch, width)
@@ -171,8 +185,81 @@ def _measure_mesh(cfg, params, mesh, batch, ctx, block, width, window,
                 jnp.ones((batch,), jnp.int32))
 
     win_s = _window_loop(win, sparams, fresh, batch, ctx, bt, window)
+    if not with_single:
+        # int8 re-pass keeps only the window time — don't compile two
+        # more single-step programs to throw their timings away.
+        return win_s, None, None
+    fused = make_sharded_greedy_step(cfg, block, mesh,
+                                     use_pallas_decode=pallas,
+                                     kv_quant=kv_quant)
     single_s = _single_loop(fused, sparams, fresh, batch, ctx, bt)
-    return win_s, single_s
+    unfused_s = None
+    if with_unfused:
+        from dynamo_tpu.parallel.sharding import make_sharded_step
+
+        step = make_sharded_step(cfg, block, mesh,
+                                 use_pallas_decode=pallas,
+                                 kv_quant=kv_quant)
+        argmax = jax.jit(lambda l: jnp.argmax(l, -1).astype(jnp.int32))
+
+        def unfused(p, cache, toks, pos, sl, bts, sp):
+            # The r5-cliff dispatch shape: full [B, V] f32 logits out of
+            # the step, then a SEPARATE argmax dispatch — what every
+            # sharded single-step decode paid before the fused program.
+            logits, cache = step(p, cache, toks, pos, sl, bts, sp)
+            return argmax(logits), cache
+
+        unfused_s = _single_loop(unfused, sparams, fresh, batch, ctx, bt)
+    return win_s, single_s, unfused_s
+
+
+def _measure_pp(cfg, params, mesh, batch, ctx, block, width, window,
+                num_blocks, n_microbatches=2, kv_quant=False,
+                with_single=True):
+    """pp2 mode (ISSUE 12 leg 3): the schedule-looping decode window,
+    the all-in-one fused greedy stage program, and the UNFUSED loop it
+    replaces (pp step → [B, V] logits → separate argmax → feedback).
+    `with_single=False` builds/times ONLY the window (the int8 re-pass
+    keeps just w8_s — compiling two more stage programs to discard
+    their timings would burn bench/smoke wall-clock for nothing)."""
+    from dynamo_tpu.engine import kv_cache as kvc
+    from dynamo_tpu.parallel.pipeline import (
+        init_pp_cache, make_pp_decode_window, make_pp_greedy_step,
+        make_pp_step, pp_cache_pspecs, pp_param_pspecs,
+        stack_layer_params)
+    from dynamo_tpu.parallel.sharding import shard_pytree
+
+    sparams = shard_pytree(stack_layer_params(params),
+                           pp_param_pspecs(cfg), mesh)
+    cache_specs = pp_cache_pspecs(kv_quant)
+
+    def fresh():
+        return (shard_pytree(
+                    init_pp_cache(kvc.KvCacheConfig.for_model(
+                        cfg, num_blocks=num_blocks, block_size=block,
+                        kv_quant="int8" if kv_quant else "none")),
+                    cache_specs, mesh),
+                jnp.ones((batch,), jnp.int32))
+
+    bt = _block_tables(batch, width)
+    win = make_pp_decode_window(cfg, block, mesh, n_microbatches, window,
+                                greedy_only=True, kv_quant=kv_quant)
+    win_s = _window_loop(win, sparams, fresh, batch, ctx, bt, window)
+    if not with_single:
+        return win_s, None, None
+    fused = make_pp_greedy_step(cfg, block, mesh, n_microbatches,
+                                kv_quant=kv_quant)
+    step = make_pp_step(cfg, block, mesh, n_microbatches,
+                        kv_quant=kv_quant)
+    argmax = jax.jit(lambda l: jnp.argmax(l, -1).astype(jnp.int32))
+
+    def unfused(p, cache, toks, pos, sl, bts, sp):
+        logits, cache = step(p, cache, toks, pos, sl, bts, sp)
+        return argmax(logits), cache
+
+    single_s = _single_loop(fused, sparams, fresh, batch, ctx, bt)
+    unfused_s = _single_loop(unfused, sparams, fresh, batch, ctx, bt)
+    return win_s, single_s, unfused_s
 
 
 def run_sharded_decode(cfg, params=None, *, batch: int = 64,
@@ -180,7 +267,7 @@ def run_sharded_decode(cfg, params=None, *, batch: int = 64,
                        window: int = 8,
                        hbm_bw: Optional[float] = None,
                        weight_bytes: Optional[int] = None,
-                       modes=("tp2", "dp2"),
+                       modes=("tp2", "dp2", "sp2", "pp2"),
                        with_int8: bool = True,
                        meshless_window_step_s: Optional[float] = None,
                        meshless_single_step_s: Optional[float] = None,
@@ -221,16 +308,40 @@ def run_sharded_decode(cfg, params=None, *, batch: int = 64,
                 * kvc.KvCacheConfig.for_model(
                     cfg, num_blocks=2, block_size=block)
                 .bytes_per_context_token)
-    mesh_cfgs = {"tp2": MeshConfig(tp=2), "dp2": MeshConfig(dp=2)}
+    mesh_cfgs = {"tp2": MeshConfig(tp=2), "dp2": MeshConfig(dp=2),
+                 "sp2": MeshConfig(sp=2), "pp2": MeshConfig(pp=2)}
+    matrix: Dict = {}
     for mode in modes:
         mcfg_ = mesh_cfgs[mode]
         if mcfg_.size > len(devices):
             out[mode] = {"skipped": f"needs {mcfg_.size} devices, "
                                     f"have {len(devices)}"}
+            matrix[f"fused_decode × {mode}"] = {
+                "status": f"skipped: needs {mcfg_.size} devices"}
+            continue
+        if mode == "pp2" and cfg.num_layers % 2:
+            out[mode] = {"skipped": f"pp2 needs an even layer count, "
+                                    f"model has {cfg.num_layers}"}
+            matrix[f"fused_decode × {mode}"] = {
+                "status": "skipped: odd layer count"}
             continue
         mesh = make_mesh(mcfg_, devices[:mcfg_.size])
-        w_s, s_s = _measure_mesh(cfg, params, mesh, batch, ctx, block,
-                                 width, window, num_blocks)
+        try:
+            if mode == "pp2":
+                w_s, s_s, u_s = _measure_pp(
+                    cfg, params, mesh, batch, ctx, block, width, window,
+                    num_blocks)
+            else:
+                w_s, s_s, u_s = _measure_mesh(
+                    cfg, params, mesh, batch, ctx, block, width, window,
+                    num_blocks, with_unfused=(mode == "sp2"))
+        except Exception as e:  # a composing cell that raises must FAIL
+            # the gate (bench/gate.py rejects "rejected" statuses) —
+            # never silently vanish from the section.
+            out[mode] = {"rejected": str(e)}
+            matrix[f"fused_decode × {mode}"] = {
+                "status": f"rejected: {e}"}
+            continue
         entry = {
             "window_step_ms": round(w_s * 1e3, 4),
             "single_step_ms": round(s_s * 1e3, 4),
@@ -240,23 +351,74 @@ def run_sharded_decode(cfg, params=None, *, batch: int = 64,
             # near the windowed per-token cost, not 2x over it.
             "single_vs_window": round(s_s / w_s, 3),
         }
+        if u_s is not None:
+            # Fused-vs-unfused: the fused program against the r5-cliff
+            # dispatch shape it replaces (ISSUE 12).
+            entry["single_unfused_ms"] = round(u_s * 1e3, 4)
+            entry["fused_vs_unfused"] = round(u_s / s_s, 3)
         if hbm_bw and weight_bytes:
             # Per-chip moved bytes: tp shards weights AND KV tp-ways; dp
             # replicates weights but each chip serves batch/dp rows of
-            # the (replicated-slot) cache.
+            # the (replicated-slot) cache; a pp stage streams its layer
+            # slice of both; sp replicates decode entirely (the honest
+            # per-chip mbu does NOT divide by sp — the win is prefill).
             if mode == "tp2":
                 per_chip = (weight_bytes + kv_bytes) / mcfg_.size
+            elif mode == "pp2":
+                per_chip = (weight_bytes + kv_bytes) / mcfg_.size
+            elif mode == "sp2":
+                per_chip = weight_bytes + kv_bytes
             else:
                 per_chip = weight_bytes + kv_bytes / mcfg_.size
             entry["mbu_per_chip"] = round(per_chip / w_s / hbm_bw, 4)
-        if mode == "tp2" and with_int8 and cfg.num_kv_heads >= 2:
-            w8_s, _ = _measure_mesh(cfg, params, mesh, batch, ctx, block,
-                                    width, window, num_blocks,
-                                    kv_quant=True)
-            entry["window_step_ms_int8"] = round(w8_s * 1e3, 4)
+        if (mode in ("tp2", "sp2", "pp2") and with_int8
+                and cfg.num_kv_heads >= 2):
+            try:
+                if mode == "pp2":
+                    w8_s, _, _ = _measure_pp(
+                        cfg, params, mesh, batch, ctx, block, width,
+                        window, num_blocks, kv_quant=True,
+                        with_single=False)
+                else:
+                    w8_s, _, _ = _measure_mesh(
+                        cfg, params, mesh, batch, ctx, block, width,
+                        window, num_blocks, kv_quant=True,
+                        with_single=False)
+                entry["window_step_ms_int8"] = round(w8_s * 1e3, 4)
+                matrix[f"int8 × {mode}"] = {"status": "ok"}
+            except Exception as e:
+                matrix[f"int8 × {mode}"] = {"status": f"rejected: {e}"}
         out[mode] = entry
+        matrix[f"fused_decode × {mode}"] = {
+            "status": "ok", "tok_s_per_chip": entry["tok_s_per_chip"]}
+    # Declared-impossible cells come from the ONE capability table, so
+    # the matrix summary and the engine's pointed errors can never
+    # drift (the README Notes line quotes the same reasons).
+    from dynamo_tpu.parallel.sharding import PlaneSpec, plane_capability
+
+    if len(devices) >= 2:
+        any2 = make_mesh(MeshConfig(tp=2), devices[:2])
+        pp2 = make_mesh(MeshConfig(pp=2), devices[:2])
+        for cell, (mesh_, plane, mh) in {
+            "spec × multihost": (any2, PlaneSpec(spec=True), True),
+            "spec × pp": (pp2, PlaneSpec(spec=True), False),
+            "pallas × dp_attention(non-local)": (
+                any2, PlaneSpec(use_pallas=True, dp_attention=True),
+                False),
+            "pallas × pp": (pp2, PlaneSpec(use_pallas=True), False),
+            "pallas × multihost": (any2, PlaneSpec(use_pallas=True),
+                                   True),
+        }.items():
+            cap = plane_capability(mesh_, plane, multihost=mh)
+            matrix[cell] = {"status": ("ok" if cap.ok
+                                       else f"declared: {cap.reason}")}
+    out["compose_matrix"] = matrix
     tp2 = out.get("tp2", {})
     if "tok_s_per_chip" in tp2 and meshless_tok_s:
         out["tok_s_per_chip_ratio"] = round(
             tp2["tok_s_per_chip"] / meshless_tok_s, 4)
+    pp2_entry = out.get("pp2", {})
+    if "fused_vs_unfused" in pp2_entry:
+        # Gate floor sharded_decode.pp_fused_vs_single >= 1.2 (TPU).
+        out["pp_fused_vs_single"] = pp2_entry["fused_vs_unfused"]
     return out

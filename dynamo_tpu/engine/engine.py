@@ -72,9 +72,12 @@ from dynamo_tpu.runtime.contracts import (
 from dynamo_tpu.runtime.metrics import EngineStepCounters
 from dynamo_tpu.tokens import TokenBlockSequence
 from dynamo_tpu.parallel.sharding import (
+    PlaneSpec,
     cache_pspecs,
+    check_plane,
     make_sharded_step,
     param_pspecs,
+    plane_capability,
     shard_pytree,
 )
 
@@ -104,10 +107,11 @@ class EngineConfig:
     # with per-token-per-head f32 scales and dequantizes inside the
     # decode kernel's VMEM tile — HBM bytes per context token drop to
     # ~0.53x bf16 at serving geometry (kv_cache.py module docstring).
-    # Composes with single-process tp/dp meshes, dp_attention and
-    # dp-local decode (scales shard with their kv heads / slots —
-    # ISSUE 9); pp, ring-SP and multi-process meshes still reject at
-    # construction with pointed errors.
+    # Composes with EVERY mesh (ISSUE 12): tp/dp/dp_attention/dp-local
+    # (scales shard with their kv heads / slots), ring-SP (the chunk
+    # exchange rotates int8 rows + scales), pp (stacked scale buffers)
+    # and multi-process lockstep meshes; any future impossible combo is
+    # declared in parallel.sharding.plane_capability, not here.
     kv_quant: str = "none"
     mesh: Optional[object] = None          # jax.sharding.Mesh for tp/ep
     # Batch-sharded attention with slot-sharded KV (tp beyond the kv-head
@@ -227,29 +231,27 @@ class EngineCore:
             from dynamo_tpu.parallel.multihost import mesh_spans_processes
 
             self._mh = mesh_spans_processes(self.mesh)
-        # kv_quant × mesh composition (ISSUE 9 leg 1): the sharded
-        # attention bodies thread per-token-per-head scale buffers for
-        # head-sharded tp (scales shard with their kv heads), for
-        # dp_attention's slot-sharded cache, and for dp-local shard_map
-        # decode — so int8 now serves every single-process tp/dp mesh.
-        # The still-unsupported combos reject with pointed errors:
-        if self.cache_cfg.quantized and self.mesh is not None:
-            if self.mesh.shape.get("pp", 1) > 1:
-                raise ValueError(
-                    "kv_quant=int8 is not wired for pipeline parallelism "
-                    "(the stacked pp cache layout has no scale-buffer "
-                    "variant); drop --kv-quant or --pp")
-            if self.mesh.shape.get("sp", 1) > 1:
-                raise ValueError(
-                    "kv_quant=int8 is not wired for ring-SP prefill (the "
-                    "ring attends unquantized chunk K/V, which would "
-                    "silently diverge from the dequantized cache-read "
-                    "paths); drop --kv-quant or --sp")
-            if self._mh:
-                raise ValueError(
-                    "kv_quant=int8 under a multi-process mesh is not in "
-                    "the lockstep command stream yet; run int8 "
-                    "single-process")
+        # Feature × mesh composition (ISSUE 12): the capability table in
+        # parallel/sharding.py is THE one place declaring impossible
+        # combos — int8 now composes with pp (stacked scale buffers),
+        # ring-SP (quantized chunk exchange) and the lockstep stream
+        # (the packed wire block and shard_pytree are layout-agnostic),
+        # so the old hand-maintained rejection list here is gone.
+        # Speculative decode is gated at CONSTRUCTION so an incapable
+        # combo fails pointedly instead of silently never drafting.
+        if self.mesh is not None:
+            # (dp_local is granted permissively here — its precise
+            # resolution happens below and make_sharded_step re-checks
+            # the resolved plane, so pallas × NON-local dp_attention
+            # still raises at construction with the table's reason.)
+            check_plane(
+                self.mesh,
+                PlaneSpec(quant=self.cache_cfg.quantized,
+                          spec=config.speculative_tokens > 0,
+                          use_pallas=config.use_pallas_decode is True,
+                          dp_attention=config.dp_attention,
+                          dp_local=config.dp_attention),
+                multihost=self._mh)
         # Host-side staging for device inputs: single-process uploads
         # eagerly (device-resident caching matters on a tunneled chip);
         # multihost keeps numpy and lets the step wrappers build global
@@ -319,11 +321,21 @@ class EngineCore:
                 tp = (self.mesh.shape["tp"] if self.mesh is not None
                       else 1)
                 feat = cfg.num_kv_heads * cfg.head_dim // max(tp, 1)
+            # Eligibility beyond geometry comes from the capability
+            # table (non-local dp_attention, pp stage scan, lockstep
+            # shard_map are all declared there) — querying it instead of
+            # re-listing the combos keeps auto-pallas from drifting when
+            # the table changes.
             pallas = (jax.default_backend() == "tpu"
                       and mosaic_geometry_ok(feat, self.block_size)
-                      and not (config.dp_attention
-                               and config.mesh is not None
-                               and not self._dp_local))
+                      and plane_capability(
+                          self.mesh,
+                          PlaneSpec(use_pallas=True,
+                                    dp_attention=(config.dp_attention
+                                                  and self.mesh
+                                                  is not None),
+                                    dp_local=bool(self._dp_local)),
+                          multihost=self._mh).ok)
         self._use_pallas = pallas
         self._n_local_shards = 1
         if self._dp_local:
@@ -355,7 +367,9 @@ class EngineCore:
             # Pipeline serving: stage-rotated GPipe step over the pp axis.
             # v2: the stacked layout has its own whole-block extract/
             # inject (pipeline.make_pp_block_ops), so the tiered prefix
-            # cache runs under pp like everywhere else.
+            # cache runs under pp like everywhere else.  v3 (ISSUE 12):
+            # the stacked layout grows sibling scale buffers, so int8
+            # serves pp like everywhere else too.
             from dynamo_tpu.parallel.pipeline import (
                 init_pp_cache, make_pp_step, pp_cache_pspecs,
                 pp_param_pspecs, stack_layer_params)
@@ -363,9 +377,11 @@ class EngineCore:
             params = shard_pytree(stack_layer_params(params),
                                   pp_param_pspecs(cfg), self.mesh)
             self._step = make_pp_step(cfg, self.block_size, self.mesh,
-                                      config.pp_microbatches)
-            cache = shard_pytree(init_pp_cache(self.cache_cfg),
-                                 pp_cache_pspecs(), self.mesh)
+                                      config.pp_microbatches,
+                                      kv_quant=self.cache_cfg.quantized)
+            cache = shard_pytree(
+                init_pp_cache(self.cache_cfg),
+                pp_cache_pspecs(self.cache_cfg.quantized), self.mesh)
         elif self.mesh is not None:
             from dynamo_tpu.parallel.sharding import resolve_moe_mode
 
@@ -377,12 +393,11 @@ class EngineCore:
                              dp_attention=config.dp_attention),
                 self.mesh)
             self._step = make_sharded_step(
-                cfg, self.block_size, self.mesh, moe_mode,
-                with_expert_load=self._moe,
-                dp_attention=config.dp_attention,
-                use_pallas_decode=pallas,
-                dp_local=self._dp_local,
-                kv_quant=self.cache_cfg.quantized)
+                cfg, self.block_size, self.mesh,
+                PlaneSpec(quant=self.cache_cfg.quantized,
+                          dp_attention=config.dp_attention,
+                          use_pallas=pallas, dp_local=self._dp_local),
+                self._moe, moe_mode=moe_mode)
             cache = shard_pytree(
                 kvc.init_cache(self.cache_cfg),
                 cache_pspecs(cfg.num_layers,
@@ -392,12 +407,14 @@ class EngineCore:
                 self.mesh)
             if (self.mesh.shape.get("sp", 1) > 1 and not cfg.is_moe
                     and not config.dp_attention):
-                # (dp_attention shards the cache differently than the sp
-                # step's specs — the combination isn't wired.)
+                # (dp_attention × ring-SP is declared impossible in the
+                # capability table — the sp step's cache specs conflict
+                # with slot sharding.)
                 from dynamo_tpu.parallel.sharding import make_sp_prefill_step
 
                 self._sp_step = make_sp_prefill_step(
-                    cfg, self.block_size, self.mesh)
+                    cfg, self.block_size, self.mesh,
+                    kv_quant=self.cache_cfg.quantized)
         else:
             fwd = make_forward_step(cfg, self.block_size,
                                     use_pallas_decode=pallas,
@@ -510,7 +527,16 @@ class EngineCore:
         self._prefill_cost_tokens = 0
         self._last_window_sync_ts: Optional[float] = None
         # Speculative decoding: pluggable drafter + lazily-jitted batched
-        # verify (sampling.speculative_verify).
+        # verify (sampling.speculative_verify).  Mesh-level eligibility
+        # comes from the capability table (checked loudly above);
+        # per-step conditions (logprobs, seeded rows, prefill backlog)
+        # stay in _spec_eligible.
+        self._spec_capable = plane_capability(
+            self.mesh,
+            PlaneSpec(spec=True, quant=self.cache_cfg.quantized,
+                      dp_attention=config.dp_attention,
+                      dp_local=self._dp_local),
+            multihost=self._mh).ok
         self._spec_verify: Optional[Callable] = None
         if config.drafter is not None:
             self._drafter = config.drafter
@@ -563,15 +589,20 @@ class EngineCore:
                 from dynamo_tpu.parallel.pipeline import make_pp_block_ops
 
                 self._extract_jit, self._inject_jit = make_pp_block_ops(
-                    self.block_size, self.mesh)
+                    self.block_size, self.mesh,
+                    kv_quant=self.cache_cfg.quantized)
             elif self._mh:
                 from dynamo_tpu.parallel.sharding import (
                     cache_pspecs as _cps)
 
+                # (ISSUE 12 leg 4 audit: the spec tree must carry the
+                # scale leaves under int8 or the multihost block ops
+                # would tree-mismatch on first extract.)
                 self._extract_jit, self._inject_jit = kvc.make_block_ops(
                     self.block_size, mesh=self.mesh,
                     cache_specs=_cps(cfg.num_layers, config.dp_attention,
-                                     self._dp_local))
+                                     self._dp_local,
+                                     self.cache_cfg.quantized))
             else:
                 self._extract_jit, self._inject_jit = kvc.make_block_ops(
                     self.block_size)
@@ -675,14 +706,10 @@ class EngineCore:
         if not prompt_tokens:
             raise ValueError("empty prompt")
         if prompt_embeds is not None:
-            if self._mh:
-                raise ValueError("prompt_embeds (multimodal) under a "
-                                 "multi-process mesh is not in the "
-                                 "lockstep command stream yet")
-            if self._pp:
-                raise ValueError("prompt_embeds (multimodal) on the pp "
-                                 "engine is not wired (stage step has no "
-                                 "input-embeds variant)")
+            # Declared-impossible combos (pp / multihost) raise the
+            # capability table's pointed error — one source of truth.
+            check_plane(self.mesh, PlaneSpec(role="mm"),
+                        multihost=self._mh)
             prompt_embeds = np.asarray(prompt_embeds)
             if (prompt_embeds.ndim != 2
                     or prompt_embeds.shape[0] > len(prompt_tokens)
@@ -951,13 +978,14 @@ class EngineCore:
         # drawn jointly through accept/reject chains depends on step
         # boundaries and draft content — only the plain per-token path
         # can honor the seed guarantee.
+        #
+        # Mesh-level eligibility is `_spec_capable` (the capability
+        # table, ONE source of truth — pp/multihost are declared
+        # impossible there and already rejected at construction;
+        # dp-attention locality composes since ISSUE 12 leg 5: the
+        # verify batch resolves rows to their slots).
         return (self.config.speculative_tokens > 0
-                and not self._pp  # pp step has no all-positions logits
-                and not self._mh  # spec path not audited for lockstep v1
-                # dp-attention locality pins rows to slots; the verify
-                # batch uses compact rows, which would read the wrong
-                # shard's pages — plain decode serves dp_local fleets.
-                and not self._dp_local
+                and self._spec_capable
                 and plan.decode is not None
                 and plan.prefill is None
                 and not self.scheduler.waiting
@@ -979,20 +1007,23 @@ class EngineCore:
                 speculative_verify, static_argnames=("greedy_only",))
         return self._spec_verify
 
-    def _row_keys(self, reqs, n: int):
+    def _row_keys(self, reqs, n: int, rows=None):
         """Per-row sampling keys, ONE discipline for the plain and spec
         paths: one fresh split per step for unseeded rows; seeded rows
         overwritten with fold_in(seed, emitted-token index) so a seeded
         stream depends only on (seed, token index).  (The spec path
         never sees seeded stochastic rows — _spec_eligible routes them
-        to the plain path, the only one that can honor that contract.)"""
+        to the plain path, the only one that can honor that contract.)
+        `rows`: device row per request when requests don't sit at
+        compact indices (slot-pinned dp-attention locality)."""
         self._rng, sub = jax.random.split(self._rng)
         keys = jax.random.split(sub, n)
         for i, r in enumerate(reqs):
             if r.sampling.seed is not None:
-                keys = keys.at[i].set(jax.random.fold_in(
-                    jax.random.key(r.sampling.seed),
-                    r.prior_output + len(r.output_tokens)))
+                keys = keys.at[rows[i] if rows is not None else i].set(
+                    jax.random.fold_in(
+                        jax.random.key(r.sampling.seed),
+                        r.prior_output + len(r.output_tokens)))
         return keys
 
     def _run_decode_spec(self, work: DecodeWork) -> Optional[List[TokenDelta]]:
@@ -1019,7 +1050,14 @@ class EngineCore:
         K = self.config.speculative_tokens
         T = K + 1
         reqs = work.requests
-        bucket = self._pad_rows(work.bucket)
+        # Compact-row-aware verify (ISSUE 12 leg 5): under dp-attention
+        # locality a request's rows are pinned to its SLOT (its pages
+        # live on the slot's shard), so the verify batch resolves each
+        # request to the owning shard's slot range instead of compact
+        # order — same row discipline as _run_decode.
+        bucket = (self._dp_rows if self._dp_local
+                  else self._pad_rows(work.bucket))
+        rows = [self._decode_row(r, j) for j, r in enumerate(reqs)]
 
         vocab = self.config.model.vocab_size
         drafts = []
@@ -1055,18 +1093,19 @@ class EngineCore:
         top_p = np.ones((bucket,), np.float32)
         draft_arr = np.zeros((bucket, K), np.int32)
         for i, req in enumerate(reqs):
+            row = rows[i]
             ctx = req.context_len
             last = (req.output_tokens[-1] if req.output_tokens
                     else req.prompt_tokens[-1])
-            tokens[i] = [last] + drafts[i]
-            positions[i] = np.arange(ctx - 1, ctx - 1 + T)
-            seq_lens[i] = ctx + K  # every fed token's KV is written
+            tokens[row] = [last] + drafts[i]
+            positions[row] = np.arange(ctx - 1, ctx - 1 + T)
+            seq_lens[row] = ctx + K  # every fed token's KV is written
             n = min(len(req.pages), width)
-            bts[i, :n] = req.pages[:n]
-            temp[i] = req.sampling.temperature
-            top_k[i] = req.sampling.top_k
-            top_p[i] = req.sampling.top_p
-            draft_arr[i] = drafts[i]
+            bts[row, :n] = req.pages[:n]
+            temp[row] = req.sampling.temperature
+            top_k[row] = req.sampling.top_k
+            top_p[row] = req.sampling.top_p
+            draft_arr[row] = drafts[i]
 
         # sample_positions=None → logits at EVERY chunk position [B,T,V].
         self.counters.note_dispatch("spec", bucket, T, width)
@@ -1083,7 +1122,7 @@ class EngineCore:
         emitted_dev, n_emit_dev = self._spec_verify_fn()(
             logits, jnp.asarray(draft_arr), jnp.asarray(temp),
             jnp.asarray(top_k), jnp.asarray(top_p),
-            self._row_keys(reqs, bucket),
+            self._row_keys(reqs, bucket, rows=rows),
             greedy_only=all(r.sampling.temperature <= 0 for r in reqs))
         self.counters.host_syncs += 1
         emitted, n_emit = jax.device_get((emitted_dev, n_emit_dev))
@@ -1093,9 +1132,9 @@ class EngineCore:
         deltas: List[TokenDelta] = []
         stats = self.metrics.spec_decode_stats
         for i, req in enumerate(reqs):
-            n = int(n_emit[i])
+            n = int(n_emit[rows[i]])
             appended = 0
-            for tok in emitted[i, :n]:
+            for tok in emitted[rows[i], :n]:
                 if req.request_id not in self._requests:
                     break  # finished mid-burst (stop token / max_tokens)
                 self._publish_completed_blocks(req)
@@ -1127,10 +1166,10 @@ class EngineCore:
         # (Prefill work / waiting admissions do NOT disqualify windows:
         # bounded prefill chunks dispatch concurrently behind them —
         # see step().  MoE windows thread the expert-load aux through
-        # the loop carry since r5.)
+        # the loop carry since r5; pp meshes ride the schedule-looping
+        # window program since ISSUE 12 leg 3.)
         if not (self.config.decode_window > 1
                 and self.config.speculative_tokens == 0
-                and not self._pp  # windows build their own non-pp step
                 and plan.decode is not None):
             return False
         # Logprob requests take the single-step path too (the window's
@@ -1272,6 +1311,16 @@ class EngineCore:
             # test-only before; now EngineCore routes real requests
             # through it).
             self.sp_prefill_count += len(batch.items)
+            # Modeled per-chip ring traffic: each chip's resident chunk
+            # (T/sp tokens) rides (sp−1) hops per layer; the payload per
+            # token comes from the ONE cache-mode-aware accounting
+            # (ring_payload_bytes_per_token), so the series halves under
+            # int8 exactly like the decode read series does.
+            sp = self.mesh.shape["sp"]
+            self.counters.note_ring_exchange(
+                sum(w.length for w in batch.items)
+                * self.cache_cfg.ring_payload_bytes_per_token
+                * (sp - 1) // sp)
             logits, self.cache = self._sp_step(
                 self.params, self.cache,
                 self._dev(tokens), self._dev(positions),
@@ -1530,11 +1579,12 @@ class EngineCore:
             # program (donated cache), ONE host sync for [bucket] tokens.
             # The unfused path is 3 dispatches (step, row gather, argmax)
             # plus a [B, V] f32 logits output allocation per step — the
-            # r5 single-step cliff's engine-side half.  Sharded non-pp
-            # engines fuse through make_sharded_greedy_step, so the
-            # cliff dies under meshes too (pp keeps the plain path: the
-            # stage step has no all-in-one program; multihost replays
-            # the unfused step through the lockstep stream).
+            # r5 single-step cliff's engine-side half.  Sharded engines
+            # fuse through make_sharded_step(plane.fused), pp through the
+            # all-in-one stage program (make_pp_greedy_step), and the
+            # lockstep stream replays THIS fused step (its token output
+            # is replicated so every process reads locally) — the cliff
+            # is dead on every mesh (ISSUE 12 legs 3-4).
             self.counters.note_dispatch("decode1g", bucket, work.pages)
             res = self._greedy_step_fn()(
                 self.params, self.cache, self._dev(tokens),
@@ -1570,21 +1620,40 @@ class EngineCore:
     @property
     def _fused_greedy_capable(self) -> bool:
         """Engines whose all-greedy single-step decode runs the fused
-        forward+argmax program: meshless (raw forward captured) and
-        single-process sharded non-pp (make_sharded_greedy_step)."""
-        return (self._fwd_raw is not None
-                or (self.mesh is not None and not self._pp
-                    and not self._mh))
+        forward+argmax program.  Reads the capability table (ISSUE 12):
+        meshless (raw forward captured), every single-process mesh
+        (make_sharded_greedy_step), pp (the all-in-one stage program,
+        make_pp_greedy_step), and multihost — the fused step replicates
+        its token output so every lockstep process reads it locally."""
+        if self._fwd_raw is not None:
+            return True
+        return self.mesh is not None and plane_capability(
+            self.mesh,
+            PlaneSpec(fused=True, quant=self.cache_cfg.quantized,
+                      dp_attention=self.config.dp_attention,
+                      dp_local=self._dp_local),
+            multihost=self._mh).ok
 
+    @engine_thread_only
+    @hot_path
     def _greedy_step_fn(self):
         """Lazily-jitted fused greedy single step: the forward and the
         argmax compile into one program, so the non-window decode path
         costs one dispatch and returns [B] tokens instead of [B, V]
-        logits.  Sharded (non-pp) engines build it through
-        parallel.sharding.make_sharded_greedy_step with the engine's own
-        sharding choices, so tp/dp/dp-attention fleets shed the
-        single-step cliff exactly like meshless ones."""
+        logits.  Sharded non-pp engines build it through the unified
+        make_sharded_step builder (plane.fused=True) with the engine's
+        own sharding choices; pp engines through the all-in-one stage
+        program (pipeline.make_pp_greedy_step) — so every mesh sheds
+        the single-step cliff exactly like meshless ones."""
         if self._greedy_fused is None:
+            if self._pp:
+                from dynamo_tpu.parallel.pipeline import make_pp_greedy_step
+
+                self._greedy_fused = make_pp_greedy_step(
+                    self.config.model, self.block_size, self.mesh,
+                    self.config.pp_microbatches,
+                    kv_quant=self.cache_cfg.quantized)
+                return self._greedy_fused
             if self.mesh is not None:
                 from dynamo_tpu.parallel.sharding import (
                     make_sharded_greedy_step)
@@ -1617,10 +1686,25 @@ class EngineCore:
 
     # -- pipelined decode windows ------------------------------------------
 
+    @engine_thread_only
+    @hot_path
     def _window_fn(self, greedy_only: bool):
         fn = self._window_fns.get(greedy_only)
         if fn is None:
-            if self.mesh is not None:
+            if self._pp:
+                # pp window (ISSUE 12 leg 3): K schedule passes in one
+                # dispatch with on-device token feedback, so pp decode
+                # rides the same pipelined window path as every mesh.
+                from dynamo_tpu.parallel.pipeline import (
+                    make_pp_decode_window)
+
+                fn = make_pp_decode_window(
+                    self.config.model, self.block_size, self.mesh,
+                    self.config.pp_microbatches,
+                    self.config.decode_window,
+                    greedy_only=greedy_only,
+                    kv_quant=self.cache_cfg.quantized)
+            elif self.mesh is not None:
                 from dynamo_tpu.parallel.sharding import make_sharded_window
 
                 fn = make_sharded_window(
@@ -2029,13 +2113,10 @@ class EngineCore:
         temporarily-allocated pages that are released afterward — the
         /v1/embeddings surface (reference `http/service/openai.rs:315`).
         Must run on the engine thread (InferenceEngine wraps it)."""
-        if self._pp:
-            raise ValueError("embeddings are not wired for the pp engine "
-                             "(pipeline stages have no return_hidden path)")
-        if self._mh:
-            raise ValueError("embeddings are not wired for multihost v1 "
-                             "(the embed route isn't in the lockstep "
-                             "command stream)")
+        # Declared-impossible combos (pp / multihost) raise the
+        # capability table's pointed error — one source of truth.
+        check_plane(self.mesh, PlaneSpec(role="embed"),
+                    multihost=self._mh)
         if self._embed_step is None:
             if self.mesh is not None:
                 from dynamo_tpu.parallel.sharding import (

@@ -122,6 +122,20 @@ class KvCacheConfig:
         return self.block_size * self.bytes_per_context_token
 
     @property
+    def ring_payload_bytes_per_token(self) -> int:
+        """Bytes ONE token's K+V contribute to each ring-SP hop, summed
+        over layers (every layer's attention rotates its own chunk).
+        Unquantized chunks rotate at the compute dtype; quantized chunks
+        rotate int8 rows + their f32 scales (ISSUE 12 leg 1) — the ICI
+        exchange halves with the cache mode, and the modeled
+        `ring_exchange_bytes` series must say so."""
+        if self.quantized:
+            per = self.feature_dim + 4 * self.num_kv_heads
+        else:
+            per = self.feature_dim * jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * per
+
+    @property
     def block_wire_shape(self) -> tuple:
         """Canonical shape of one exported block (the transfer-plane and
         tier-storage unit).  bf16 mode: [2, L, bs, F] at `dtype`; int8
@@ -279,6 +293,31 @@ def dequantize_rows(
     return (q.astype(jnp.float32) * scale[..., None]).astype(out_dtype)
 
 
+def scatter_kv_quant(
+    cache_layer_k: jax.Array,   # [S, F] int8
+    cache_layer_v: jax.Array,
+    scale_layer_k: jax.Array,   # [S, Hkv] f32
+    scale_layer_v: jax.Array,
+    slots: jax.Array,           # [N] flat slot ids (NULL for pad)
+    kq: jax.Array,              # [N, F] int8 rows (already quantized)
+    vq: jax.Array,
+    ks: jax.Array,              # [N, Hkv] f32 scales
+    vs: jax.Array,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Scatter ALREADY-quantized rows + scales into one layer —
+    write_kv_quant minus the quantization.  Callers that need the int8
+    rows for their own attention (the ring-SP chunk exchange, ISSUE 12
+    leg 1) quantize ONCE via quantize_kv_rows and share the result, so
+    the cache and the ring can never hold different quantizations of the
+    same token."""
+    return (
+        cache_layer_k.at[slots].set(kq, mode="drop"),
+        cache_layer_v.at[slots].set(vq, mode="drop"),
+        scale_layer_k.at[slots].set(ks, mode="drop"),
+        scale_layer_v.at[slots].set(vs, mode="drop"),
+    )
+
+
 def write_kv_quant(
     cache_layer_k: jax.Array,   # [S, F] int8
     cache_layer_v: jax.Array,
@@ -294,12 +333,8 @@ def write_kv_quant(
     H = scale_layer_k.shape[-1]
     kq, ks = quantize_kv_rows(k, H)
     vq, vs = quantize_kv_rows(v, H)
-    return (
-        cache_layer_k.at[slots].set(kq, mode="drop"),
-        cache_layer_v.at[slots].set(vq, mode="drop"),
-        scale_layer_k.at[slots].set(ks, mode="drop"),
-        scale_layer_v.at[slots].set(vs, mode="drop"),
-    )
+    return scatter_kv_quant(cache_layer_k, cache_layer_v, scale_layer_k,
+                            scale_layer_v, slots, kq, vq, ks, vs)
 
 
 def gather_kv_quant(

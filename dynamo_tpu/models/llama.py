@@ -159,14 +159,6 @@ def _attention_block(
     scatter in `write_kv` aliases in place under donation / loop carries."""
     B, T, _ = x.shape
     quant = k_scale_cache is not None
-    if quant and sp_mesh is not None:
-        # The ring path attends the chunk's PRE-quantization K/V (no
-        # cache read), which would silently diverge from every
-        # dequantized-read path — the engine rejects sp×int8 at
-        # construction; this is the backstop.
-        raise ValueError("kv_quant=int8 is not wired for ring-SP "
-                         "prefill (the ring attends unquantized chunk "
-                         "K/V); drop --kv-quant or --sp")
     q = (x @ p_attn["wq"]).reshape(B, T, cfg.num_heads, cfg.head_dim)
     k = (x @ p_attn["wk"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     v = (x @ p_attn["wv"]).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
@@ -275,7 +267,28 @@ def _attention_block(
         out = out.reshape(B, T, cfg.q_size) @ p_attn["wo"]
         return out, k_layer, v_layer, ks_layer, vs_layer
 
-    if quant:
+    ring_quant = None
+    if quant and sp_mesh is not None:
+        # ISSUE 12 leg 1 (int8 × ring-SP): quantize the chunk ONCE — the
+        # same int8 rows + [chunk, Hkv] scales are scattered into the
+        # cache AND rotated around the ring, so ring attention attends
+        # exactly the values every dequantized cache-read path sees.
+        # (Attending the pre-quantization chunk, as the pre-ISSUE-12
+        # raise documented, would silently diverge from decode.)
+        kq, ksc = kvc.quantize_kv_rows(k.reshape(B * T, cfg.kv_size),
+                                       cfg.num_kv_heads)
+        vq, vsc = kvc.quantize_kv_rows(v.reshape(B * T, cfg.kv_size),
+                                       cfg.num_kv_heads)
+        k_layer, v_layer, ks_layer, vs_layer = kvc.scatter_kv_quant(
+            k_cache, v_cache, k_scale_cache, v_scale_cache, write_slots,
+            kq, vq, ksc, vsc)
+        ring_quant = (
+            kq.reshape(B, T, cfg.num_kv_heads, cfg.head_dim),
+            vq.reshape(B, T, cfg.num_kv_heads, cfg.head_dim),
+            ksc.reshape(B, T, cfg.num_kv_heads),
+            vsc.reshape(B, T, cfg.num_kv_heads),
+        )
+    elif quant:
         k_layer, v_layer, ks_layer, vs_layer = kvc.write_kv_quant(
             k_cache, v_cache, k_scale_cache, v_scale_cache, write_slots,
             k.reshape(B * T, cfg.kv_size),
@@ -306,15 +319,34 @@ def _attention_block(
         # all-gather the column-parallel q/k/v projections and every tp
         # shard would redo all heads' attention.
         spec4 = P("dp", "sp", "tp", None)
-        out = shard_map(
-            lambda qs, ks, vs, ps: ring_causal_attention(
-                qs, ks, vs, ps, axis_name="sp",
-                scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap),
-            mesh=sp_mesh,
-            in_specs=(spec4, spec4, spec4, P("dp", "sp")),
-            out_specs=spec4,
-            check_vma=False,
-        )(q, k, v, positions)
+        if ring_quant is not None:
+            # Quantized exchange: int8 chunk rows + per-token-per-head
+            # scales ride the ring together and each hop dequantizes
+            # in-register (ring_causal_attention k_scale/v_scale) —
+            # the per-hop ICI payload drops to F + 4·Hkv bytes/token.
+            spec3 = P("dp", "sp", "tp")
+            kq4, vq4, ks3, vs3 = ring_quant
+            out = shard_map(
+                lambda qs, ks_, vs_, ksc, vsc, ps: ring_causal_attention(
+                    qs, ks_, vs_, ps, axis_name="sp",
+                    scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap,
+                    k_scale=ksc, v_scale=vsc),
+                mesh=sp_mesh,
+                in_specs=(spec4, spec4, spec4, spec3, spec3,
+                          P("dp", "sp")),
+                out_specs=spec4,
+                check_vma=False,
+            )(q, kq4, vq4, ks3, vs3, positions)
+        else:
+            out = shard_map(
+                lambda qs, ks, vs, ps: ring_causal_attention(
+                    qs, ks, vs, ps, axis_name="sp",
+                    scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap),
+                mesh=sp_mesh,
+                in_specs=(spec4, spec4, spec4, P("dp", "sp")),
+                out_specs=spec4,
+                check_vma=False,
+            )(q, k, v, positions)
     elif ctx_slots is None:
         # Decode hot path: stream pages via the Pallas kernel — no
         # materialised context gather (ops/pallas/paged_attention.py).

@@ -32,7 +32,7 @@ NEG = -1e30
 
 def ring_causal_attention(
     q: jax.Array,            # [B, T_loc, Hq, D]
-    k: jax.Array,            # [B, T_loc, Hkv, D]
+    k: jax.Array,            # [B, T_loc, Hkv, D] (int8 when k_scale given)
     v: jax.Array,            # [B, T_loc, Hkv, D]
     q_positions: jax.Array,  # [B, T_loc] absolute token positions
     kv_positions: Optional[jax.Array] = None,  # defaults to q_positions
@@ -40,12 +40,23 @@ def ring_causal_attention(
                                       # to masked causal attention)
     scale: Optional[float] = None,
     soft_cap: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,  # [B, T_loc, Hkv] f32 (int8 k/v)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Blockwise-causal attention; call inside `shard_map` with the T axis
     sharded over `axis_name` (or standalone with axis_name=None).
 
     Returns [B, T_loc, Hq, D] in q's dtype.  Numerics match
     ops/attention.py `causal_attention` (same mask, f32 softmax path).
+
+    Quantized exchange (ISSUE 12 leg 1): with `k_scale`/`v_scale`, K/V
+    are int8 rows quantized EXACTLY as the paged cache stores them
+    (kv_cache.quantize_kv_rows) and the per-token-per-head f32 scales
+    rotate around the ring WITH their rows — each hop dequantizes the
+    visiting block in-register (kv_cache.dequantize_rows to q's compute
+    dtype, f32 inside the softmax math), so ring attention sees the same
+    dequantized operands every cache-read path sees, and the per-hop ICI
+    payload drops from 2·F·itemsize to F + 4·Hkv bytes per token.
     """
     B, T, Hq, D = q.shape
     Hkv = k.shape[2]
@@ -69,9 +80,19 @@ def ring_causal_attention(
     # order that visited a later shard's block first would need the
     # -inf/NaN dance instead.)
     k_cur, v_cur, kv_pos = k, v, kv_positions
+    ks_cur, vs_cur = k_scale, v_scale
     for step in range(sp):
-        kf = k_cur.astype(jnp.float32)
-        vf = v_cur.astype(jnp.float32)
+        if ks_cur is None:
+            kf = k_cur.astype(jnp.float32)
+            vf = v_cur.astype(jnp.float32)
+        else:
+            from dynamo_tpu.engine.kv_cache import dequantize_rows
+
+            # Dequant to q's compute dtype first, THEN f32 — the exact
+            # operand path gather_kv_quant feeds the XLA fallback, so
+            # ring and gather attention agree bit-for-bit pre-softmax.
+            kf = dequantize_rows(k_cur, ks_cur, q.dtype).astype(jnp.float32)
+            vf = dequantize_rows(v_cur, vs_cur, q.dtype).astype(jnp.float32)
         # [B, Hkv, G, T, Tk]
         s = jnp.einsum("btkgd,bckd->bkgtc", qg, kf)
         if soft_cap is not None:
@@ -93,6 +114,11 @@ def ring_causal_attention(
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
             v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
             kv_pos = jax.lax.ppermute(kv_pos, axis_name, perm)
+            if ks_cur is not None:
+                # Scales ride the ring WITH their int8 rows — a block and
+                # its scales can never desynchronize across hops.
+                ks_cur = jax.lax.ppermute(ks_cur, axis_name, perm)
+                vs_cur = jax.lax.ppermute(vs_cur, axis_name, perm)
 
     # Fully-masked rows (padding) keep l == 0: guard the divide.
     out = acc / jnp.maximum(l.transpose(0, 3, 1, 2, 4), 1e-30)

@@ -17,18 +17,24 @@ matmuls, all-to-all for experts, ppermute rings for sequence shards).
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.parallel.pipeline import make_pp_step
 from dynamo_tpu.parallel.sharding import (
+    PlaneSpec,
     cache_pspecs,
+    check_plane,
     data_pspecs,
     make_sharded_greedy_step,
     make_sharded_step,
     make_sp_prefill_step,
     param_pspecs,
+    plane_capability,
     shard_pytree,
 )
 
 __all__ = [
     "MeshConfig",
     "make_mesh",
+    "PlaneSpec",
+    "plane_capability",
+    "check_plane",
     "param_pspecs",
     "cache_pspecs",
     "data_pspecs",

@@ -58,6 +58,9 @@ from typing import Callable, Iterable, Optional
 import msgpack
 import numpy as np
 
+from dynamo_tpu.runtime import contracts
+from dynamo_tpu.runtime.contracts import never_engine_thread
+
 logger = logging.getLogger(__name__)
 
 # ---------------------------------------------------------------------------
@@ -189,7 +192,12 @@ class LockstepLeader:
         self._conns: list[socket.socket] = []
         self._lock = threading.Lock()
 
+    @never_engine_thread
     def wait_for_followers(self, timeout: float = 120.0) -> None:
+        # Blocking accept loop — startup/bootstrap thread only; the
+        # engine thread must never park here (broadcast() itself runs ON
+        # the engine thread by design: tiny frames next to multi-ms
+        # device steps).
         self._srv.settimeout(timeout)
         while len(self._conns) < self.num_followers:
             conn, addr = self._srv.accept()
@@ -288,7 +296,19 @@ def run_follower(core, chan: LockstepFollower,
     """Replay the leader's engine-thread command stream on a shadow
     EngineCore until the leader stops.  Every device computation the
     leader launches, this process launches identically — that IS the
-    multihost execution contract."""
+    multihost execution contract.  The replay thread registers as THIS
+    process's engine thread (it drives core.step()/add_request — every
+    @engine_thread_only pin lands on it, and @never_engine_thread
+    functions refuse it, exactly like the leader's step loop)."""
+    contracts.register_engine_thread()
+    try:
+        _follower_loop(core, chan, stop_event)
+    finally:
+        contracts.unregister_engine_thread()
+
+
+def _follower_loop(core, chan: LockstepFollower,
+                   stop_event: Optional[threading.Event]) -> None:
     while stop_event is None or not stop_event.is_set():
         cmd = chan.recv()
         op = cmd["op"]

@@ -20,7 +20,8 @@ rather than silently replicated.
 
 from __future__ import annotations
 
-from typing import Dict
+from dataclasses import dataclass
+from typing import Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -28,6 +29,144 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.models.config import ModelConfig
 
 Params = Dict
+
+
+# ---------------------------------------------------------------------------
+# Declarative plane spec + capability table (ISSUE 12 tentpole)
+
+
+@dataclass(frozen=True)
+class PlaneSpec:
+    """Declarative spec of one compiled serving plane.
+
+    The per-combo `make_sharded_{step,window,greedy,embed,mm}_step`
+    family collapsed into ONE `make_sharded_step(cfg, block, mesh,
+    plane)` builder parameterized by this spec — the feature-composition
+    matrix is now a value, not a code grid:
+
+    - `quant`: int8 KV cache — the cache pytree carries sibling
+      `[S, Hkv]` f32 scale buffers that shard with their kv heads
+      (or slots, under dp_attention) and every attention body
+      dequantizes shard-locally (ring hops included).
+    - `spec`: speculative verify chunks (T = K+1 decode with
+      all-positions logits) will ride this engine's step.
+    - `fused`: on-device argmax fused into the program — [B] int32
+      tokens out instead of [B, V] f32 logits (the single-step-cliff
+      killer).
+    - `window`: fused K-token decode window (0/1 = single-step program).
+    - `greedy_only`: argmax-only window variant (no sort, no keys).
+    - `use_pallas`: route decode attention through the Pallas paged
+      kernel inside shard_map.
+    - `dp_attention` / `dp_local`: batch-sharded attention with
+      slot-sharded KV, optionally with page locality.
+    - `role`: "decode" (the unified step family), "embed"
+      (return_hidden), "mm" (input-embeds prefill), "sp_prefill"
+      (ring-SP whole-prompt prefill).
+    """
+
+    quant: bool = False
+    spec: bool = False
+    fused: bool = False
+    window: int = 0
+    greedy_only: bool = False
+    use_pallas: bool = False
+    dp_attention: bool = False
+    dp_local: bool = False
+    role: str = "decode"
+
+
+@dataclass(frozen=True)
+class Capability:
+    ok: bool
+    reason: Optional[str] = None
+
+
+def plane_capability(mesh: Optional[Mesh], plane: PlaneSpec,
+                     multihost: Optional[bool] = None) -> Capability:
+    """THE capability table: every genuinely-impossible (feature x mesh)
+    combination is declared HERE, with the pointed error serving code
+    raises — the engine's gating, the README matrix Notes, and the
+    composition grid test all read this one function instead of
+    hand-maintained combo lists.  `mesh=None` is the meshless engine;
+    `multihost` overrides process-span detection so tests can query
+    lockstep combos without building a multi-process mesh."""
+    pp = mesh is not None and mesh.shape.get("pp", 1) > 1
+    if multihost is None:
+        from dynamo_tpu.parallel.multihost import mesh_spans_processes
+
+        multihost = mesh is not None and mesh_spans_processes(mesh)
+
+    def no(reason: str) -> Capability:
+        return Capability(False, reason)
+
+    if plane.dp_local and not plane.dp_attention:
+        return no("dp_local implies dp_attention")
+    if (plane.dp_attention or plane.dp_local) and mesh is None:
+        return no("dp_attention needs a mesh")
+    if plane.use_pallas and plane.dp_attention and not plane.dp_local:
+        return no(
+            "pallas decode under dp_attention needs page locality "
+            "(dp_attention_local=True): without it a row's pages may "
+            "live on any shard and the kernel's slot indexing cannot "
+            "cross chips — set dp_attention_local (plain allocator) or "
+            "drop use_pallas_decode for the gather path")
+    if plane.use_pallas and pp:
+        return no(
+            "pallas paged decode is not wired into the pp stage scan "
+            "(the schedule attends gathered context inside each stage); "
+            "drop use_pallas_decode (auto keeps pp on the gather path) "
+            "or --pp")
+    if plane.use_pallas and multihost:
+        return no(
+            "pallas paged decode under a multi-process mesh is not "
+            "audited for the lockstep stream (shard_map custom calls "
+            "across processes are unvalidated); drop use_pallas_decode "
+            "— auto keeps multihost on the gather path")
+    if pp and multihost:
+        return no("pipeline parallelism under a multi-process mesh is "
+                  "not wired yet (multihost v2 covers tp/dp/dp-attention "
+                  "with int8 and fused steps)")
+    if plane.spec:
+        if pp:
+            return no(
+                "speculative decode on the pp engine is declared "
+                "impossible: the stage program banks ONE sampled row "
+                "per microbatch, and the T=K+1 verify chunk needs "
+                "all-positions logits; drop --spec-decode or --pp")
+        if multihost:
+            return no(
+                "speculative decode under a multi-process mesh is "
+                "loudly versioned out of the audited lockstep stream "
+                "(the host-side verify jit carries no multihost "
+                "shardings); drop --spec-decode or run single-process")
+    if plane.role == "embed":
+        if pp:
+            return no("embeddings are not wired for the pp engine "
+                      "(pipeline stages have no return_hidden path)")
+        if multihost:
+            return no("embeddings are not wired for multihost (the "
+                      "embed route isn't in the lockstep command "
+                      "stream)")
+    if plane.role == "mm":
+        if pp:
+            return no("prompt_embeds (multimodal) on the pp engine is "
+                      "not wired (stage step has no input-embeds "
+                      "variant)")
+        if multihost:
+            return no("prompt_embeds (multimodal) under a multi-process "
+                      "mesh is not in the lockstep command stream yet")
+    if plane.role == "sp_prefill" and plane.dp_attention:
+        return no("ring-SP prefill is not wired for dp_attention (the "
+                  "sp step's cache specs conflict with slot sharding)")
+    return Capability(True)
+
+
+def check_plane(mesh: Optional[Mesh], plane: PlaneSpec,
+                multihost: Optional[bool] = None) -> None:
+    """Raise the capability table's pointed error for impossible combos."""
+    cap = plane_capability(mesh, plane, multihost)
+    if not cap.ok:
+        raise ValueError(cap.reason)
 
 
 def param_pspecs(cfg: ModelConfig, moe_mode: str = "dense",
@@ -205,52 +344,6 @@ def _finalize(fn, in_shardings, mesh: Mesh):
     return fn
 
 
-def make_sp_prefill_step(cfg: ModelConfig, block_size: int, mesh: Mesh):
-    """Jit the SEQUENCE-PARALLEL full-prompt prefill step: the token axis
-    shards over the mesh's sp axis and attention runs on the ICI ring
-    (ops/ring_attention.py) — the long-context prefill path SURVEY §2.5
-    demands.  Contract: the chunk is the WHOLE prompt (positions 0..T-1;
-    no prior cached context is read); T must divide by sp.
-
-    Returns `step(params, cache, tokens, positions, seq_lens,
-    block_tables, sample_positions)` → (logits, cache), same signature as
-    the regular step but with tokens/positions sharded P(dp, sp).
-    """
-    from dynamo_tpu.models.llama import make_forward_step
-    from dynamo_tpu.parallel.multihost import mesh_spans_processes
-
-    validate(cfg, mesh)
-    mh = mesh_spans_processes(mesh)
-    # MoE under sp: dense compute (the dispatch shard_map shards tokens
-    # over dp×ep, which conflicts with the sp sharding of a prefill chunk).
-    step = make_forward_step(cfg, block_size, moe_mode="dense", mesh=mesh,
-                             sp_ring=True)
-    seq = NamedSharding(mesh, P("dp", "sp"))
-    in_shardings = (
-        jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs(cfg)),
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers)),
-        seq,                                       # tokens [B, T]
-        seq,                                       # positions [B, T]
-        NamedSharding(mesh, P("dp")),              # seq_lens [B]
-        NamedSharding(mesh, P("dp", None)),        # block_tables [B, P]
-        NamedSharding(mesh, P("dp")),              # sample_positions [B]
-    )
-    out_shardings = (
-        # Logits are host-read (sampling); multihost replicates them so
-        # every process can read locally (no off-thread collectives).
-        NamedSharding(mesh, P(None, None) if mh else P("dp", None)),
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers)),
-    )
-    return _finalize(jax.jit(
-        step,
-        in_shardings=in_shardings,
-        out_shardings=out_shardings,
-        donate_argnums=(1,),
-    ), in_shardings, mesh)
-
-
 def resolve_moe_mode(cfg: ModelConfig, mesh: Mesh,
                      moe_mode: str = "auto") -> str:
     """'auto' → all-to-all dispatch when an ep axis exists and tp == 1
@@ -267,20 +360,233 @@ def resolve_moe_mode(cfg: ModelConfig, mesh: Mesh,
     return moe_mode
 
 
-def _reject_pallas_dp_attention(use_pallas_decode: bool,
-                                dp_attention: bool, dp_local: bool) -> None:
-    """Pallas decode composes with head-sharded tp (heads over tp inside
-    shard_map) and with dp_attention LOCALITY (slots rebase to the shard's
-    local range inside the body — ISSUE 9 leg 2).  Plain dp_attention
-    without locality is the one remaining exclusion: pages may live on
-    any shard, and the kernel's slot indexing cannot cross chips."""
-    if use_pallas_decode and dp_attention and not dp_local:
-        raise ValueError(
-            "pallas decode under dp_attention needs page locality "
-            "(dp_attention_local=True): without it a row's pages may "
-            "live on any shard and the kernel's slot indexing cannot "
-            "cross chips — set dp_attention_local (plain allocator) or "
-            "drop use_pallas_decode for the gather path")
+def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
+                      plane: Optional[PlaneSpec] = None,
+                      with_expert_load: bool = False, *,
+                      moe_mode: str = "auto",
+                      dp_attention: bool = False,
+                      use_pallas_decode: bool = False,
+                      dp_local: bool = False,
+                      kv_quant: bool = False,
+                      window: int = 0,
+                      greedy_only: bool = False):
+    """THE sharded-step builder (ISSUE 12 tentpole): one entry point,
+    parameterized by a declarative `PlaneSpec`, for every compiled
+    program a sharded engine dispatches — the plain unified step, the
+    fused greedy single step, the K-token decode window, the embeddings
+    (return_hidden) step, the multimodal (input-embeds) prefill, and the
+    ring-SP whole-prompt prefill.  The per-combo
+    `make_sharded_{window,greedy,embed,mm,sp_prefill}_step` spellings
+    survive as thin wrappers that construct the PlaneSpec.
+
+    Impossible combinations raise the capability table's pointed error
+    (`plane_capability`) — ONE place declares them, the engine's gating
+    reads the same table, and the composition grid test asserts it.
+
+    Common contract pieces: cache donated (in-place paged update);
+    host-read outputs (logits / fused tokens) come back replicated under
+    a multi-process mesh so every lockstep process reads locally, and
+    host (numpy) inputs are converted to global arrays per in_shardings
+    (`_finalize`).  `dp_attention` shards batch over (dp, tp) and the
+    cache's slot axis over tp; `quant` carries the int8 cache's sharded
+    scale buffers through every plane (ring hops included).
+
+    Pipeline (pp) meshes build their stage programs through
+    `parallel.pipeline` (stacked layer/cache layout); this builder
+    serves every non-pp mesh.
+
+    Legacy keyword spelling (moe_mode / dp_attention / use_pallas_decode
+    / dp_local / kv_quant, and a positional moe_mode string) is still
+    accepted and folded into a PlaneSpec.
+    """
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import make_decode_window, make_forward_step
+    from dynamo_tpu.parallel.multihost import mesh_spans_processes
+
+    if isinstance(plane, str):       # legacy positional moe_mode
+        moe_mode, plane = plane, None
+    if plane is None:
+        plane = PlaneSpec(quant=kv_quant, dp_attention=dp_attention,
+                          use_pallas=use_pallas_decode, dp_local=dp_local,
+                          window=window, greedy_only=greedy_only)
+    validate(cfg, mesh, plane.dp_attention)
+    check_plane(mesh, plane)
+    mh = mesh_spans_processes(mesh)
+    moe_mode = resolve_moe_mode(
+        cfg, mesh, "dense" if plane.role == "sp_prefill" else moe_mode)
+    batch_axes = ("dp", "tp") if plane.dp_attention else "dp"
+
+    def nsh(spec):
+        return NamedSharding(mesh, spec)
+
+    param_sh = jax.tree.map(
+        nsh, param_pspecs(cfg, moe_mode, plane.dp_attention))
+    cache_sh = jax.tree.map(
+        nsh, cache_pspecs(cfg.num_layers, plane.dp_attention,
+                          plane.dp_local, plane.quant))
+    b = nsh(P(batch_axes))
+    b2 = nsh(P(batch_axes, None))
+
+    def jit_plane(fn, in_shardings, out_shardings):
+        return _finalize(jax.jit(fn, in_shardings=in_shardings,
+                                 out_shardings=tuple(out_shardings),
+                                 donate_argnums=(1,)), in_shardings, mesh)
+
+    if plane.role == "sp_prefill":
+        # SEQUENCE-PARALLEL full-prompt prefill: the token axis shards
+        # over sp and attention runs on the ICI ring
+        # (ops/ring_attention.py).  Contract: the chunk is the WHOLE
+        # prompt (positions 0..T-1, no prior cached context); T must
+        # divide by sp.  MoE stays dense (the dispatch shard_map shards
+        # tokens over dp×ep, conflicting with sp chunk sharding).
+        # Quantized caches ride the ring as int8 chunks + scales
+        # (llama._attention_block sp branch — ISSUE 12 leg 1).
+        step = make_forward_step(cfg, block_size, moe_mode="dense",
+                                 mesh=mesh, sp_ring=True)
+        seq = nsh(P("dp", "sp"))
+        in_shardings = (param_sh, cache_sh, seq, seq, nsh(P("dp")),
+                        nsh(P("dp", None)), nsh(P("dp")))
+        out_shardings = (
+            # Logits are host-read (sampling); multihost replicates them
+            # so every process can read locally.
+            nsh(P(None, None) if mh else P("dp", None)),
+            cache_sh,
+        )
+        return jit_plane(step, in_shardings, out_shardings)
+
+    if plane.role == "embed":
+        # return_hidden step (the /v1/embeddings path on a sharded
+        # engine — r3 raised NotImplementedError here).
+        step = make_forward_step(cfg, block_size, moe_mode=moe_mode,
+                                 mesh=mesh, return_hidden=True,
+                                 dp_local=plane.dp_local)
+        in_shardings = (param_sh, cache_sh, b2, b2, b, b2, b)
+        return jit_plane(step, in_shardings, (b2, cache_sh))
+
+    if plane.role == "mm":
+        # Multimodal prefill: masked chunk positions take provided
+        # [B, T, H] embeddings instead of the token lookup
+        # (llm/multimodal.py).  Embeddings shard like activations:
+        # batch over the batch axes, H replicated.
+        step = make_forward_step(cfg, block_size, moe_mode=moe_mode,
+                                 mesh=mesh, with_input_embeds=True,
+                                 dp_local=plane.dp_local)
+        b3 = nsh(P(batch_axes, None, None))
+        in_shardings = (param_sh, cache_sh, b2, b2, b, b2, b, b3, b2)
+        out_shardings = (
+            nsh(P(None, None) if mh else P(batch_axes, None)), cache_sh)
+        return jit_plane(step, in_shardings, out_shardings)
+
+    if plane.window > 0:
+        # Fused K-token decode window — the fast decode path for SERVED
+        # sharded models (VERDICT r3 weak #3).  Same contract as
+        # llama.make_decode_window; MoE models return a sixth output
+        # (accumulated expert-load counts through the fori_loop carry).
+        # window == 1 still builds the WINDOW program (degenerate
+        # single-iteration loop): callers chose the 11-arg run()
+        # contract, and silently handing back the 7-arg plain step
+        # would TypeError at their first dispatch.
+        run = make_decode_window(cfg, block_size, plane.window,
+                                 use_pallas_decode=plane.use_pallas,
+                                 greedy_only=plane.greedy_only, mesh=mesh,
+                                 dp_local=plane.dp_local,
+                                 moe_mode=moe_mode,
+                                 with_expert_load=cfg.is_moe)
+        in_shardings = (param_sh, cache_sh,
+                        b,    # last_tokens [B]
+                        b,    # positions0 [B]
+                        b,    # seq_lens0 [B]
+                        b2,   # block_tables [B, P]
+                        b,    # temp [B]
+                        b,    # top_k [B]
+                        b,    # top_p [B]
+                        b2,   # base_key_data [B, 2]
+                        b)    # key_offsets [B]
+        out_shardings = [
+            cache_sh,
+            # Tokens are the one host-read output: multihost replicates
+            # them so the fetch thread can read locally (collectives are
+            # illegal off the lockstep thread).
+            nsh(P(None, None) if mh else P(None, batch_axes)),
+            b,    # positions0 + K
+            b,    # seq_lens0 + K
+            b,    # key_offsets + K
+        ]
+        if cfg.is_moe:
+            out_shardings.append(nsh(P(None)))  # expert load
+        return jit_plane(run, in_shardings, out_shardings)
+
+    # Single-step planes (plain unified step / fused greedy).
+    inner = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
+                              with_expert_load=with_expert_load,
+                              use_pallas_decode=plane.use_pallas,
+                              dp_local=plane.dp_local)
+    div = ((mesh.shape["dp"] * mesh.shape["tp"])
+           if plane.dp_attention else 1)
+
+    def checked(params, cache, tokens, *rest):
+        if tokens.shape[0] % div:
+            # Shape check at trace time (batch is static under jit):
+            # surfaces a clear error instead of opaque GSPMD padding.
+            raise ValueError(
+                f"dp_attention: batch {tokens.shape[0]} must be a "
+                f"multiple of dp*tp = {div}")
+        return inner(params, cache, tokens, *rest)
+
+    step = checked if plane.dp_attention else inner
+    in_shardings = (param_sh, cache_sh,
+                    b2,   # tokens [B, T]
+                    b2,   # positions [B, T]
+                    b,    # seq_lens [B]
+                    b2,   # block_tables [B, P]
+                    b)    # sample_positions [B]
+
+    if plane.fused:
+        # FUSED greedy single step: forward + on-device argmax in ONE
+        # program with a donated cache, [B] int32 tokens out instead of
+        # [B, V] f32 logits (ISSUE 9 leg 3 — the sharded half of the r5
+        # single-step cliff; the unfused path was 3 eager dispatches plus
+        # a full-vocab output per token).  Multi-process meshes replicate
+        # the token output so every lockstep process reads it locally —
+        # the fused step IS in the audited command stream (ISSUE 12
+        # leg 4).
+        def fused(params, cache, tokens, positions, seq_lens,
+                  block_tables, sample_positions):
+            out = step(params, cache, tokens, positions, seq_lens,
+                       block_tables, sample_positions)
+            if with_expert_load:
+                logits, cache, load = out
+                return (jnp.argmax(logits, -1).astype(jnp.int32), cache,
+                        load)
+            logits, cache = out
+            return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+        out_shardings = [nsh(P(None) if mh else P(batch_axes)), cache_sh]
+        if with_expert_load:
+            out_shardings.append(nsh(P(None)))
+        return jit_plane(fused, in_shardings, out_shardings)
+
+    out_shardings = [
+        # Logits are host-read (sampling); multihost replicates them so
+        # every process reads locally.
+        nsh(P(None, None) if mh else P(batch_axes, None)),
+        cache_sh,
+    ]
+    if with_expert_load:
+        out_shardings.append(nsh(P(None)))
+    return jit_plane(step, in_shardings, out_shardings)
+
+
+# -- legacy spellings: thin PlaneSpec wrappers over make_sharded_step ------
+
+
+def make_sp_prefill_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
+                         kv_quant: bool = False):
+    """Ring-SP whole-prompt prefill (`role="sp_prefill"`): tokens and
+    positions shard P(dp, sp); same step signature otherwise."""
+    return make_sharded_step(cfg, block_size, mesh,
+                             PlaneSpec(role="sp_prefill", quant=kv_quant))
 
 
 def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
@@ -290,236 +596,13 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
                         dp_attention: bool = False,
                         dp_local: bool = False,
                         kv_quant: bool = False):
-    """Jit the fused K-token decode window under a mesh — the fast decode
-    path for SERVED sharded models (VERDICT r3 weak #3: without this, a
-    tp=8 70B decode would fall back to the per-token host loop over a
-    ~160 ms-RTT link).  Same contract as llama.make_decode_window; MoE
-    models return a sixth output (accumulated expert-load counts — the
-    aux threads through the fori_loop carry since r5).
-
-    `use_pallas_decode` routes attention through the Pallas kernel inside
-    a shard_map over (dp, tp) — heads over tp, or shard-local slots under
-    dp_attention locality (see _reject_pallas_dp_attention).
-
-    `kv_quant`: the cache pytree carries int8 pages + [S, Hkv] f32 scale
-    buffers (cache_pspecs kv_quant=True) and the attention bodies
-    dequantize shard-locally.
-    """
-    from dynamo_tpu.models.llama import make_decode_window
-    from dynamo_tpu.parallel.multihost import mesh_spans_processes
-
-    validate(cfg, mesh, dp_attention)
-    mh = mesh_spans_processes(mesh)
-    _reject_pallas_dp_attention(use_pallas_decode, dp_attention, dp_local)
-    # MoE windows (r5): the expert-load telemetry threads through the
-    # fori_loop carry; the window uses the same resolved moe mode as the
-    # engine's single step.
-    moe_mode = resolve_moe_mode(cfg, mesh)
-    run = make_decode_window(cfg, block_size, window,
-                             use_pallas_decode=use_pallas_decode,
-                             greedy_only=greedy_only, mesh=mesh,
-                             dp_local=dp_local,
-                             moe_mode=moe_mode,
-                             with_expert_load=cfg.is_moe)
-    batch_axes = ("dp", "tp") if dp_attention else "dp"
-    b = NamedSharding(mesh, P(batch_axes))
-    b2 = NamedSharding(mesh, P(batch_axes, None))
-    in_shardings = (
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     param_pspecs(cfg, moe_mode,
-                                  dp_attention=dp_attention)),
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
-                                  kv_quant)),
-        b,                                         # last_tokens [B]
-        b,                                         # positions0 [B]
-        b,                                         # seq_lens0 [B]
-        b2,                                        # block_tables [B, P]
-        b,                                         # temp [B]
-        b,                                         # top_k [B]
-        b,                                         # top_p [B]
-        b2,                                        # base_key_data [B, 2]
-        b,                                         # key_offsets [B]
-    )
-    out_shardings = [
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
-                                  kv_quant)),
-        # Tokens are the one host-read output: multihost replicates them
-        # so the fetch thread can read locally (collectives are illegal
-        # off the lockstep thread).
-        NamedSharding(mesh, P(None, None) if mh else P(None, batch_axes)),
-        b,                                         # positions0 + K
-        b,                                         # seq_lens0 + K
-        b,                                         # key_offsets + K
-    ]
-    if cfg.is_moe:
-        out_shardings.append(NamedSharding(mesh, P(None)))  # expert load
-    return _finalize(jax.jit(run, in_shardings=in_shardings,
-                             out_shardings=tuple(out_shardings),
-                             donate_argnums=(1,)), in_shardings, mesh)
-
-
-def make_sharded_embed_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
-                            dp_attention: bool = False,
-                            dp_local: bool = False,
-                            kv_quant: bool = False):
-    """Jit the return_hidden step under a mesh (the /v1/embeddings path on
-    a sharded engine — r3 raised NotImplementedError here)."""
-    from dynamo_tpu.models.llama import make_forward_step
-
-    validate(cfg, mesh, dp_attention)
-    moe_mode = resolve_moe_mode(cfg, mesh)
-    step = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
-                             return_hidden=True, dp_local=dp_local)
-    batch_axes = ("dp", "tp") if dp_attention else "dp"
-    b = NamedSharding(mesh, P(batch_axes))
-    b2 = NamedSharding(mesh, P(batch_axes, None))
-    in_shardings = (
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     param_pspecs(cfg, moe_mode, dp_attention)),
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
-                                  kv_quant)),
-        b2, b2, b, b2, b,
-    )
-    out_shardings = (
-        b2,                                        # hidden [B, H]
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
-                                  kv_quant)),
-    )
-    return _finalize(jax.jit(step, in_shardings=in_shardings,
-                             out_shardings=out_shardings,
-                             donate_argnums=(1,)), in_shardings, mesh)
-
-
-def make_sharded_mm_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
-                         dp_attention: bool = False,
-                         dp_local: bool = False,
-                         kv_quant: bool = False):
-    """Jit the multimodal prefill variant under a mesh: positions whose
-    mask is set take the provided [B, T, H] embeddings instead of the
-    token lookup (llm/multimodal.py; lifts VERDICT r4's sharded-engine
-    prompt_embeds rejection, engine.py:380).  Embeddings shard like
-    activations: batch over the batch axes, H replicated (the tp-sharded
-    projections consume them immediately)."""
-    from dynamo_tpu.models.llama import make_forward_step
-
-    validate(cfg, mesh, dp_attention)
-    moe_mode = resolve_moe_mode(cfg, mesh)
-    step = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
-                             with_input_embeds=True, dp_local=dp_local)
-    batch_axes = ("dp", "tp") if dp_attention else "dp"
-    from dynamo_tpu.parallel.multihost import mesh_spans_processes
-
-    mh = mesh_spans_processes(mesh)
-    b = NamedSharding(mesh, P(batch_axes))
-    b2 = NamedSharding(mesh, P(batch_axes, None))
-    b3 = NamedSharding(mesh, P(batch_axes, None, None))
-    in_shardings = (
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     param_pspecs(cfg, moe_mode, dp_attention)),
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
-                                  kv_quant)),
-        b2,                                        # tokens [B, T]
-        b2,                                        # positions [B, T]
-        b,                                         # seq_lens [B]
-        b2,                                        # block_tables [B, P]
-        b,                                         # sample_positions [B]
-        b3,                                        # input_embeds [B, T, H]
-        b2,                                        # embed_mask [B, T]
-    )
-    out_shardings = (
-        NamedSharding(mesh, P(None, None) if mh else P(batch_axes, None)),
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
-                                  kv_quant)),
-    )
-    return _finalize(jax.jit(step, in_shardings=in_shardings,
-                             out_shardings=out_shardings,
-                             donate_argnums=(1,)), in_shardings, mesh)
-
-
-def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
-                      moe_mode: str = "auto",
-                      with_expert_load: bool = False,
-                      dp_attention: bool = False,
-                      use_pallas_decode: bool = False,
-                      dp_local: bool = False,
-                      kv_quant: bool = False):
-    """Jit the unified engine step with explicit in/out shardings.
-
-    Returns `step(params, cache, tokens, positions, seq_lens, block_tables)`
-    → (logits, cache[, expert_load]).  Cache is donated (in-place paged-
-    cache update); logits come back replicated so the sampler/host sees
-    full vocab.
-
-    `dp_attention`: batch shards over (dp, tp) and the KV cache's slot
-    axis over tp — see param_pspecs/cache_pspecs.  Batch must be a
-    multiple of dp×tp.
-
-    `kv_quant`: int8 cache pytree with sharded scale buffers
-    (cache_pspecs kv_quant=True; ISSUE 9 leg 1).
-    """
-    from dynamo_tpu.models.llama import make_forward_step
-
-    validate(cfg, mesh, dp_attention)
-    _reject_pallas_dp_attention(use_pallas_decode, dp_attention, dp_local)
-    if dp_local and not dp_attention:
-        raise ValueError("dp_local implies dp_attention")
-    moe_mode = resolve_moe_mode(cfg, mesh, moe_mode)
-    inner = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
-                              with_expert_load=with_expert_load,
-                              use_pallas_decode=use_pallas_decode,
-                              dp_local=dp_local)
-    if dp_attention:
-        div = mesh.shape["dp"] * mesh.shape["tp"]
-
-        def step(params, cache, tokens, *rest):
-            # Shape check at trace time (batch is static under jit):
-            # surfaces a clear error instead of opaque GSPMD padding.
-            if tokens.shape[0] % div:
-                raise ValueError(
-                    f"dp_attention: batch {tokens.shape[0]} must be a "
-                    f"multiple of dp*tp = {div}")
-            return inner(params, cache, tokens, *rest)
-    else:
-        step = inner
-    batch_axes = ("dp", "tp") if dp_attention else "dp"
-    from dynamo_tpu.parallel.multihost import mesh_spans_processes
-
-    mh = mesh_spans_processes(mesh)
-    in_shardings = (
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     param_pspecs(cfg, moe_mode, dp_attention)),
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
-                                  kv_quant)),
-        NamedSharding(mesh, P(batch_axes, None)),  # tokens
-        NamedSharding(mesh, P(batch_axes, None)),  # positions
-        NamedSharding(mesh, P(batch_axes)),        # seq_lens
-        NamedSharding(mesh, P(batch_axes, None)),  # block_tables
-        NamedSharding(mesh, P(batch_axes)),        # sample_positions [B]
-    )
-    out_shardings = [
-        # Logits are host-read (sampling); multihost replicates them so
-        # every process reads locally.
-        NamedSharding(mesh,
-                      P(None, None) if mh else P(batch_axes, None)),
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     cache_pspecs(cfg.num_layers, dp_attention, dp_local,
-                                  kv_quant)),
-    ]
-    if with_expert_load:
-        out_shardings.append(NamedSharding(mesh, P(None)))
-    return _finalize(jax.jit(
-        step,
-        in_shardings=in_shardings,
-        out_shardings=tuple(out_shardings),
-        donate_argnums=(1,),
-    ), in_shardings, mesh)
+    """Fused K-token decode window (`plane.window=K`); see
+    llama.make_decode_window for the run() contract."""
+    return make_sharded_step(
+        cfg, block_size, mesh,
+        PlaneSpec(window=window, greedy_only=greedy_only,
+                  use_pallas=use_pallas_decode, dp_attention=dp_attention,
+                  dp_local=dp_local, quant=kv_quant))
 
 
 def make_sharded_greedy_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
@@ -529,68 +612,33 @@ def make_sharded_greedy_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
                              use_pallas_decode: bool = False,
                              dp_local: bool = False,
                              kv_quant: bool = False):
-    """Jit the FUSED greedy single step under a mesh: forward + on-device
-    argmax compile into ONE program with a donated cache, returning [B]
-    int32 tokens instead of [B, V] logits (ISSUE 9 leg 3 — the sharded
-    half of the r5 single-step cliff).  The unfused sharded path was a
-    step dispatch + row gather + argmax, three eager dispatches plus a
-    full-vocab f32 logits output per token; on a tunneled chip the extra
-    dispatches dominate the step.  Same fusion as the meshless
-    `EngineCore._greedy_step_fn`; multihost stays on the plain path (the
-    lockstep command stream replays the unfused step).
+    """Fused greedy single step (`plane.fused=True`): forward + argmax in
+    one donated-cache program, [B] tokens out."""
+    return make_sharded_step(
+        cfg, block_size, mesh,
+        PlaneSpec(fused=True, use_pallas=use_pallas_decode,
+                  dp_attention=dp_attention, dp_local=dp_local,
+                  quant=kv_quant),
+        with_expert_load, moe_mode=moe_mode)
 
-    Returns `fused(params, cache, tokens, positions, seq_lens,
-    block_tables, sample_positions)` → (tokens[B], cache[, expert_load]).
-    """
-    import jax.numpy as jnp
 
-    from dynamo_tpu.models.llama import make_forward_step
+def make_sharded_embed_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
+                            dp_attention: bool = False,
+                            dp_local: bool = False,
+                            kv_quant: bool = False):
+    """return_hidden step (`role="embed"`) — the /v1/embeddings path."""
+    return make_sharded_step(
+        cfg, block_size, mesh,
+        PlaneSpec(role="embed", dp_attention=dp_attention,
+                  dp_local=dp_local, quant=kv_quant))
 
-    validate(cfg, mesh, dp_attention)
-    _reject_pallas_dp_attention(use_pallas_decode, dp_attention, dp_local)
-    if dp_local and not dp_attention:
-        raise ValueError("dp_local implies dp_attention")
-    moe_mode = resolve_moe_mode(cfg, mesh, moe_mode)
-    inner = make_forward_step(cfg, block_size, moe_mode=moe_mode, mesh=mesh,
-                              with_expert_load=with_expert_load,
-                              use_pallas_decode=use_pallas_decode,
-                              dp_local=dp_local)
-    div = (mesh.shape["dp"] * mesh.shape["tp"]) if dp_attention else 1
 
-    def fused(params, cache, tokens, positions, seq_lens, block_tables,
-              sample_positions):
-        if tokens.shape[0] % div:
-            # Same trace-time check as make_sharded_step: a clear error
-            # instead of opaque GSPMD padding (the fused path must not
-            # hide a misconfiguration the unfused path surfaces).
-            raise ValueError(
-                f"dp_attention: batch {tokens.shape[0]} must be a "
-                f"multiple of dp*tp = {div}")
-        out = inner(params, cache, tokens, positions, seq_lens,
-                    block_tables, sample_positions)
-        if with_expert_load:
-            logits, cache, load = out
-            return (jnp.argmax(logits, -1).astype(jnp.int32), cache, load)
-        logits, cache = out
-        return jnp.argmax(logits, -1).astype(jnp.int32), cache
-
-    batch_axes = ("dp", "tp") if dp_attention else "dp"
-    cache_sh = jax.tree.map(
-        lambda s: NamedSharding(mesh, s),
-        cache_pspecs(cfg.num_layers, dp_attention, dp_local, kv_quant))
-    in_shardings = (
-        jax.tree.map(lambda s: NamedSharding(mesh, s),
-                     param_pspecs(cfg, moe_mode, dp_attention)),
-        cache_sh,
-        NamedSharding(mesh, P(batch_axes, None)),  # tokens [B, 1]
-        NamedSharding(mesh, P(batch_axes, None)),  # positions [B, 1]
-        NamedSharding(mesh, P(batch_axes)),        # seq_lens [B]
-        NamedSharding(mesh, P(batch_axes, None)),  # block_tables [B, P]
-        NamedSharding(mesh, P(batch_axes)),        # sample_positions [B]
-    )
-    out_shardings = [NamedSharding(mesh, P(batch_axes)),  # tokens [B]
-                     cache_sh]
-    if with_expert_load:
-        out_shardings.append(NamedSharding(mesh, P(None)))
-    return jax.jit(fused, in_shardings=in_shardings,
-                   out_shardings=tuple(out_shardings), donate_argnums=(1,))
+def make_sharded_mm_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
+                         dp_attention: bool = False,
+                         dp_local: bool = False,
+                         kv_quant: bool = False):
+    """Multimodal input-embeds prefill (`role="mm"`)."""
+    return make_sharded_step(
+        cfg, block_size, mesh,
+        PlaneSpec(role="mm", dp_attention=dp_attention,
+                  dp_local=dp_local, quant=kv_quant))

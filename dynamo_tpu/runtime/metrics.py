@@ -269,6 +269,13 @@ class EngineStepCounters:
         self.h2d_uploads = 0
         self.kv_read_bytes_modeled = 0
         self.decode_tokens_emitted = 0
+        # Modeled PER-CHIP ICI bytes the ring-SP prefill exchange moved
+        # (ISSUE 12 satellite): each chip sends its resident K/V chunk on
+        # (sp−1) of sp hops per layer, so the series halves when the
+        # quantized cache halves the per-token ring payload
+        # (KvCacheConfig.ring_payload_bytes_per_token) — the sp analog of
+        # the kv_read_bytes_modeled honesty series.
+        self.ring_exchange_bytes_modeled = 0
         # Mixed-prefill cost calibration (ISSUE 10 satellite): EWMAs of
         # engine-thread wall seconds per window-decode token (plain
         # windows) and per concurrently-dispatched prefill token (the
@@ -299,6 +306,11 @@ class EngineStepCounters:
         it emitted; host-int arithmetic only."""
         self.kv_read_bytes_modeled += int(nbytes)
         self.decode_tokens_emitted += int(tokens)
+
+    def note_ring_exchange(self, nbytes: int) -> None:
+        """Tally modeled per-chip ring-SP exchange bytes (sp prefill
+        dispatches only); host-int arithmetic only."""
+        self.ring_exchange_bytes_modeled += int(nbytes)
 
     def note_window_interval(self, wall_s: float, window_tokens: int,
                              prefill_tokens: int) -> None:
@@ -357,6 +369,7 @@ class EngineStepCounters:
             "h2d_uploads": self.h2d_uploads,
             "kv_read_bytes_modeled": self.kv_read_bytes_modeled,
             "decode_tokens_emitted": self.decode_tokens_emitted,
+            "ring_exchange_bytes_modeled": self.ring_exchange_bytes_modeled,
         }
 
     def snapshot(self) -> "EngineStepCounters":

@@ -95,10 +95,16 @@ def parse_args(argv=None):
                         "path); decode stays on the tp/dp plane")
     p.add_argument("--pp", type=int, default=1,
                    help="pipeline-parallel degree (GPipe stage-rotated "
-                        "step).  Excludes spec decode, multimodal "
-                        "embeds, /v1/embeddings and --kv-quant — the "
-                        "engine rejects those combos with pointed "
-                        "errors")
+                        "step).  Decode rides the fused stage programs "
+                        "(all-in-one greedy step + schedule-looping "
+                        "windows) and --kv-quant composes via stacked "
+                        "scale buffers; the remaining impossible combos "
+                        "(spec decode, multimodal embeds, "
+                        "/v1/embeddings) reject with the capability "
+                        "table's pointed errors")
+    p.add_argument("--pp-microbatches", type=int, default=2,
+                   help="GPipe microbatch count for the pp stage "
+                        "schedule (batch rows pad to a multiple of it)")
     p.add_argument("--dp-attention", action="store_true",
                    help="batch-sharded attention with slot-sharded KV "
                         "(tp beyond the kv-head count; reference sglang "
@@ -126,9 +132,11 @@ def parse_args(argv=None):
                         "int8 with per-token-per-head f32 scales and "
                         "dequantizes inside the decode kernel — ~0.53x "
                         "the HBM bytes per context token at serving "
-                        "geometry.  Meshless engines only; prefill and "
-                        "decode workers of one disagg pair must match "
-                        "(mismatched peers refuse block transfer loudly)")
+                        "geometry.  Composes with every mesh (tp/dp/"
+                        "dp-attention/sp/pp/multihost — ISSUE 12); "
+                        "prefill and decode workers of one disagg pair "
+                        "must match (mismatched peers refuse block "
+                        "transfer loudly)")
     p.add_argument("--spec-decode", type=int, default=0, metavar="K",
                    help="self-speculative decoding: draft K tokens per "
                         "decode step (prompt-lookup n-gram drafter) and "
@@ -255,6 +263,13 @@ def run_follower_rank(args) -> None:
                      mesh=build_mesh(args),
                      dp_attention=args.dp_attention,
                      decode_window=args.decode_window,
+                     # The shadow engine must derive the SAME compiled
+                     # programs as the leader: cache mode and microbatch
+                     # count are part of that identity (ISSUE 12 leg 4 —
+                     # a follower without kv_quant would build a bf16
+                     # cache and diverge on the first quantized step).
+                     kv_quant=getattr(args, "kv_quant", "none"),
+                     pp_microbatches=getattr(args, "pp_microbatches", 2),
                      scheduler=SchedulerConfig(
                          block_size=args.block_size,
                          max_prefill_chunk=args.max_prefill_chunk)),
@@ -296,6 +311,7 @@ async def build_engine(args, kv_event_sink):
                      dp_attention=args.dp_attention,
                      decode_window=args.decode_window,
                      kv_quant=getattr(args, "kv_quant", "none"),
+                     pp_microbatches=getattr(args, "pp_microbatches", 2),
                      speculative_tokens=getattr(args, "spec_decode", 0),
                      speculative_ngram=getattr(args, "spec_ngram", 3),
                      packed_prefill={"auto": None, "on": True,

@@ -37,11 +37,16 @@ def main() -> None:
     tp = min(cfg.num_kv_heads, max(1, total // 2))
     mesh = make_mesh(MeshConfig(dp=total // tp, tp=tp), jax.devices())
     dp_attention = mode == "dp_attention"
+    # "fused_int8" (ISSUE 12 leg 4 — the lockstep-2proc grid cell):
+    # int8 KV + single-step decode, so the leader's command stream
+    # replays the FUSED greedy step (replicated [B] token output) with
+    # quantized scale buffers riding the sharded cache pytree.
     core = EngineCore(EngineConfig(
         model=cfg, num_blocks=64, mesh=mesh,
         dp_attention=dp_attention,
         enable_prefix_cache=(mode == "prefix"),
-        decode_window=4,
+        kv_quant="int8" if mode == "fused_int8" else "none",
+        decode_window=1 if mode == "fused_int8" else 4,
         scheduler=SchedulerConfig(block_size=16)))
 
     if role == "follower":
@@ -62,8 +67,12 @@ def main() -> None:
         "req-b": [9, 8, 7, 6, 5],
         "req-c": [42, 43],
     }
-    sampled = {"req-c": SamplingParams(temperature=0.8, top_k=20,
-                                       seed=1234, max_tokens=12)}
+    # fused_int8 keeps every request greedy so the single-step path
+    # actually dispatches the fused program (a stochastic row would
+    # route the whole batch through the plain step).
+    sampled = ({} if mode == "fused_int8"
+               else {"req-c": SamplingParams(temperature=0.8, top_k=20,
+                                             seed=1234, max_tokens=12)})
     for rid, toks in prompts.items():
         core.add_request(rid, toks,
                          sampled.get(rid, SamplingParams(max_tokens=12)))

@@ -1,0 +1,306 @@
+"""The feature-composition grid (ISSUE 12): every (feature × mesh) cell
+of the README "Sharded serving" matrix is either exercised token-exact
+against the meshless oracle HERE, or declared impossible in the ONE
+capability table (parallel.sharding.plane_capability) with a pointed
+error this file asserts — no silent gaps.
+
+The matrix used to be a code grid (per-combo step builders + engine
+rejection lists); the PlaneSpec refactor collapsed it to this test grid.
+One shared tiny geometry (identical to tests/test_sharded_serving.py's)
+keeps the compiled-shape set compile-cache-friendly; the heaviest cells
+are slow-marked so the warm tier-1 suite stays inside its budget.  The
+lockstep-2proc column runs as subprocess pairs in
+tests/test_multihost.py (`fused_int8` is the grid's multihost cell).
+"""
+
+import jax
+import pytest
+
+from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.engine.scheduler import SchedulerConfig
+from dynamo_tpu.models import config as mcfg
+from dynamo_tpu.parallel import MeshConfig, make_mesh
+from dynamo_tpu.parallel.sharding import PlaneSpec, plane_capability
+
+# SAME geometry as tests/test_sharded_serving.py — the grid's engines
+# lower to already-cached HLO wherever the cell's program shape repeats.
+SCHED = dict(max_seqs=4, block_size=8, max_pages_per_seq=8,
+             max_prefill_chunk=16, decode_buckets=(2, 4),
+             prefill_buckets=(8, 16))
+
+PROMPTS = {"a": [5, 6, 7, 8, 9, 10, 5, 6, 7, 8],
+           "b": list(range(20, 34))}
+
+MESHES = {
+    "tp2": (MeshConfig(tp=2), {}),
+    "dp2": (MeshConfig(dp=2), {}),
+    "dp_local": (MeshConfig(tp=2, dp=2), dict(dp_attention=True)),
+    "sp2": (MeshConfig(sp=2, tp=2), dict(sp_prefill_threshold=8)),
+    "pp2": (MeshConfig(pp=2), {}),
+}
+
+
+def _run_cell(mesh_name=None, kv_quant="none", spec=0, decode_window=1,
+              **extra):
+    kwargs = dict(enable_prefix_cache=False)
+    mesh = None
+    if mesh_name is not None:
+        mesh_cfg, mesh_kwargs = MESHES[mesh_name]
+        mesh = make_mesh(mesh_cfg, jax.devices()[:mesh_cfg.size])
+        kwargs.update(mesh_kwargs)
+    kwargs.update(extra)
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64, mesh=mesh,
+        kv_quant=kv_quant, speculative_tokens=spec,
+        decode_window=decode_window, window_pipeline_depth=2,
+        scheduler=SchedulerConfig(**SCHED), **kwargs))
+    for rid, toks in PROMPTS.items():
+        core.add_request(rid, toks, SamplingParams(max_tokens=12))
+    outputs = {}
+    for _ in range(300):
+        for d in core.step():
+            outputs.setdefault(d.request_id, []).extend(d.token_ids)
+        if not core._requests:
+            break
+    assert not core._requests, "engine did not finish"
+    return core, outputs
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    """Meshless single-step greedy output — the one parity reference
+    every exercised cell must match byte-identically."""
+    _, out = _run_cell()
+    return out
+
+
+# (cell id, engine kwargs, extra post-run asserts key) — each cell is a
+# NEW composition this PR opened (the pre-existing yes-cells keep their
+# pins in test_sharded_serving.py / test_kv_quant.py).
+CELLS = {
+    # int8 × spec × head-sharded tp: quantized verify chunks.
+    "tp2+int8+spec": dict(mesh_name="tp2", kv_quant="int8", spec=3),
+    # int8 × dp window: replicated-cache dp with quantized windows.
+    "dp2+int8+window": dict(mesh_name="dp2", kv_quant="int8",
+                            decode_window=4),
+    # ISSUE 12 leg 5: spec verify resolves rows to the owning shard's
+    # slot range under dp-attention locality.
+    "dp_local+spec": dict(mesh_name="dp_local", spec=3),
+    # ISSUE 12 leg 1: quantized ring-SP exchange, then int8 decode.
+    "sp2+int8+window": dict(mesh_name="sp2", kv_quant="int8",
+                            decode_window=4),
+    # ISSUE 12 leg 3: the pp decode window (schedule-looping program).
+    "pp2+window": dict(mesh_name="pp2", decode_window=4),
+    # ISSUE 12 leg 3: the all-in-one fused pp greedy step.
+    "pp2+fused": dict(mesh_name="pp2", decode_window=1),
+    # ISSUE 12 leg 2: int8 through the stacked pp layout.
+    "pp2+int8": dict(mesh_name="pp2", kv_quant="int8", decode_window=1),
+}
+
+SLOW_CELLS = {
+    # spec × ring-SP mesh (the sp axis idles during decode; the matrix
+    # row claims yes, so it gets a pin).
+    "sp2+spec": dict(mesh_name="sp2", spec=3),
+    # int8 × spec × dp-attention locality — the heaviest three-way cell.
+    "dp_local+int8+spec": dict(mesh_name="dp_local", kv_quant="int8",
+                               spec=3),
+    # int8 × pp × window.
+    "pp2+int8+window": dict(mesh_name="pp2", kv_quant="int8",
+                            decode_window=4),
+}
+
+
+def _assert_cell(name, kwargs, oracle):
+    core, out = _run_cell(**kwargs)
+    assert out == oracle, f"cell {name} diverged from the meshless oracle"
+    # The cell must have run the plane it claims, not a fallback.
+    if kwargs.get("spec"):
+        assert core.counters.spec_dispatches > 0, \
+            f"cell {name} never dispatched a speculative verify"
+    if kwargs.get("mesh_name") == "sp2":
+        assert core.sp_prefill_count == len(PROMPTS), \
+            f"cell {name} prefill skipped the ring path"
+        assert core.counters.ring_exchange_bytes_modeled > 0
+    if kwargs.get("decode_window", 1) > 1:
+        assert core.counters.window_dispatches > 0, \
+            f"cell {name} never dispatched a decode window"
+    elif not kwargs.get("spec"):
+        assert core._greedy_fused is not None, \
+            f"cell {name} single-step decode did not take the fused path"
+
+
+@pytest.mark.parametrize("name", sorted(CELLS))
+def test_composition_cell(name, oracle):
+    _assert_cell(name, CELLS[name], oracle)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(SLOW_CELLS))
+def test_composition_cell_slow(name, oracle):
+    _assert_cell(name, SLOW_CELLS[name], oracle)
+
+
+def test_pp_fused_step_counters():
+    """The pp half of the r5 single-step cliff is dead (ISSUE 12 leg 3):
+    steady pp single-step decode is ONE fused stage-program dispatch
+    with ONE host sync and zero new compiled shapes per engine
+    iteration — the same pin the meshless and tp paths carry."""
+    mesh = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64,
+        mesh=mesh, decode_window=1, enable_prefix_cache=False,
+        scheduler=SchedulerConfig(**SCHED)))
+    for rid, toks in PROMPTS.items():
+        core.add_request(rid, toks, SamplingParams(max_tokens=30))
+    for _ in range(6):   # prefill + warm the fused program
+        core.step()
+    assert core._greedy_fused is not None
+    base = core.counters.snapshot()
+    n = 8
+    for _ in range(n):
+        core.step()
+    d = core.counters.delta(base)
+    assert d["single_step_dispatches"] == n
+    assert d["host_syncs"] == n, "fused pp step must cost 1 sync"
+    assert d["xla_cache_misses"] == 0, "steady pp shape recompiled"
+
+
+def test_sp_ring_exchange_bytes_halve_under_int8():
+    """Modeled ring traffic honesty (ISSUE 12 satellite): the quantized
+    ring exchange moves int8 rows + f32 scales instead of full-precision
+    chunks, so the per-chip `ring_exchange_bytes_modeled` series must
+    shrink by exactly the packed-payload ratio — the sp analog of the
+    kv_quant traffic_ratio the gate floors pin."""
+    cfg = mcfg.get_config("tiny-test")
+    _, _ = (None, None)
+    core_bf, _ = _run_cell(mesh_name="sp2")
+    core_i8, _ = _run_cell(mesh_name="sp2", kv_quant="int8")
+    bf = core_bf.counters.ring_exchange_bytes_modeled
+    i8 = core_i8.counters.ring_exchange_bytes_modeled
+    assert bf > 0 and i8 > 0
+    H, D = cfg.num_kv_heads, cfg.head_dim
+    itemsize = jax.numpy.dtype(core_bf.cache_cfg.dtype).itemsize
+    want = (H * D + 4 * H) / (H * D * itemsize)
+    assert abs(i8 / bf - want) < 1e-6
+
+
+def test_per_chip_modeled_bytes_pp_sp():
+    """tp2 parity discipline (PR 9) extended to pp2/sp2 (ISSUE 12
+    satellite): a pp2 engine's per-chip effective_bytes_per_token HALVES
+    vs meshless (each stage sweeps its layer slice for all rows) — int8
+    included, where the numerator also carries the stacked scale
+    buffers; an sp2(+tp2) engine divides by dp·tp ONLY (the sp axis
+    replicates decode — dividing by it would be flattering, not
+    honest)."""
+    meshless, _ = _run_cell()
+    b0 = meshless.counters.effective_bytes_per_token
+    assert b0 > 0
+
+    pp2, _ = _run_cell(mesh_name="pp2")
+    assert pp2.kv_traffic_shards == 2 and pp2.kv_shard_count == 2
+    assert abs(pp2.counters.effective_bytes_per_token / b0 - 0.5) < 1e-6
+
+    meshless_i8, _ = _run_cell(kv_quant="int8")
+    pp2_i8, _ = _run_cell(mesh_name="pp2", kv_quant="int8")
+    b0_i8 = meshless_i8.counters.effective_bytes_per_token
+    assert b0_i8 > 0
+    assert abs(pp2_i8.counters.effective_bytes_per_token / b0_i8
+               - 0.5) < 1e-6
+
+    sp2, _ = _run_cell(mesh_name="sp2")  # sp2 × tp2 mesh
+    assert sp2.kv_traffic_shards == 2  # dp*tp — tp halves, sp does NOT
+    assert abs(sp2.counters.effective_bytes_per_token / b0 - 0.5) < 1e-6
+
+    # Residency honesty under pp+int8: per-chip block bytes report the
+    # stacked pages AND scale buffers divided by the stage count.
+    from dynamo_tpu.runtime.metrics import KvCacheMetrics, MetricsRegistry
+
+    kvm = KvCacheMetrics(MetricsRegistry())
+    kvm.observe_engine(pp2_i8)
+    got = kvm.kv_bytes_per_block.value(labels={"kv_quant": "int8"})
+    assert got == pp2_i8.cache_cfg.bytes_per_block / 2
+
+
+def test_declared_impossible_cells_are_pointed():
+    """Acceptance: every matrix '—' that remains is DECLARED in the one
+    capability table, and serving code raises that exact reason — the
+    grid asserts both halves so a silently-rejecting cell can't hide."""
+    tp2 = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
+    pp2 = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+
+    # spec × pp: declared (stage program banks one sampled row).
+    cap = plane_capability(pp2, PlaneSpec(spec=True))
+    assert not cap.ok and "spec" in cap.reason
+    with pytest.raises(ValueError, match="pp") as ei:
+        EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=64, mesh=pp2,
+            speculative_tokens=3, enable_prefix_cache=False,
+            scheduler=SchedulerConfig(**SCHED)))
+    assert str(ei.value) == cap.reason
+
+    # spec × multihost: loudly versioned out of the lockstep stream.
+    cap = plane_capability(tp2, PlaneSpec(spec=True), multihost=True)
+    assert not cap.ok and "lockstep" in cap.reason
+
+    # pallas × plain dp_attention (no locality): pages span shards.
+    cap = plane_capability(
+        tp2, PlaneSpec(use_pallas=True, dp_attention=True))
+    assert not cap.ok and "locality" in cap.reason
+    dpl = make_mesh(MeshConfig(tp=2, dp=2), jax.devices()[:4])
+    with pytest.raises(ValueError, match="locality") as ei:
+        EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=64, mesh=dpl,
+            dp_attention=True, dp_attention_local=False,
+            use_pallas_decode=True, enable_prefix_cache=False,
+            scheduler=SchedulerConfig(**SCHED)))
+    assert str(ei.value) == cap.reason
+
+    # pallas × pp: the kernel is not wired into the stage scan; auto
+    # keeps pp on the gather path, explicit True raises.
+    cap = plane_capability(pp2, PlaneSpec(use_pallas=True))
+    assert not cap.ok and "stage scan" in cap.reason
+    # pallas × multihost: unaudited shard_map custom calls — declared;
+    # auto keeps lockstep meshes on the gather path.
+    cap_mh = plane_capability(tp2, PlaneSpec(use_pallas=True),
+                              multihost=True)
+    assert not cap_mh.ok and "lockstep" in cap_mh.reason
+    with pytest.raises(ValueError, match="stage scan") as ei:
+        EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=64, mesh=pp2,
+            use_pallas_decode=True, enable_prefix_cache=False,
+            scheduler=SchedulerConfig(**SCHED)))
+    assert str(ei.value) == cap.reason
+
+    # embeddings / multimodal × pp and × multihost: declared.
+    for role in ("embed", "mm"):
+        assert not plane_capability(pp2, PlaneSpec(role=role)).ok
+        assert not plane_capability(tp2, PlaneSpec(role=role),
+                                    multihost=True).ok
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64, mesh=pp2,
+        enable_prefix_cache=False, scheduler=SchedulerConfig(**SCHED)))
+    cap = plane_capability(pp2, PlaneSpec(role="embed"))
+    with pytest.raises(ValueError) as ei:
+        core.embed_tokens([[1, 2, 3]])
+    assert str(ei.value) == cap.reason
+
+    # pp × multihost: declared.
+    assert not plane_capability(pp2, PlaneSpec(), multihost=True).ok
+
+    # Every EXERCISED cell above must be capability-table-OK — a cell
+    # that runs here but is declared impossible (or vice versa) means
+    # the table and the grid drifted.
+    for name, kw in {**CELLS, **SLOW_CELLS}.items():
+        mesh_cfg, mesh_kwargs = MESHES[kw["mesh_name"]]
+        mesh = make_mesh(mesh_cfg, jax.devices()[:mesh_cfg.size])
+        plane = PlaneSpec(
+            quant=kw.get("kv_quant") == "int8",
+            spec=bool(kw.get("spec")),
+            window=kw.get("decode_window", 1),
+            fused=kw.get("decode_window", 1) <= 1,
+            dp_attention=bool(mesh_kwargs.get("dp_attention")),
+            dp_local=bool(mesh_kwargs.get("dp_attention")))
+        cap = plane_capability(mesh, plane)
+        assert cap.ok, f"grid cell {name} is declared impossible: " \
+                       f"{cap.reason}"

@@ -5,6 +5,7 @@ cache misses after warmup).
 """
 
 import numpy as np
+import pytest
 
 from dynamo_tpu.engine.engine import EngineConfig, EngineCore
 from dynamo_tpu.engine.sampling import SamplingParams
@@ -296,6 +297,42 @@ def test_profile_decode_tp_emits_sharded_phases():
     assert out["kv_bytes_per_step"] == full["kv_bytes_per_step_bf16"] // 2
 
 
+@pytest.mark.slow
+def test_profile_decode_pp_emits_stage_phases():
+    """ISSUE 12 satellite: `--pp 2` profiles the fused pp stage programs
+    (the schedule-looping decode window over the stacked layout) and
+    divides modeled bytes by the stage count — the engine's
+    kv_traffic_shards discipline (slow: one more subprocess compile)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "profile_decode.py"),
+         "--model", "tiny-test", "--batch", "2", "--ctx", "16",
+         "--block", "8", "--width", "4", "--window", "2", "--pp", "2",
+         "--no-probes", "--no-kernel", "--json"],
+        capture_output=True, text=True, timeout=280,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 JAX_COMPILATION_CACHE_DIR=os.environ.get(
+                     "JAX_COMPILATION_CACHE_DIR",
+                     "/tmp/dynamo_tpu_test_xla_cache")),
+        cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["pp"] == 2
+    assert out["modeled_byte_shards"] == 2
+    assert "window_ms_per_tok" in out["phases"]
+    from dynamo_tpu.bench.decode_wall import kv_quant_traffic
+    from dynamo_tpu.models import config as mcfg
+
+    full = kv_quant_traffic(mcfg.get_config("tiny-test"),
+                            block_size=8, batch=2, ctx=16)
+    assert out["kv_bytes_per_step"] == full["kv_bytes_per_step_bf16"] // 2
+
+
 def test_counters_expose_dict():
     core = _engine(decode_window=2)
     core.add_request("a", [5, 6, 7, 8], SamplingParams(max_tokens=6))
@@ -306,6 +343,7 @@ def test_counters_expose_dict():
                       "single_step_dispatches", "prefill_dispatches",
                       "packed_prefill_dispatches", "spec_dispatches",
                       "h2d_uploads", "kv_read_bytes_modeled",
-                      "decode_tokens_emitted"}
+                      "decode_tokens_emitted",
+                      "ring_exchange_bytes_modeled"}
     assert d["prefill_dispatches"] >= 1
     assert d["xla_cache_misses"] >= 1  # cold engine must compile

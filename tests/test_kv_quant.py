@@ -223,32 +223,39 @@ def test_int8_engine_counts_fewer_effective_bytes():
 
 
 def test_kv_quant_mesh_composition_gating():
-    """ISSUE 9: int8 composes with single-process tp/dp meshes (scales
-    shard with their kv heads — construction succeeds and the sharded
-    cache pytree carries sharded scale buffers); the still-unsupported
-    combos (pp stacked layout, ring-SP prefill) reject with pointed
-    errors instead of the old blanket meshless-only rule."""
+    """ISSUE 12: int8 composes with EVERY mesh — the old pp/ring-SP
+    rejections are gone (stacked scale buffers and the quantized ring
+    exchange landed), construction succeeds and each layout's cache
+    pytree carries its scale buffers; the capability table
+    (parallel.sharding.plane_capability) is where any future impossible
+    combo must be declared."""
     from dynamo_tpu.parallel import MeshConfig, make_mesh
 
+    sched = SchedulerConfig(
+        max_seqs=8, block_size=BS, max_pages_per_seq=8,
+        max_prefill_chunk=16,
+        decode_buckets=(1, 2, 4, 8), prefill_buckets=(8, 16))
     tp2 = make_mesh(MeshConfig(tp=2), jax.devices()[:2])
     core = EngineCore(EngineConfig(
         model=TINY, num_blocks=64, mesh=tp2, kv_quant="int8",
-        enable_prefix_cache=False,
-        scheduler=SchedulerConfig(
-            max_seqs=8, block_size=BS, max_pages_per_seq=8,
-            max_prefill_chunk=16,
-            decode_buckets=(1, 2, 4, 8), prefill_buckets=(8, 16))))
+        enable_prefix_cache=False, scheduler=sched))
     assert kvc.cache_is_quantized(core.cache)
     assert core.kv_shard_count == 2
 
     pp2 = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
-    with pytest.raises(ValueError, match="pipeline"):
-        EngineCore(EngineConfig(model=TINY, num_blocks=64,
-                                kv_quant="int8", mesh=pp2))
+    core_pp = EngineCore(EngineConfig(
+        model=TINY, num_blocks=64, kv_quant="int8", mesh=pp2,
+        enable_prefix_cache=False, scheduler=sched))
+    assert kvc.cache_is_quantized(core_pp.cache)
+    assert core_pp.cache["k_scale"].shape[0] == TINY.num_layers  # stacked
+
     sp2 = make_mesh(MeshConfig(sp=2), jax.devices()[:2])
-    with pytest.raises(ValueError, match="ring"):
-        EngineCore(EngineConfig(model=TINY, num_blocks=64,
-                                kv_quant="int8", mesh=sp2))
+    core_sp = EngineCore(EngineConfig(
+        model=TINY, num_blocks=64, kv_quant="int8", mesh=sp2,
+        enable_prefix_cache=False, scheduler=sched))
+    assert kvc.cache_is_quantized(core_sp.cache)
+    assert core_sp._sp_step is not None
+
     with pytest.raises(ValueError, match="kv_quant"):
         kvc.KvCacheConfig(num_blocks=4, block_size=8, num_layers=1,
                           num_kv_heads=2, head_dim=16, kv_quant="fp8")

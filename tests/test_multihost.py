@@ -89,15 +89,20 @@ def _single_process_reference(mode: str):
         model=cfg, num_blocks=64, mesh=mesh,
         dp_attention=(mode == "dp_attention"),
         enable_prefix_cache=(mode == "prefix"),
-        decode_window=4,
+        kv_quant="int8" if mode == "fused_int8" else "none",
+        decode_window=1 if mode == "fused_int8" else 4,
         scheduler=SchedulerConfig(block_size=16)))
     prompts = {
         "req-a": [1, 2, 3, 4, 5, 6, 7, 8],
         "req-b": [9, 8, 7, 6, 5],
         "req-c": [42, 43],
     }
-    sampled = {"req-c": SamplingParams(temperature=0.8, top_k=20,
-                                       seed=1234, max_tokens=12)}
+    # fused_int8 keeps every request greedy so the single-step path
+    # actually dispatches the fused program (a stochastic row would
+    # route the whole batch through the plain step).
+    sampled = ({} if mode == "fused_int8"
+               else {"req-c": SamplingParams(temperature=0.8, top_k=20,
+                                             seed=1234, max_tokens=12)})
     for rid, toks in prompts.items():
         core.add_request(rid, toks,
                          sampled.get(rid, SamplingParams(max_tokens=12)))
@@ -114,6 +119,22 @@ def _single_process_reference(mode: str):
 def test_multihost_decode_matches_single_process(mode):
     got = _run_pair(mode)
     want = _single_process_reference(mode)
+    for rid in want:
+        assert got[rid] == want[rid], (
+            f"{rid}: multihost {got[rid]} != single-process {want[rid]}")
+    assert all(len(v) > 0 for v in got.values())
+
+
+@pytest.mark.slow
+def test_multihost_fused_int8_matches_single_process():
+    """The lockstep-2proc cell of the composition grid (ISSUE 12 leg 4,
+    tests/test_compose_matrix.py documents the full grid): int8 KV and
+    the FUSED greedy single step both ride the audited command stream —
+    the leader broadcasts step(), every process dispatches the same
+    fused program over the quantized sharded cache, and the replicated
+    [B] token output reads identically everywhere."""
+    got = _run_pair("fused_int8")
+    want = _single_process_reference("fused_int8")
     for rid in want:
         assert got[rid] == want[rid], (
             f"{rid}: multihost {got[rid]} != single-process {want[rid]}")

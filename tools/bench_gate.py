@@ -265,16 +265,15 @@ def decode_wall_checks() -> dict:
 
 
 def sharded_decode_checks() -> dict:
-    """ISSUE 9 smoke: the sharded fast-decode plane measured on the CPU
-    mesh rig — the tp2 fused window and fused greedy single step must
-    run through the exact make_sharded_window / make_sharded_greedy_step
-    programs a served sharded engine dispatches, the section must carry
-    the gated ratio, and the gate floor must fail a fabricated
-    slow-sharded run (tok_s_per_chip_ratio below 0.8 on a TPU doc).
+    """ISSUE 9 + 12 smoke: the sharded fast-decode plane measured on the
+    CPU mesh rig — tp2 fused window/greedy step, the pp2 all-in-one
+    stage program vs its unfused loop, the sp2 mode, int8 on all three,
+    and the compose_matrix summary (no cell may read "rejected"; the
+    declared-impossible cells must quote the capability table).
 
-    The CPU ratio itself is NOT gated: host-process sharding overhead
-    at tiny geometry swamps it; only presence + plumbing are asserted
-    here, the 0.8 floor binds on TPU rounds."""
+    The CPU ratios are NOT gated: host-process sharding overhead at tiny
+    geometry swamps them; only presence + plumbing are asserted here,
+    the 0.8 / 1.2 floors bind on TPU rounds."""
     import jax
 
     from dynamo_tpu.bench.sharded_decode import run_sharded_decode
@@ -282,19 +281,44 @@ def sharded_decode_checks() -> dict:
 
     out = run_sharded_decode(
         mcfg.get_config("tiny-test"), batch=4, ctx=16, block=8, width=4,
-        window=2, modes=("tp2",), with_int8=True)
+        window=2, modes=("tp2", "sp2", "pp2"), with_int8=True)
     tp2 = out.get("tp2", {})
+    pp2 = out.get("pp2", {})
+    sp2 = out.get("sp2", {})
+    matrix = out.get("compose_matrix", {})
+    statuses = [c.get("status", "") for c in matrix.values()]
     ran = "tok_s_per_chip" in tp2
     return {
         "sharded_decode_devices": out["devices"],
         "sharded_decode_ran_tp2": ran,
         "sharded_decode_ratio": out.get("tok_s_per_chip_ratio"),
+        "sharded_decode_pp_fused_vs_single": out.get(
+            "pp_fused_vs_single"),
         "sharded_decode_section_ok": (
             ran and isinstance(out.get("tok_s_per_chip_ratio"), float)
             and out["tok_s_per_chip_ratio"] > 0
             and tp2.get("single_step_ms", 0) > 0
             and tp2.get("window_step_ms_int8", 0) > 0
             and len(jax.devices()) >= 2),
+        # ISSUE 12: pp2/sp2 measured through the real stage programs,
+        # fused-vs-unfused reported, int8 composing on every mode.
+        # Presence checks only — tiny-geometry CPU slopes can clamp to 0
+        # under machine load, so >0 would flake; the gated ratios bind
+        # on TPU where slope timing is real.
+        "sharded_decode_pp_ok": all(
+            isinstance(pp2.get(k), (int, float))
+            for k in ("single_step_ms", "single_unfused_ms",
+                      "window_step_ms", "window_step_ms_int8",
+                      "fused_vs_unfused")),
+        "sharded_decode_sp_ok": all(
+            isinstance(sp2.get(k), (int, float))
+            for k in ("single_step_ms", "fused_vs_unfused",
+                      "window_step_ms_int8")),
+        "sharded_decode_matrix_no_rejects": (
+            len(matrix) > 0
+            and not any(s.startswith("rejected") for s in statuses)),
+        "sharded_decode_matrix_declares_impossible": any(
+            s.startswith("declared") for s in statuses),
     }
 
 
@@ -460,10 +484,12 @@ def run_smoke(args) -> int:
        spec-decode acceptance >= 0.6 + modeled sweep speedup >= 1.3 on
        the repetitive workload with byte-identical output, and the new
        gate floors verified to fail fabricated bad runs;
-    9. sharded fast-decode plane (ISSUE 9): tp2 fused window + fused
-       greedy single step + int8 window measured on the CPU mesh rig,
-       and the tok_s_per_chip_ratio floor verified to fail a fabricated
-       slow-sharded run;
+    9. sharded fast-decode plane (ISSUE 9 + 12): tp2/sp2/pp2 fused
+       windows + fused greedy steps + int8 measured on the CPU mesh rig
+       through the real stage programs, the compose_matrix carrying no
+       rejected cells, and the tok_s_per_chip_ratio /
+       pp_fused_vs_single floors plus the rejected-cell check verified
+       to fail fabricated bad runs;
     10. prefill plane (ISSUE 10): packed ragged vs padded prefill on the
         tiny model with byte-identical first tokens, and the
         packed_vs_padded_tok_s_ratio floor verified to fail a
@@ -539,7 +565,13 @@ def run_smoke(args) -> int:
                     spec_decode={"acceptance_rate": 0.9,
                                  "modeled_decode_speedup": 1.9},
                     prefix_fleet={"remote_hit_rate": 0.34},
-                    sharded_decode={"tok_s_per_chip_ratio": 0.91},
+                    sharded_decode={
+                        "tok_s_per_chip_ratio": 0.91,
+                        "pp_fused_vs_single": 1.6,
+                        "compose_matrix": {
+                            "fused_decode × pp2": {"status": "ok"},
+                            "spec × multihost": {
+                                "status": "declared: lockstep"}}},
                     prefill_plane={
                         "packed_vs_padded_tok_s_ratio": 1.45})
     tpu_low_mbu = dict(tpu_good, mbu=0.60)
@@ -558,7 +590,22 @@ def run_smoke(args) -> int:
     # ISSUE-9 floor: a sharded engine that fell back to the slow gather
     # path (per-chip throughput collapsed vs meshless) must fail.
     tpu_sharded_slow = dict(
-        tpu_good, sharded_decode={"tok_s_per_chip_ratio": 0.5})
+        tpu_good, sharded_decode=dict(
+            tpu_good["sharded_decode"], tok_s_per_chip_ratio=0.5))
+    # ISSUE-12 floor: a fused pp stage program that stopped beating the
+    # unfused 3-dispatch loop (the r5 cliff back) must fail.
+    tpu_pp_cliff = dict(
+        tpu_good, sharded_decode=dict(
+            tpu_good["sharded_decode"], pp_fused_vs_single=1.0))
+    # ISSUE-12 matrix: a fabricated STILL-REJECTING cell — a combo the
+    # capability table says composes but whose builder raised — must
+    # fail the gate even with every headline number healthy.
+    tpu_rejected_cell = dict(
+        tpu_good, sharded_decode=dict(
+            tpu_good["sharded_decode"],
+            compose_matrix={"int8 × sp2": {
+                "status": "rejected: ValueError: kv_quant=int8 is not "
+                          "wired for ring-SP"}}))
     # ISSUE-10 floor: a packed prefill plane that stopped beating the
     # padded one (regressed to the gather path) must fail.
     tpu_slow_prefill = dict(
@@ -588,6 +635,10 @@ def run_smoke(args) -> int:
                                                  tpu_no_remote).ok,
         "sharded_floor_fails": not gate.compare(tpu_sharded_slow,
                                                 tpu_sharded_slow).ok,
+        "pp_cliff_fails": not gate.compare(tpu_pp_cliff,
+                                           tpu_pp_cliff).ok,
+        "rejected_cell_fails": not gate.compare(tpu_rejected_cell,
+                                                tpu_rejected_cell).ok,
         "slow_prefill_plane_fails": not gate.compare(tpu_slow_prefill,
                                                      tpu_slow_prefill).ok,
         "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),

@@ -30,27 +30,30 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _prescan_tp() -> None:
-    """`--tp N` on a CPU host needs N visible devices, and the XLA flag
-    must land before jax initialises (same discipline as
+def _prescan_mesh() -> None:
+    """`--tp/--sp/--pp N` on a CPU host needs N visible devices, and the
+    XLA flag must land before jax initialises (same discipline as
     worker/__main__.py's prescan).  Harmless under a real TPU backend:
     the flag only multiplies the HOST platform's device count."""
     argv = sys.argv[1:]
-    tp = 0
-    for i, a in enumerate(argv):
-        if a == "--tp" and i + 1 < len(argv):
-            tp = int(argv[i + 1])
-        elif a.startswith("--tp="):
-            tp = int(a.split("=", 1)[1])
-    if tp > 1 and ("xla_force_host_platform_device_count"
-                   not in os.environ.get("XLA_FLAGS", "")):
+    need = 1
+    for flag in ("--tp", "--sp", "--pp"):
+        deg = 0
+        for i, a in enumerate(argv):
+            if a == flag and i + 1 < len(argv):
+                deg = int(argv[i + 1])
+            elif a.startswith(flag + "="):
+                deg = int(a.split("=", 1)[1])
+        need *= max(deg, 1)
+    if need > 1 and ("xla_force_host_platform_device_count"
+                     not in os.environ.get("XLA_FLAGS", "")):
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={max(tp, 8)}"
+            + f" --xla_force_host_platform_device_count={max(need, 8)}"
         ).strip()
 
 
-_prescan_tp()
+_prescan_mesh()
 
 import jax
 import jax.numpy as jnp
@@ -103,7 +106,28 @@ def window_time(cfg, params, use_pallas, *, batch=BATCH, ctx=CTX,
     cache laid out over it."""
     num_blocks = 1 + batch * width
     quant = kv_quant != "none"
-    if mesh is not None:
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        # Fused pp stage programs (ISSUE 12): the schedule-looping
+        # decode window over the STACKED layer/cache layout — exactly
+        # what a `--pp N` worker dispatches per steady window.
+        from dynamo_tpu.parallel.pipeline import (
+            init_pp_cache, make_pp_decode_window, pp_cache_pspecs,
+            pp_param_pspecs, stack_layer_params)
+        from dynamo_tpu.parallel.sharding import shard_pytree
+
+        win = make_pp_decode_window(cfg, block, mesh, 2, window,
+                                    greedy_only=True, kv_quant=quant)
+        params = shard_pytree(stack_layer_params(params),
+                              pp_param_pspecs(cfg), mesh)
+        pp_specs = pp_cache_pspecs(quant)
+
+        def make_cache(c):
+            del c
+            return shard_pytree(
+                init_pp_cache(kvc.KvCacheConfig.for_model(
+                    cfg, num_blocks=num_blocks, block_size=block,
+                    kv_quant=kv_quant)), pp_specs, mesh)
+    elif mesh is not None:
         from dynamo_tpu.parallel.sharding import (
             cache_pspecs, make_sharded_window, param_pspecs, shard_pytree)
 
@@ -318,6 +342,24 @@ def main(argv=None):
                         "before jax init), the kernel phase profiles "
                         "the per-shard geometry — so the sharded gap "
                         "is attributable per phase")
+    p.add_argument("--pp", type=int, default=1,
+                   help="profile the fused pp stage programs (ISSUE 12):"
+                        " window/weights phases run the schedule-looping"
+                        " pp decode window over the stacked layout; "
+                        "modeled bytes divide by pp (each stage streams "
+                        "its layer slice), matching the engine's "
+                        "kv_traffic_shards.  Exclusive of --tp/--sp "
+                        "(pipeline v1 composes with no other in-mesh "
+                        "axis)")
+    p.add_argument("--sp", type=int, default=1,
+                   help="build the mesh with an sp axis (ring-SP "
+                        "engines): decode phases run the sharded "
+                        "programs under it.  Modeled decode bytes do "
+                        "NOT divide by sp — the sp axis replicates "
+                        "decode (its win is ring prefill), and "
+                        "dividing would flatter the per-chip numbers "
+                        "(the engine's kv_traffic_shards makes the "
+                        "same call)")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON object instead of the text report")
     p.add_argument("--no-probes", action="store_true",
@@ -348,23 +390,30 @@ def main(argv=None):
     cfg = mcfg.get_config(args.model)
     params = init_params(cfg, jax.random.key(0))
     mesh = None
-    if args.tp > 1:
+    if args.pp > 1 and (args.tp > 1 or args.sp > 1):
+        p.error("--pp is exclusive of --tp/--sp (pipeline v1 composes "
+                "with no other in-mesh axis)")
+    mesh_need = max(args.tp, 1) * max(args.sp, 1) * max(args.pp, 1)
+    if mesh_need > 1:
         from dynamo_tpu.parallel import MeshConfig, make_mesh
 
         devices = jax.devices()
-        if len(devices) < args.tp:
-            p.error(f"--tp {args.tp} needs {args.tp} devices; "
-                    f"have {len(devices)}")
-        mesh = make_mesh(MeshConfig(tp=args.tp), devices[:args.tp])
+        if len(devices) < mesh_need:
+            p.error(f"--tp {args.tp} --sp {args.sp} --pp {args.pp} "
+                    f"needs {mesh_need} devices; have {len(devices)}")
+        mesh = make_mesh(MeshConfig(tp=args.tp, sp=args.sp, pp=args.pp),
+                         devices[:mesh_need])
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
-    # PER-CHIP modeled bytes under --tp (same honesty rule as the
-    # engine's kv_traffic_shards and the bench's mbu_per_chip): the
-    # measured window/kernel times below are per-chip sharded times, so
-    # a whole-model byte count would inflate any mbu/roofline derived
-    # from this JSON by tp.  Weights and KV both split tp-ways under
-    # head-sharded tensor parallelism (the one mesh shape this tool
-    # builds).
-    shards = max(args.tp, 1)
+    # PER-CHIP modeled bytes (same honesty rule as the engine's
+    # kv_traffic_shards and the bench's mbu_per_chip): the measured
+    # window/kernel times below are per-chip sharded times, so a
+    # whole-model byte count would inflate any mbu/roofline derived
+    # from this JSON.  Weights and KV both split tp-ways under
+    # head-sharded tensor parallelism and pp-ways under the stacked
+    # stage layout (each stage streams its layer slice for all rows);
+    # sp REPLICATES decode, so it is deliberately NOT a divisor —
+    # exactly the engine's kv_traffic_shards discipline.
+    shards = max(args.pp, 1) if args.pp > 1 else max(args.tp, 1)
     w_bytes = n_params * 2 // shards
     # True per-context-token KV bytes (incl. int8 scales) from the ONE
     # accounting everything else gates on (bench.py BENCH JSON, the
@@ -382,6 +431,9 @@ def main(argv=None):
         "ctx": args.ctx,
         "window": args.window,
         "tp": args.tp,
+        "pp": args.pp,
+        "sp": args.sp,
+        "modeled_byte_shards": shards,
         "device": str(jax.devices()[0]),
         "weight_bytes": w_bytes,
         "kv_bytes_per_step": kv_bytes,
