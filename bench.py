@@ -485,6 +485,23 @@ def main():
     prefix_fleet = _asyncio.run(
         _asyncio.wait_for(run_prefix_fleet(), 120))
 
+    # Transfer plane (ISSUE 13): GB/s of the host-staged vs
+    # device-direct vs streamed KV planes between two real engines, vs
+    # the ICI/DCN datasheet (transfer_mbu) — transfer gets a roofline
+    # the way decode got one.  Gate floor on TPU:
+    # transfer.device_vs_host_ratio >= 2.0.
+    from dynamo_tpu.bench.transfer_plane import (
+        run_tiny_transfer_plane, run_transfer_plane)
+
+    if on_tpu:
+        transfer = _asyncio.run(_asyncio.wait_for(
+            run_transfer_plane(cfg, params=params, n_blocks=32,
+                               block_size=BLOCK, batch_blocks=8,
+                               max_prefill_chunk=512), 600))
+    else:
+        transfer = _asyncio.run(
+            _asyncio.wait_for(run_tiny_transfer_plane(), 180))
+
     # Sharded fast-decode plane (ISSUE 9; pp/sp + composition matrix by
     # ISSUE 12): tok/s/chip + per-chip mbu at tp2/dp2/sp2/pp2 vs
     # meshless, through the same unified-builder / stage programs a
@@ -572,6 +589,7 @@ def main():
         "prefill_plane": prefill_plane,
         "prefix_fleet": prefix_fleet,
         "sharded_decode": sharded_decode,
+        "transfer": transfer,
         "peak_flops_nominal": round(peak / 1e12, 1),
         "peak_flops_measured": round(peak_measured / 1e12, 1),
         "hbm_bw_nominal_gbs": round(hbm_bw / 1e9, 1),

@@ -108,6 +108,17 @@ class FloorSpec:
 #   below 0.8 means the fast decode plane regressed to the gather path
 #   or the sharded fused step broke.  Only present when the round ran on
 #   >= 2 chips (single-chip rigs skip the modes and the floor).
+# - transfer.device_vs_host_ratio >= 2.0 — ISSUE 13: the device-direct
+#   KV plane (descriptor probe → batched device pull → ack; blocks never
+#   touch the host) must beat the host-staged msgpack wire by >= 2x at
+#   serving block geometry.  The host path pays extract-to-numpy,
+#   msgpack framing, TCP, and inject-from-numpy per block — on ICI-linked
+#   chips the device pull's only real cost is the fabric copy, so the
+#   honest ratio sits well above 2; parity-or-worse means the plane
+#   regressed to host staging under the covers (or double-copies on
+#   inject, the pre-ISSUE-13 sharded bug).  The bench ZEROES the ratio
+#   when byte parity fails, so this floor also trips on a
+#   fast-but-corrupting plane.
 # - sharded_decode.pp_fused_vs_single >= 1.2 — ISSUE 12: the all-in-one
 #   pp stage program (schedule + fused argmax, [B] tokens out) must beat
 #   the unfused loop it replaced (schedule dispatch returning [B, V] f32
@@ -127,6 +138,7 @@ TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("sharded_decode.tok_s_per_chip_ratio", minimum=0.8),
     FloorSpec("sharded_decode.pp_fused_vs_single", minimum=1.2),
     FloorSpec("prefill_plane.packed_vs_padded_tok_s_ratio", minimum=1.2),
+    FloorSpec("transfer.device_vs_host_ratio", minimum=2.0),
 )
 
 

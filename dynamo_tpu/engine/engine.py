@@ -2231,6 +2231,25 @@ class EngineCore:
                 out[h] = data
         return out
 
+    @property
+    def block_inject_sharding(self):
+        """The sharding `_inject_block` consumes wire blocks at — what
+        the device-transfer plane should land pulled arrays ON so the
+        inject's own device_put is a no-op instead of a second copy
+        (pre-fix every pull committed to jax.devices()[0], which under a
+        mesh double-copied on inject and piled every block onto one
+        chip).  Meshless: the cache's own device (host metadata read —
+        safe off-thread); mesh: replicated over the mesh, the layout the
+        sharded inject scatters from."""
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return NamedSharding(self.mesh, PartitionSpec())
+        leaves = jax.tree.leaves(self.cache)
+        if leaves:
+            return leaves[0].sharding
+        return jax.sharding.SingleDeviceSharding(jax.devices()[0])
+
     @engine_thread_only
     def resident_prefix_blocks(self, hashes) -> int:
         """Length of the contiguous prefix of `hashes` already resident

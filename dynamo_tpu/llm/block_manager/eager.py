@@ -18,6 +18,16 @@ our block-hash-addressed analog overlaps it block-wise:
 - on prefill-done only the residual tail is fetched — TTFT becomes
   roughly `max(prefill, transfer) + tail`.
 
+Given a `KvTransferPlane` the stream rides the DEVICE plane: each batch
+is one `pull_blocks_device` round (offer → device pull → ack), so
+sealed blocks cross device-to-device while prefill runs with the same
+double-buffered pipeline (pull batch N+1 in flight while batch N
+injects), and the prefill-done residual goes device-first too.  The
+first holder refusal (offer cap, incompatible fabric, nothing
+G1-resident) flips the stream to the host-staged wire for the rest of
+the request — the fallback is per-request sticky, counted via
+`note_plane`, and never fails the request.
+
 Failure semantics keep disagg an optimisation, never a correctness
 dependency: mid-stream death of the prefill worker (`abort()`) leaves
 whatever contiguous prefix already landed injected and registered; the
@@ -33,9 +43,15 @@ from typing import Callable, Dict, List
 
 import numpy as np
 
+from dynamo_tpu.llm.block_manager.device_transfer import (
+    note_plane,
+    pull_prefix_device,
+    try_pull_device,
+)
 from dynamo_tpu.llm.block_manager.transfer import (
     EXPORT_BATCH_BLOCKS,
     fetch_blocks,
+    inject_run,
     pull_prefix,
     sealed_hashes,
 )
@@ -59,13 +75,23 @@ class EagerPuller:
     def __init__(self, engine, rpc_for: Callable[[str], object],
                  prompt_tokens: List[int], block_size: int, *,
                  max_inflight: int = 2,
-                 batch_blocks: int = EXPORT_BATCH_BLOCKS) -> None:
+                 batch_blocks: int = EXPORT_BATCH_BLOCKS,
+                 plane=None) -> None:
+        """`plane`: a started KvTransferPlane — batches then pull
+        device-to-device (host-staged stays the per-request fallback)."""
         self.engine = engine
         self._rpc_for = rpc_for
         self.prompt_tokens = list(prompt_tokens)
         self.block_size = block_size
         self.hashes = sealed_hashes(self.prompt_tokens, block_size)
         self.batch_blocks = max(1, batch_blocks)
+        self.plane = plane
+        self._device_off = plane is None   # sticky host fallback
+        # Why host batches are host batches (plane-choice accounting is
+        # per batched pull round on BOTH planes, so the device/host
+        # split reflects traffic, not flip events).
+        self._host_reason = "no_plane" if plane is None else "fallback"
+        self.device_blocks = 0     # blocks that crossed device-to-device
         self._sem = asyncio.Semaphore(max(1, max_inflight))
         self._tasks: List[asyncio.Task] = []
         self._ready: Dict[int, np.ndarray] = {}    # block index → data
@@ -114,19 +140,35 @@ class EagerPuller:
         async with self._sem:
             if self._closed:
                 return
-            try:
-                blocks = await fetch_blocks(
-                    self._rpc_for(address), self.hashes[lo:hi],
-                    batch=self.batch_blocks)
-            except (ConnectionError, OSError, RpcError) as e:
-                # A failed batch leaves a gap; the residual pass (or the
-                # local-prefill fallback) covers it.
-                logger.warning("eager pull of blocks [%d, %d) from %s "
-                               "failed: %s", lo, hi, address, e)
-                return
+            blocks = None
+            if not self._device_off:
+                # Device plane first: one offer → device pull → ack
+                # round for this batch (device_transfer).  Any refusal
+                # or failure flips the stream to host-staged, sticky.
+                blocks, refusal = await try_pull_device(
+                    self.plane, self._rpc_for(address),
+                    self.hashes[lo:hi], context="eager",
+                    site=f"eager stream from {address}")
+                if refusal is not None:
+                    self._device_off = True
+                    self._host_reason = refusal
+                else:
+                    self.device_blocks += len(blocks)
+            if blocks is None:
+                note_plane("host", self._host_reason)
+                try:
+                    blocks = await fetch_blocks(
+                        self._rpc_for(address), self.hashes[lo:hi],
+                        batch=self.batch_blocks)
+                except (ConnectionError, OSError, RpcError) as e:
+                    # A failed batch leaves a gap; the residual pass (or
+                    # the local-prefill fallback) covers it.
+                    logger.warning("eager pull of blocks [%d, %d) from "
+                                   "%s failed: %s", lo, hi, address, e)
+                    return
             for j, h in enumerate(self.hashes[lo:hi]):
                 if h not in blocks:
-                    break  # gap inside the batch: keep the prefix only
+                    continue  # gap: islands wait for the residual pass
                 self._ready[lo + j] = blocks[h]
             self.streamed_blocks += len(blocks)
             self.streamed_bytes += sum(a.nbytes for a in blocks.values())
@@ -148,16 +190,17 @@ class EagerPuller:
     async def _inject_ready(self) -> None:
         """Inject the longest new contiguous run into the engine's prefix
         cache.  Serialised: concurrent batch completions must not race
-        the covered_blocks frontier."""
+        the covered_blocks frontier.  Short injects (pool pinned full)
+        advance only to what is resident — the shared honest-frontier
+        discipline (`transfer.inject_run`)."""
         async with self._inject_lock:
             run: Dict[int, np.ndarray] = {}
             i = self.covered_blocks
             while i in self._ready:
                 run[self.hashes[i]] = self._ready.pop(i)
                 i += 1
-            if run:
-                await self.engine.import_blocks(run)
-                self.covered_blocks = i
+            self.covered_blocks, _ = await inject_run(
+                self.engine, self.hashes, run, self.covered_blocks, i)
 
     async def _drain_tasks(self) -> None:
         while self._tasks:
@@ -188,9 +231,46 @@ class EagerPuller:
             await self._drain_tasks()
             await self._inject_ready()
             self._ready.clear()  # non-contiguous islands: residual refetches
+            covered = self.covered_tokens
+            if not self._device_off:
+                # Device-first residual: same pipeline, same fallback
+                # discipline (a kv-quant ValueError propagates — the
+                # caller must fall back to local prefill, not the host
+                # wire).  Transport errors degrade to the host residual.
+                try:
+                    covered = await pull_prefix_device(
+                        self.engine, self.plane, self._rpc_for(address),
+                        self.prompt_tokens, self.block_size,
+                        covered_tokens=covered,
+                        batch_blocks=self.batch_blocks,
+                        context="eager")
+                except (ConnectionError, OSError, RpcError,
+                        RuntimeError) as e:
+                    # The host residual below is a real host-plane
+                    # fallback: name its cause, don't let it count
+                    # under the generic constructor default.
+                    self._device_off = True
+                    self._host_reason = "pull_failed"
+                    logger.warning("eager device residual from %s failed "
+                                   "(%s); host-staged residual", address, e)
+                # Residual blocks crossed device-to-device: account them
+                # so a fast prefill whose WHOLE prefix moves here still
+                # reads as a device-plane request downstream.
+                gained = covered // self.block_size - self.covered_blocks
+                if gained > 0:
+                    self.device_blocks += gained
+                self.covered_blocks = max(self.covered_blocks,
+                                          covered // self.block_size)
+            before = covered
             covered = await pull_prefix(
                 self.engine, self._rpc_for(address), self.prompt_tokens,
-                self.block_size, covered_tokens=self.covered_tokens)
+                self.block_size, covered_tokens=covered)
+            if covered > before:
+                # The host residual moved real blocks (on a fast prefill
+                # with no progress batches this is the WHOLE prefix) —
+                # count it, or a fleet serving entirely through this
+                # path would look like it made no plane choice at all.
+                note_plane("host", self._host_reason)
             span.set_attr(overlap_ratio=round(self.overlap_ratio, 4),
                           tokens_covered=covered)
         self._closed = True  # late announcements are no-ops now

@@ -11,11 +11,18 @@ address plus its covered-token high-water mark, both derived from the
 indexer's stored-block events.  The serving worker consumes the hint
 HERE, before admission:
 
-- `PrefixFetcher` pulls the donor's sealed blocks peer-to-peer over the
-  existing `kv_blocks` plane (`transfer.fetch_blocks`) in bounded
-  in-flight batches, injects contiguous runs incrementally via
+- `PrefixFetcher` pulls the donor's sealed blocks peer-to-peer in
+  bounded in-flight batches, injects contiguous runs incrementally via
   `engine.import_blocks`, and mops up stragglers with
-  `pull_prefix(covered_tokens=...)` residual semantics;
+  `pull_prefix(covered_tokens=...)` residual semantics.  Given a
+  `KvTransferPlane` the pull is DEVICE-FIRST: each batch probes the
+  donor's `kv_offer` endpoint and pulls device-to-device
+  (`pull_blocks_device`), and only the gaps — blocks the donor holds in
+  G2/G3 rather than G1, or batches the holder refused (offer cap,
+  incompatible fabric) — ride the host-staged `kv_blocks` wire via the
+  existing gap-only refetch.  Frontier and dedup accounting are shared
+  between the planes, so a device pull can never report phantom hits a
+  host pull would not have;
 - `PrefixShareClient` wraps the worker's serving EngineClient: hint →
   pull → delegate.  The engine's admission prefix-match then skips
   prefill for every pulled token, so only the residual prefills.
@@ -41,10 +48,16 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from dynamo_tpu.llm.block_manager.device_transfer import (
+    note_plane,
+    try_pull_device,
+)
 from dynamo_tpu.llm.block_manager.transfer import (
     EXPORT_BATCH_BLOCKS,
     fetch_blocks,
+    inject_run,
     pull_prefix,
+    resident_blocks,
     sealed_hashes,
 )
 from dynamo_tpu.runtime.contracts import never_engine_thread
@@ -110,18 +123,24 @@ class PrefixFetcher:
                  block_size: int, *,
                  max_inflight: int = 2,
                  batch_blocks: int = EXPORT_BATCH_BLOCKS,
-                 pull_timeout: Optional[float] = None) -> None:
+                 pull_timeout: Optional[float] = None,
+                 plane=None) -> None:
         """`pull_timeout`: hard per-pull budget in seconds.  Default
         (None) scales with the pull size — ~2 s floor + 50 ms/block,
         capped at 30 s — so an alive-but-trickling donor cannot stall
         TTFT far past what simply prefilling locally would have cost
-        (the pull sits on the admission path)."""
+        (the pull sits on the admission path).
+
+        `plane`: a started KvTransferPlane — batches then pull
+        device-first, the host-staged wire covering only the gaps."""
         self.engine = engine
         self._rpc_for = rpc_for
         self.block_size = block_size
         self.max_inflight = max(1, max_inflight)
         self.batch_blocks = max(1, batch_blocks)
         self.pull_timeout = pull_timeout
+        self.plane = plane
+        self.device_pulled_blocks = 0   # blocks that crossed device-direct
         # One pull per prefix head at a time: a burst of requests
         # sharing a root must not fetch the identical blocks N times —
         # later pulls wait, re-check residency, and skip the wire.
@@ -226,13 +245,7 @@ class PrefixFetcher:
             return covered
 
     async def _resident_blocks(self, hashes) -> int:
-        fn = getattr(self.engine, "resident_prefix_blocks", None)
-        if fn is None:
-            return 0
-        try:
-            return int(await fn(hashes))
-        except Exception:
-            return 0
+        return await resident_blocks(self.engine, hashes)
 
     async def _pull_batches(self, hashes: List[int], local: int,
                             address: str, prompt_tokens: List[int],
@@ -261,37 +274,50 @@ class PrefixFetcher:
                 while i in ready:
                     run[hashes[i]] = ready.pop(i)
                     i += 1
-                if not run:
-                    return
-                injected = await self.engine.import_blocks(run)
-                if injected == len(run):
-                    frontier = i
-                else:
-                    # Short inject: the device pool is pinned full (or a
-                    # concurrent request raced the same blocks in).  The
-                    # honest frontier is what is actually RESIDENT —
-                    # claiming coverage that never landed would report
-                    # remote hits for prefill the engine still pays.
-                    resident = await self._resident_blocks(hashes)
-                    frontier = max(frontier, min(i, resident))
-                    if frontier < i:
-                        stalled[0] = True   # no capacity: stop pulling
+                frontier, short = await inject_run(
+                    self.engine, hashes, run, frontier, i)
+                if short:
+                    stalled[0] = True   # no capacity: stop pulling
                 progress["frontier"] = frontier
+
+        use_device = [self.plane is not None]
+        # Per-batch host reason (plane-choice accounting counts BOTH
+        # planes per batched round, so the split reflects traffic).
+        host_reason = ["no_plane" if self.plane is None else "fallback"]
 
         async def pull_batch(lo: int, hi: int):
             async with sem:
                 if refused or stalled[0]:
                     return
-                try:
-                    blocks = await fetch_blocks(rpc, hashes[lo:hi],
-                                                batch=self.batch_blocks)
-                except (ConnectionError, OSError, RpcError) as e:
-                    logger.warning("prefix-share batch [%d, %d) from %s "
-                                   "failed: %s", lo, hi, address, e)
-                    return  # gap: the gap-refetch pass covers it
+                blocks = None
+                if use_device[0]:
+                    # Device-first: probe the donor's offer endpoint and
+                    # pull this batch device-to-device.  A holder
+                    # refusal flips the REST of this pull to the host
+                    # wire (sticky per pull — the donor's answer won't
+                    # change batch-to-batch); a subset grant keeps the
+                    # granted blocks and lets the gap-refetch pass
+                    # host-fetch the G2/G3 stragglers.
+                    blocks, refusal = await try_pull_device(
+                        self.plane, rpc, hashes[lo:hi], context="prefix",
+                        site=f"prefix share from {address}")
+                    if refusal is not None:
+                        use_device[0] = False
+                        host_reason[0] = refusal
+                    else:
+                        self.device_pulled_blocks += len(blocks)
+                if blocks is None:
+                    note_plane("host", host_reason[0])
+                    try:
+                        blocks = await fetch_blocks(
+                            rpc, hashes[lo:hi], batch=self.batch_blocks)
+                    except (ConnectionError, OSError, RpcError) as e:
+                        logger.warning("prefix-share batch [%d, %d) from "
+                                       "%s failed: %s", lo, hi, address, e)
+                        return  # gap: the gap-refetch pass covers it
                 for j, h in enumerate(hashes[lo:hi]):
                     if h not in blocks:
-                        break  # hash-chain gap inside the batch
+                        continue  # gap: islands feed the frontier later
                     ready[lo + j] = blocks[h]
                 try:
                     await inject_ready()
@@ -323,6 +349,11 @@ class PrefixFetcher:
                         batch=self.batch_blocks)
                 except (ConnectionError, OSError, RpcError):
                     break   # donor gone: pull_prefix below is the judge
+                if blocks:
+                    # Host wire moved real blocks: count the round, or a
+                    # device plane granting only G1 subsets would render
+                    # as device-dominated while most bytes ride host.
+                    note_plane("host", "gap_refetch")
                 for j, h in enumerate(hashes[frontier:gap_end]):
                     if h not in blocks:
                         break
@@ -337,10 +368,14 @@ class PrefixFetcher:
         # the contiguous frontier.  It stops on its own at whatever the
         # donor no longer holds — and a dead donor raises HERE, which is
         # what turns the pull into a counted local-prefill fallback.
-        return await pull_prefix(
+        before_resid = frontier * self.block_size
+        covered = await pull_prefix(
             self.engine, rpc,
             prompt_tokens[: len(hashes) * self.block_size],
-            self.block_size, covered_tokens=frontier * self.block_size)
+            self.block_size, covered_tokens=before_resid)
+        if covered > before_resid:
+            note_plane("host", "residual")   # host wire moved blocks
+        return covered
 
 
 class PrefixShareClient:

@@ -128,6 +128,39 @@ def sealed_hashes(prompt_tokens: List[int], block_size: int) -> List[int]:
         prompt_tokens[: n_sealed * block_size], block_size))
 
 
+async def resident_blocks(engine, hashes) -> int:
+    """Contiguous locally-resident prefix of `hashes`, 0 when the engine
+    cannot say (test sinks without `resident_prefix_blocks`, transient
+    errors) — the conservative answer for coverage accounting."""
+    fn = getattr(engine, "resident_prefix_blocks", None)
+    if fn is None:
+        return 0
+    try:
+        return int(await fn(hashes))
+    except Exception:
+        return 0
+
+
+async def inject_run(engine, hashes: List[int], run: Dict[int, object],
+                     frontier: int, end: int):
+    """Inject the contiguous run [frontier, end) and return the new
+    HONEST frontier as (frontier, stalled) — THE one implementation of
+    the short-inject discipline every pull pipeline (eager stream,
+    prefix share, device pulls) shares: when the device pool refuses
+    part of the run (pinned full, or a concurrent request raced the
+    same blocks in), the frontier advances only to what is actually
+    RESIDENT — claiming coverage that never landed would skip residual
+    pulls / report remote hits for prefill the engine still pays."""
+    if not run:
+        return frontier, False
+    injected = await engine.import_blocks(run)
+    if injected == len(run):
+        return end, False
+    resident = await resident_blocks(engine, hashes)
+    new_frontier = max(frontier, min(end, resident))
+    return new_frontier, new_frontier < end
+
+
 def contiguous_prefix(hashes: List[int], blocks: Dict[int, np.ndarray]
                       ) -> Dict[int, np.ndarray]:
     """The longest fetched prefix with no gaps — a gap breaks the hash

@@ -60,6 +60,13 @@ PREFILL_DONE_SUBJECT = "prefill_done"
 PREFILL_PROGRESS_SUBJECT = "prefill_progress"
 
 
+class _KvModeRefused(Exception):
+    """A pull's inject refused the peer's blocks (kv-quant-mode
+    mismatch, engine `_validate_block`).  Raised ONLY from the pull
+    call sites — a bare ValueError elsewhere in remote-prefill is a
+    real bug and must propagate, not read as config skew."""
+
+
 def prefill_queue_name(namespace: str) -> str:
     return f"{namespace}/prefill_queue"
 
@@ -235,13 +242,14 @@ class DisaggDecodeClient:
         KV-transfer time lands in its kv_transfer_seconds histogram and
         the eager-streaming overlap in kv_transfer_overlap.
 
-        `eager`: stream sealed blocks over the host-staged plane WHILE
-        remote prefill runs (EagerPuller per pending rid, driven by the
-        PREFILL_PROGRESS subscription).  Engages when no transfer_plane
-        is configured — the device-direct plane pulls whole prefixes
-        descriptor-at-a-time on done and stays the faster path where
-        available; composing it with mid-prefill streaming is future
-        work."""
+        `eager`: stream sealed blocks WHILE remote prefill runs
+        (EagerPuller per pending rid, driven by the PREFILL_PROGRESS
+        subscription).  With a transfer_plane the stream rides the
+        DEVICE plane — each batch is an offer → device pull → ack round,
+        overlapped with prefill exactly like the host stream — and the
+        host-staged wire remains the per-request fallback.  Without
+        eager, a transfer_plane still pulls the whole prefix
+        device-direct at prefill-done (the pre-streaming protocol)."""
         self.inner = inner
         self.engine = engine
         self.cp = cp
@@ -343,16 +351,21 @@ class DisaggDecodeClient:
             self._waiters.pop(rid, None)
 
     async def _remote_prefill_traced(self, request, rid, fut, span) -> None:
+        from dynamo_tpu.llm.block_manager.device_transfer import note_plane
+
         puller = None
-        if self.eager and self.transfer_plane is None:
+        if self.eager:
             from dynamo_tpu.llm.block_manager.eager import EagerPuller
 
             # Registered BEFORE the queue push: a fast prefill worker's
-            # first progress announcement must find its puller.
+            # first progress announcement must find its puller.  The
+            # stream rides the device plane when this worker runs one
+            # (ISSUE 13 tentpole: eager × device compose).
             puller = EagerPuller(
                 self.engine, self._rpc, list(request.token_ids),
                 self.block_size, max_inflight=self.eager_inflight,
-                batch_blocks=self.eager_batch_blocks)
+                batch_blocks=self.eager_batch_blocks,
+                plane=self.transfer_plane)
             self._pullers[rid] = puller
         settled = False   # success OR handled fallback reached abort()
         try:
@@ -372,8 +385,14 @@ class DisaggDecodeClient:
                 # injected; finish() drains in-flight pulls and fetches
                 # only the residual tail.
                 streamed = puller.streamed_blocks * self.block_size
-                onboarded = await puller.finish(done["address"])
-                if streamed:
+                try:
+                    onboarded = await puller.finish(done["address"])
+                except ValueError as e:
+                    raise _KvModeRefused(e) from e
+                if puller.device_blocks:
+                    self.device_pulls += 1
+                    path = "device-stream"
+                elif streamed:
                     path = "eager-stream"
                 overlap = puller.overlap_ratio
                 self.tokens_streamed += streamed
@@ -386,8 +405,10 @@ class DisaggDecodeClient:
             else:
                 if self.transfer_plane is not None:
                     # Device-direct first (NIXL-analog pull, no host
-                    # hop); any failure falls through to the host-staged
-                    # plane.
+                    # hop); any transport failure falls through to the
+                    # host-staged plane.  A kv-quant ValueError
+                    # propagates to the local-prefill fallback below —
+                    # the host wire would refuse identically.
                     from dynamo_tpu.llm.block_manager.device_transfer import (
                         pull_prefix_device)
 
@@ -396,8 +417,11 @@ class DisaggDecodeClient:
                             self.engine, self.transfer_plane,
                             self._rpc(done["address"]),
                             list(request.token_ids), self.block_size)
+                    except ValueError as e:
+                        raise _KvModeRefused(e) from e
                     except (ConnectionError, OSError, RpcError,
                             RuntimeError) as e:
+                        note_plane("host", "pull_failed")
                         logger.warning("device-direct pull %s failed (%s); "
                                        "using host-staged plane", rid, e)
                     if onboarded:
@@ -410,10 +434,23 @@ class DisaggDecodeClient:
                     # didn't: blocks offloaded to G2/G3 live host-side
                     # anyway (and a failed device pull covers nothing).
                     # import skips the already-onboarded prefix.
-                    onboarded = await pull_prefix(
-                        self.engine, self._rpc(done["address"]),
-                        list(request.token_ids), self.block_size,
-                        covered_tokens=onboarded)
+                    before = onboarded
+                    try:
+                        onboarded = await pull_prefix(
+                            self.engine, self._rpc(done["address"]),
+                            list(request.token_ids), self.block_size,
+                            covered_tokens=onboarded)
+                    except ValueError as e:
+                        raise _KvModeRefused(e) from e
+                    if onboarded > before:
+                        # Count the host traffic with its cause, so the
+                        # PLANE split reflects where bytes actually
+                        # moved (device refusals inside
+                        # pull_prefix_device record their own reason).
+                        note_plane(
+                            "host",
+                            "no_plane" if self.transfer_plane is None
+                            else "residual")
             self.remote_prefills += 1
             self.tokens_onboarded += onboarded
             settled = True
@@ -426,13 +463,19 @@ class DisaggDecodeClient:
             logger.info("remote prefill %s: %d tokens onboarded from %s "
                         "(%s)", rid, onboarded, done["address"], path)
         except (asyncio.TimeoutError, ConnectionError, OSError,
-                RpcError) as e:
+                RpcError, _KvModeRefused) as e:
             # RpcError: the peer's kv_blocks handler failed (e.g. blocks
             # evicted between announce and pull) — disagg is an
-            # optimisation, never a correctness dependency.  A mid-stream
-            # death keeps the landed contiguous prefix: the local prefill
-            # below prefix-matches it and recomputes only the rest.
+            # optimisation, never a correctness dependency.
+            # _KvModeRefused: the peer's blocks are un-injectable here
+            # (kv-quant-mode mismatch) — retrying over the host wire
+            # would refuse identically, so the request prefills locally.
+            # A mid-stream death keeps the landed contiguous prefix: the
+            # local prefill below prefix-matches it and recomputes only
+            # the rest.
             self.local_fallbacks += 1
+            if isinstance(e, _KvModeRefused):
+                note_plane("host", "quant_mismatch")
             landed = 0
             if puller is not None:
                 landed = await puller.abort()

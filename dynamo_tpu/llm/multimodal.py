@@ -84,10 +84,20 @@ class EncodeWorker:
             image = payload.get("image", "")
             emb = self.encoder.encode(image)
             self.encoded += 1
-            if self.plane is not None:
+            # Descriptor only for peers that advertised a reachable
+            # fabric (same probe discipline as kv_offer): a plane-less
+            # or cross-fabric processor gets inline bytes instead of a
+            # descriptor it could never pull.
+            peer = payload.get("fabric")
+            if self.plane is not None and peer is not None:
                 import jax.numpy as jnp
 
-                meta = self.plane.stage({0: jnp.asarray(emb)}, [0])
+                # Short TTL: this protocol has no kv_pulled ack, so the
+                # offer must age out of the cap accounting on its own —
+                # a puller slower than this is indistinguishable from a
+                # dead one (the pull then fails like a dead holder).
+                meta = self.plane.stage({0: jnp.asarray(emb)}, [0],
+                                        peer_fabric=peer, ttl_s=30.0)
                 if meta is not None:
                     yield {"kind": "descriptor", "meta": meta}
                     return
@@ -111,12 +121,22 @@ async def _decode_reply(reply: Optional[dict],
     return arr.reshape(reply["shape"]).copy()
 
 
+def _encode_payload(image_ref: str, transfer_plane) -> dict:
+    """The encode request: carries the puller's fabric id so the worker
+    offers a descriptor only when this processor can actually pull it."""
+    payload = {"image": image_ref}
+    if transfer_plane is not None:
+        payload["fabric"] = transfer_plane.fabric
+    return payload
+
+
 async def fetch_embeddings(rpc_client, image_ref: str,
                            transfer_plane=None) -> np.ndarray:
     """Processor-side: ask the encode worker for one image's embeddings,
-    pulling device-direct when both sides run a plane."""
+    pulling device-direct when both sides run a reachable plane."""
     reply = None
-    async for msg in rpc_client.call(ENCODE_ENDPOINT, {"image": image_ref}):
+    async for msg in rpc_client.call(
+            ENCODE_ENDPOINT, _encode_payload(image_ref, transfer_plane)):
         reply = msg
     return await _decode_reply(reply, transfer_plane)
 
@@ -169,7 +189,8 @@ class MultimodalAttach:
         if self._client is None:
             self._client = await self._endpoint.client()
         reply = None
-        async for msg in self._client.generate({"image": ref}):
+        async for msg in self._client.generate(
+                _encode_payload(ref, self._plane)):
             reply = msg
         return await _decode_reply(reply, self._plane)
 

@@ -535,6 +535,19 @@ class KvCacheMetrics:
             "prefix_remote_fallbacks_total",
             "Remote-prefix pulls that failed or were refused "
             "(request fell back to local prefill)")
+        # Which data plane bulk KV pulls rode (ISSUE 13): plane=device
+        # batches crossed device-to-device (reason names the pull site:
+        # eager|prefix|disagg); plane=host names WHY the device plane
+        # was not used (no_plane, offer_cap, transport, not_resident,
+        # pull_failed, quant_mismatch, ...) — a fleet silently degraded
+        # to host staging is visible here and in `dynamo top`'s PLANE
+        # column.
+        self.transfer_plane_choices = registry.counter(
+            "kv_transfer_plane_total",
+            "Batched bulk-KV pull rounds by data plane (one increment "
+            "per pull round on BOTH planes, so device/host reflects "
+            "traffic; reason = pull site for device, fallback cause "
+            "for host)")
         self.hbm_used = registry.gauge(
             "hbm_used_bytes", "Accelerator memory in use")
         self.hbm_limit = registry.gauge(
@@ -583,6 +596,21 @@ class KvCacheMetrics:
         self._inc_to(self.prefix_remote_hits, {}, fetcher.remote_hits)
         self._inc_to(self.prefix_remote_pulled, {}, fetcher.pulled_blocks)
         self._inc_to(self.prefix_remote_fallbacks, {}, fetcher.fallbacks)
+
+    @never_engine_thread
+    def observe_transfer_plane(self, counts=None) -> None:
+        """Sample the device-transfer plane-choice tallies
+        (device_transfer.plane_counts — process-wide host ints) into the
+        dynamo_kv_transfer_plane_total counter family.  `counts` may be
+        passed explicitly (tests)."""
+        if counts is None:
+            from dynamo_tpu.llm.block_manager.device_transfer import (
+                plane_counts)
+
+            counts = plane_counts()
+        for (plane, reason), n in counts.items():
+            self._inc_to(self.transfer_plane_choices,
+                         {"plane": plane, "reason": reason}, n)
 
     @never_engine_thread
     def observe_pool(self, pool, tier: str) -> None:

@@ -446,8 +446,9 @@ async def run(args) -> None:
             else:
                 logger.warning(
                     "jax.experimental.transfer not in this jax build; "
-                    "device-direct KV plane disabled (host-staged "
-                    "fallback stays active)")
+                    "device-direct KV transfer disabled for this worker "
+                    "— every bulk pull rides the host-staged plane "
+                    "(dynamo top PLANE column shows no device pulls)")
 
     disagg_client = None
     prefill_task = None
@@ -489,7 +490,8 @@ async def run(args) -> None:
             PrefixFetcher, PrefixShareClient)
 
         prefix_fetcher = PrefixFetcher(
-            transfer_engine, runtime.client_for, args.block_size)
+            transfer_engine, runtime.client_for, args.block_size,
+            plane=transfer_plane)
         serve_base = PrefixShareClient(engine, prefix_fetcher)
 
     if args.role == "decode":
@@ -577,6 +579,10 @@ async def run(args) -> None:
                 kv_metrics.observe_engine(core)
             if prefix_fetcher is not None:
                 kv_metrics.observe_prefix_share(prefix_fetcher)
+            # Plane-choice tallies (device vs host, with fallback
+            # reasons): a fleet silently degraded to host staging shows
+            # up here and in `dynamo top`'s PLANE column.
+            kv_metrics.observe_transfer_plane()
             return "\n".join(lines) + "\n"
 
         status = StatusServer(

@@ -194,6 +194,9 @@ def test_bench_gate_smoke_cli():
     assert out["sharded_decode_section_ok"] is True
     assert out["slow_prefill_plane_fails"] is True
     assert out["prefill_plane_token_parity"] is True
+    assert out["slow_device_transfer_fails"] is True
+    assert out["transfer_byte_parity"] is True
+    assert out["transfer_device_plane_used"] is True
 
 
 def test_gate_tpu_floors():

@@ -185,10 +185,10 @@ def test_disagg_device_direct_data_plane():
     the PJRT transfer service — no host msgpack hop — with the
     host-staged plane untouched (device_pulls proves the path taken)."""
     from dynamo_tpu.llm.block_manager.device_transfer import (
-        KV_OFFER_ENDPOINT, KvTransferPlane, transfer_available)
+        KV_OFFER_ENDPOINT, KV_PULLED_ENDPOINT, KvTransferPlane)
 
-    if not transfer_available():
-        pytest.skip("jax.experimental.transfer not in this jax build")
+    # Runs on every build: PJRT transfer service where available, the
+    # same-process device_put fabric otherwise (ISSUE 13).
 
     async def main():
         cp = InProcessControlPlane()
@@ -200,6 +200,8 @@ def test_disagg_device_direct_data_plane():
         prefill_plane.start()
         prefill.rpc.register(KV_OFFER_ENDPOINT,
                              prefill_plane.make_offer_handler())
+        prefill.rpc.register(KV_PULLED_ENDPOINT,
+                             prefill_plane.make_pulled_handler())
         decode = await _Worker().start()
         decode_plane = KvTransferPlane(decode.engine)
         decode_plane.start()
@@ -220,7 +222,10 @@ def test_disagg_device_direct_data_plane():
             assert dec.remote_prefills == 1 and dec.local_fallbacks == 0
             assert dec.device_pulls == 1          # device path carried it
             assert dec.tokens_onboarded == 24
-            assert prefill_plane.offers == 1
+            # Eager streaming batches offers per seal announcement, so
+            # the count depends on progress timing; what matters is the
+            # device plane moved every block exactly once.
+            assert prefill_plane.offers >= 1
             assert decode_plane.pulled_blocks == 3
             assert decode.engine.core.allocator.manager.onboarded_blocks == 3
         finally:

@@ -346,6 +346,41 @@ def prefill_plane_checks() -> dict:
     }
 
 
+def transfer_plane_checks() -> dict:
+    """ISSUE 13 smoke: the KV transfer planes measured on CPU between
+    two real tiny engines — host-staged, device-direct, and streamed
+    all land the full prefix with BYTE parity, the device plane really
+    pulled blocks (the local device fabric on this jax build), and the
+    plane-choice counters recorded the device pulls.  The CPU GB/s
+    values are NOT gated (localhost wire); the 2x floor binds on TPU
+    rounds and is fabricated-failure-checked in run_smoke."""
+    import asyncio
+
+    from dynamo_tpu.bench.transfer_plane import run_tiny_transfer_plane
+    from dynamo_tpu.llm.block_manager.device_transfer import plane_counts
+
+    before = sum(n for (plane, _), n in plane_counts().items()
+                 if plane == "device")
+    out = asyncio.run(asyncio.wait_for(run_tiny_transfer_plane(), 180))
+    device_delta = sum(n for (plane, _), n in plane_counts().items()
+                       if plane == "device") - before
+    return {
+        "transfer_transport": out["transport"],
+        "transfer_host_gbs": out["host_staged_gbs"],
+        "transfer_device_gbs": out["device_direct_gbs"],
+        "transfer_streamed_gbs": out["streamed_gbs"],
+        "transfer_section_ok": all(
+            isinstance(out[k], (int, float)) and out[k] > 0
+            for k in ("host_staged_gbs", "device_direct_gbs",
+                      "streamed_gbs", "device_vs_host_ratio")),
+        "transfer_device_plane_used": (out["device_blocks_pulled"] > 0
+                                       and out["streamed_device_blocks"]
+                                       > 0),
+        "transfer_plane_counters_recorded": device_delta > 0,
+        "transfer_byte_parity": out["byte_parity"],
+    }
+
+
 def prefix_fleet_checks() -> dict:
     """ISSUE 7 smoke: fleet-wide prefix reuse measured on CPU — the real
     router must hand out remote-prefix hints on the shared-prefix
@@ -494,6 +529,11 @@ def run_smoke(args) -> int:
         tiny model with byte-identical first tokens, and the
         packed_vs_padded_tok_s_ratio floor verified to fail a
         fabricated slow-packed run;
+    11a. transfer plane (ISSUE 13): host-staged vs device-direct vs
+        streamed KV pulls between two real tiny engines with byte
+        parity, the device plane demonstrably used (plane counters),
+        and the device_vs_host_ratio floor verified to fail a
+        fabricated slower-than-host device run;
     11. SLA profiler + capacity frontier (ISSUE 11): the deterministic
         mocker-cell sweep emits a profile SlaPlanner loads unchanged,
         the capacity model names the pinned cheapest fleet and REFUSES
@@ -573,7 +613,8 @@ def run_smoke(args) -> int:
                             "spec × multihost": {
                                 "status": "declared: lockstep"}}},
                     prefill_plane={
-                        "packed_vs_padded_tok_s_ratio": 1.45})
+                        "packed_vs_padded_tok_s_ratio": 1.45},
+                    transfer={"device_vs_host_ratio": 3.4})
     tpu_low_mbu = dict(tpu_good, mbu=0.60)
     tpu_interfered = dict(
         tpu_good, mixed_prefill_decode={"interference_ratio": 0.70})
@@ -610,6 +651,12 @@ def run_smoke(args) -> int:
     # padded one (regressed to the gather path) must fail.
     tpu_slow_prefill = dict(
         tpu_good, prefill_plane={"packed_vs_padded_tok_s_ratio": 0.9})
+    # ISSUE-13 floor: a device plane slower than the host-staged wire
+    # (regressed to host staging under the covers, or double-copying on
+    # inject) must fail — as must a parity failure, which zeroes the
+    # ratio at the bench.
+    tpu_slow_transfer = dict(
+        tpu_good, transfer={"device_vs_host_ratio": 0.8})
 
     from dynamo_tpu.bench.disagg import run_disagg_ttft_model
 
@@ -641,6 +688,8 @@ def run_smoke(args) -> int:
                                                 tpu_rejected_cell).ok,
         "slow_prefill_plane_fails": not gate.compare(tpu_slow_prefill,
                                                      tpu_slow_prefill).ok,
+        "slow_device_transfer_fails": not gate.compare(
+            tpu_slow_transfer, tpu_slow_transfer).ok,
         "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),
         "disagg_ttft_streamed_ms": round(
             disagg["ttft_streamed_s"] * 1e3, 1),
@@ -652,6 +701,7 @@ def run_smoke(args) -> int:
         **telemetry_overhead_checks(),
         **decode_wall_checks(),
         **prefill_plane_checks(),
+        **transfer_plane_checks(),
         **prefix_fleet_checks(),
         **sharded_decode_checks(),
         **sla_profiler_checks(),

@@ -184,6 +184,13 @@ def summarize(component: str, address: str, samples: List[Sample],
         "remote_hits": total(samples, "dynamo_prefix_remote_hits_total"),
         "remote_fallbacks": total(
             samples, "dynamo_prefix_remote_fallbacks_total"),
+        # Bulk KV transfer plane split (ISSUE 13): device-direct pulls
+        # vs host-staged fallbacks — a worker whose device plane
+        # silently degraded shows d0 with a growing h count.
+        "device_pulls": total(samples, "dynamo_kv_transfer_plane_total",
+                              plane="device"),
+        "host_pulls": total(samples, "dynamo_kv_transfer_plane_total",
+                            plane="host"),
         "evictions": total(samples, "dynamo_kv_evictions_total"),
         "hbm_used_bytes": hbm_used,
         "hbm_limit_bytes": hbm_limit,
@@ -302,6 +309,12 @@ COLUMNS = (
     ("KV%", 6, lambda r: _fmt(r.get("kv_usage"), "pct")),
     ("HIT%", 6, lambda r: _fmt(r.get("prefix_hit_rate"), "pct")),
     ("RHIT", 5, lambda r: _fmt(r.get("remote_hits"), "int")),
+    # Bulk-transfer plane split: device-direct vs host-staged pulls.
+    ("PLANE", 9, lambda r: (
+        f'd{_fmt(r.get("device_pulls"), "int")}'
+        f'/h{_fmt(r.get("host_pulls"), "int")}'
+        if r.get("device_pulls") is not None
+        or r.get("host_pulls") is not None else "—")),
     ("HBM", 16, lambda r: (f'{_fmt(r.get("hbm_used_bytes"), "bytes")}'
                            f'/{_fmt(r.get("hbm_limit_bytes"), "bytes")}'
                            if r.get("hbm_used_bytes") is not None

@@ -327,6 +327,60 @@ def phase_breakdown(cfg, params, *, batch=BATCH, ctx=CTX, block=BLOCK,
     return phases
 
 
+def transfer_phase(cfg, block, batch_sizes=(1, 4, 8, 16),
+                   n_blocks=32, kv_quant="none"):
+    """Pure-transport GB/s of the device plane per pull batch size:
+    stage `bsz` wire blocks on a KvTransferPlane, pull them, wall-clock
+    the round.  Measures the fabric + staging cost the batched pull
+    pipelines amortise — no engines, no RPC, so the number isolates the
+    transport itself (pjrt service where the build has it, the local
+    device_put fabric otherwise)."""
+    import asyncio
+
+    from dynamo_tpu.engine.kv_cache import KvCacheConfig
+    from dynamo_tpu.llm.block_manager.device_transfer import (
+        KvTransferPlane)
+
+    cache_cfg = KvCacheConfig.for_model(cfg, num_blocks=n_blocks + 1,
+                                        block_size=block,
+                                        kv_quant=kv_quant)
+    shape = cache_cfg.block_wire_shape
+    dtype = cache_cfg.block_wire_dtype
+    blocks = {h: jnp.zeros(shape, dtype) for h in range(1, n_blocks + 1)}
+    jax.block_until_ready(list(blocks.values()))
+    block_bytes = cache_cfg.bytes_per_block
+    plane = KvTransferPlane(offer_ttl_s=30.0)
+    plane.start()
+
+    async def pull_all(bsz: int) -> float:
+        order = sorted(blocks)
+        t0 = time.perf_counter()
+        for lo in range(0, n_blocks, bsz):
+            meta = plane.stage(blocks, order[lo:lo + bsz],
+                               peer_fabric=plane.fabric)
+            assert meta is not None, plane.last_refusal
+            pulled = await plane.pull(meta)
+            plane.mark_pulled(meta["uuid"])
+            assert len(pulled) == len(order[lo:lo + bsz])
+        return time.perf_counter() - t0
+
+    per_batch = {}
+    for bsz in batch_sizes:
+        asyncio.run(pull_all(min(bsz, n_blocks)))    # warm
+        wall = asyncio.run(pull_all(min(bsz, n_blocks)))
+        per_batch[str(bsz)] = round(
+            n_blocks * block_bytes / wall / 1e9, 4) if wall else 0.0
+    transport = plane.transport_kind
+    plane.stop()
+    return {
+        "transport": transport,
+        "kv_quant": kv_quant,
+        "block_bytes": block_bytes,
+        "n_blocks": n_blocks,
+        "gbs_per_batch_size": per_batch,
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("tools/profile_decode.py")
     p.add_argument("--model", default="llama-3-1b")
@@ -372,6 +426,13 @@ def main(argv=None):
                    help="also measure the fused window with the "
                         "quantized KV cache (modeled int8 rooflines are "
                         "always reported)")
+    p.add_argument("--transfer", action="store_true",
+                   help="also profile the device-transfer plane: pure "
+                        "transport GB/s of staged wire-block pulls per "
+                        "batch size (ISSUE 13; CPU-runnable — the local "
+                        "device fabric on builds without "
+                        "jax.experimental.transfer), at this model's "
+                        "wire-block geometry in both cache modes")
     p.add_argument("--prefill-attn", action="store_true",
                    help="also slope-time prefill attention: the Pallas "
                         "paged flash-prefill kernel vs the gather_kv "
@@ -484,6 +545,14 @@ def main(argv=None):
             batch=args.batch, ctx=args.ctx, block=args.block,
             width=args.width, window=args.window,
             kv_quant=args.kv_quant, mesh=mesh) * 1e3, 6)
+
+    if args.transfer:
+        # Device-transfer transport phase (ISSUE 13): per-batch-size
+        # GB/s in both cache modes at this model's wire-block geometry.
+        out["transfer"] = {
+            "bf16": transfer_phase(cfg, args.block),
+            "int8": transfer_phase(cfg, args.block, kv_quant="int8"),
+        }
 
     if args.prefill_attn:
         # Prefill-plane attention phase (ISSUE 10): one measurement
