@@ -63,7 +63,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
 )
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import Params, init_params, make_forward_step
-from dynamo_tpu.runtime import contracts
+from dynamo_tpu.runtime import contracts, flight_recorder
 from dynamo_tpu.runtime.contracts import (
     engine_thread_only,
     hot_path,
@@ -667,6 +667,14 @@ class EngineCore:
         # and compiled-shape cache misses, with dispatch denominators —
         # the observability the r5 single-step cliff lacked.
         self.counters = EngineStepCounters()
+        # Flight recorder (runtime/flight_recorder.py): the postmortem
+        # ring.  step() stamps its heartbeat unconditionally (the stall
+        # watchdog reads it); dispatch-shape / admission / recompile
+        # breadcrumbs record only while the process enabled the ring
+        # (worker --flight-recorder), and every record site passes
+        # pre-computed scalars only (lint rule DL006).
+        self.flight = flight_recorder.get_recorder()
+        self.counters.on_recompile = self._flight_recompile
         # Mixed-mode duty state: windows dispatched since the last
         # concurrent prefill chunk (see EngineConfig.mixed_prefill_duty).
         self._windows_since_prefill = 0
@@ -786,6 +794,7 @@ class EngineCore:
         pool (their first token sampled asynchronously) and merge into
         the decode cohort in batches, so the window pipeline isn't
         drained per completion."""
+        self.flight.beat()  # stall-watchdog heartbeat: one float store
         if self._lockstep is not None:
             self._lockstep.broadcast({"op": "step"})
         deltas: List[TokenDelta] = []
@@ -848,8 +857,41 @@ class EngineCore:
 
         self._collect_dead(deltas)
         self.step_count += 1
+        if self.flight.enabled and self.step_count % 64 == 0:
+            # Periodic cumulative-counter breadcrumb: consecutive
+            # "counters" events give the postmortem reader per-interval
+            # DELTAS of syncs/recompiles/dispatches; cadence 64 keeps it
+            # inside the steady-window ring-write budget.
+            self._flight_counters()
         self._refresh_metrics()
         return deltas
+
+    def _flight_recompile(self, key) -> None:
+        """EngineStepCounters first-seen-shape hook: a compile is
+        imminent — leave a breadcrumb naming the program and shape (cold
+        misses included: a crash during warmup is exactly when you want
+        to know what was compiling).  Off the steady path by
+        construction (fires only on cache misses).  The compile stamp
+        runs regardless of recording: the stall watchdog widens its
+        threshold while a step is legitimately stuck inside XLA."""
+        self.flight.note_compile()
+        if self.flight.enabled:
+            self.flight.record("recompile", tag=str(key[0]),
+                               sig=repr(key[1:]))
+
+    @hot_path
+    def _flight_counters(self) -> None:
+        """Cumulative EngineStepCounters breadcrumb (pre-computed host
+        ints only — DL006); the dump reader diffs consecutive events for
+        per-interval deltas."""
+        c = self.counters
+        self.flight.record(
+            "counters", step=self.step_count,
+            host_syncs=c.host_syncs, recompiles=c.xla_cache_misses,
+            windows=c.window_dispatches,
+            singles=c.single_step_dispatches,
+            prefills=c.prefill_dispatches, spec=c.spec_dispatches,
+            uploads=c.h2d_uploads)
 
     def _has_prefill_backlog(self) -> bool:
         return bool(self.scheduler.waiting) or any(
@@ -1110,6 +1152,9 @@ class EngineCore:
         # sample_positions=None → logits at EVERY chunk position [B,T,V].
         self.counters.note_dispatch("spec", bucket, T, width)
         self.counters.spec_dispatches += 1
+        fl = self.flight
+        if fl.enabled:
+            fl.record("spec", bucket=bucket, chunk=T, width=width)
         # Effective-bytes model: ONE sweep of each row's KV serves up to
         # T emitted tokens (tokens tally added below from n_emit);
         # per-chip bytes under meshes (kv_shard_count).
@@ -1282,6 +1327,9 @@ class EngineCore:
         R, T, P = self._pad_rows(batch.rows), batch.chunk, batch.pages
         self.counters.prefill_dispatches += 1
         self._prefill_cost_tokens += sum(w.length for w in batch.items)
+        fl = self.flight
+        if fl.enabled:
+            fl.record("prefill", rows=R, chunk=T, pages=P)
         tokens = np.zeros((R, T), np.int32)
         positions = np.full((R, T), self._pad_position, np.int32)
         seq_lens = np.zeros((R,), np.int32)
@@ -1474,6 +1522,9 @@ class EngineCore:
         self.counters.prefill_dispatches += 1
         self.counters.packed_prefill_dispatches += 1
         self.counters.note_dispatch("prefill_packed", T, R, P)
+        fl = self.flight
+        if fl.enabled:
+            fl.record("prefill_packed", tokens=T, segs=R, pages=P)
         self._prefill_cost_tokens += sum(w.length for w in items)
         logits, self.cache = self._packed_prefill_fn()(
             self.params, self.cache, self._dev(tokens),
@@ -1561,6 +1612,9 @@ class EngineCore:
             return []
 
         self.counters.single_step_dispatches += 1
+        fl = self.flight
+        if fl.enabled:
+            fl.record("decode1", bucket=bucket, pages=work.pages)
         # Effective-bytes model: this step's attention reads each live
         # row's full KV context once (weights excluded — this series
         # isolates the KV plane the quantized cache halves); per-chip
@@ -1784,6 +1838,11 @@ class EngineCore:
         self._window_state = st
         self.counters.window_dispatches += 1
         self.counters.note_dispatch("window", greedy_only, bucket, width)
+        fl = self.flight
+        if fl.enabled:
+            # THE per-window ring write (budget: one per window
+            # dispatch, gated in bench_gate --smoke).
+            fl.record("window", bucket=bucket, width=width, lag=lag)
         # Effective-bytes model, bytes half: window step i of K reads
         # context shadow+i per row.  The TOKEN half is tallied at sync
         # time from what actually reaches the output stream — counting
@@ -1953,6 +2012,9 @@ class EngineCore:
             self._finish(req, FinishReason.LENGTH)
             return
         logger.info("preempting %s: out of KV blocks", req.request_id)
+        fl = self.flight
+        if fl.enabled:
+            fl.record("preempt", rid=req.request_id, need_pages=total_need)
         if not self._managed_cache:
             # Plain allocator: the pages really are gone; re-publish on the
             # recompute pass.  (Managed source keeps sealed blocks resident

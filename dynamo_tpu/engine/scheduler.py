@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from dynamo_tpu.engine.sampling import SamplingParams
+from dynamo_tpu.runtime import flight_recorder
 
 logger = logging.getLogger(__name__)
 
@@ -506,6 +507,10 @@ class Scheduler:
         # after preemption recount — each admission is a real lookup.
         self.prefix_hit_tokens = 0
         self.prefix_miss_tokens = 0
+        # Flight-recorder breadcrumbs for the scheduling decisions the
+        # postmortem needs ordered (admissions, preemptions); the module
+        # singleton is a no-op until the process enables recording.
+        self.flight = flight_recorder.get_recorder()
         # Adaptive mixed-mode budget (engine-installed each step when a
         # MixedPrefillController runs): replaces the static
         # mixed_prefill_tokens / per-row slack caps while decode rows are
@@ -573,6 +578,11 @@ class Scheduler:
             self._slots[slot] = req
             req.state = RequestState.PREFILL
             self.running.append(req)
+            fl = self.flight
+            if fl.enabled:
+                fl.record("admit", rid=req.request_id,
+                          prompt=len(req.prompt_tokens),
+                          cached=cached_tokens, new_pages=need_new)
 
     # -- page growth ------------------------------------------------------
 
@@ -676,6 +686,11 @@ class Scheduler:
         prefill rebuilds their KV, and completion of that prefill samples
         the next token exactly as if decode had continued.  (vLLM-style
         recompute preemption; the reference delegates this to its engines.)"""
+        fl = self.flight
+        if fl.enabled:
+            fl.record("sched_preempt", rid=req.request_id,
+                      output=len(req.output_tokens),
+                      pages=len(req.pages))
         if req in self.running:
             self.running.remove(req)
         if req.slot is not None:

@@ -81,11 +81,13 @@ def parse_args(argv=None):
                    help="batch mode: JSONL output (default: input + .out)")
     from dynamo_tpu.runtime.config import (
         apply_to_parser_defaults, load_layered_config)
+    from dynamo_tpu.runtime.flight_recorder import add_flight_args
     from dynamo_tpu.runtime.slo import add_slo_args
     from dynamo_tpu.runtime.tracing import add_trace_args
 
     add_trace_args(p)
     add_slo_args(p)
+    add_flight_args(p)
     apply_to_parser_defaults(p, load_layered_config(
         {"http_host": "127.0.0.1", "http_port": 8080,
          "control_plane": None, "router_mode": "round_robin",
@@ -363,9 +365,15 @@ async def run_batch(models: ModelManager, batch_file: str,
 
 async def run(args) -> None:
     from dynamo_tpu import native
+    from dynamo_tpu.runtime import flight_recorder
     from dynamo_tpu.runtime.tracing import configure_from_args
 
     configure_from_args(args, service="frontend")
+    # Flight recorder (ISSUE 14): the frontend's ring holds SLO state
+    # transitions and slow-request markers; crash/SIGUSR2/atexit dumps
+    # armed like any worker; /debug/flightrecorder serves it.
+    flight_recorder.configure_from_args(
+        args, service="frontend").install_crash_dump()
     await native.warmup()  # build the C++ hasher off the event loop
     models = ModelManager()
     shutdowns = []

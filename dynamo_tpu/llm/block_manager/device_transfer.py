@@ -60,6 +60,7 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from dynamo_tpu.runtime import flight_recorder
 from dynamo_tpu.runtime.contracts import hot_path, never_engine_thread
 from dynamo_tpu.runtime.logutil import warn_rate_limited
 from dynamo_tpu.runtime.rpc import RpcError
@@ -100,6 +101,13 @@ def note_plane(plane: str, reason: str) -> None:
     with _plane_lock:
         key = (plane, reason)
         _plane_counts[key] = _plane_counts.get(key, 0) + 1
+    # Flight-recorder breadcrumb (ISSUE 14): the counter family shows
+    # the cumulative split; the ring shows the ORDER of plane choices in
+    # the seconds before a stall or death (e.g. device pulls degrading
+    # to host right before a wedge).
+    fl = flight_recorder.get_recorder()
+    if fl.enabled:
+        fl.record("kv_plane", plane=plane, reason=reason)
 
 
 def plane_counts() -> Dict[Tuple[str, str], int]:

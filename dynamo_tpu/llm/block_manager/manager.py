@@ -35,6 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from dynamo_tpu.llm.block_manager.pool import BlockPool
+from dynamo_tpu.runtime import flight_recorder
 from dynamo_tpu.runtime.contracts import (
     engine_thread_only,
     hot_path,
@@ -161,6 +162,12 @@ class KvBlockManager:
             self._settle_host(next(iter(self._pending_host)))
         self._pending_host[block_hash] = self._offload_pool.submit(land)
         self.offloaded_blocks += 1
+        # Tier-demotion breadcrumb (ISSUE 14): G1→G2 pressure in the
+        # seconds before a stall/OOM is exactly what the postmortem
+        # needs and what the cumulative gauges can't order.
+        fl = flight_recorder.get_recorder()
+        if fl.enabled:
+            fl.record("tier_demote", src="G1", dst="G2", slot=hslot)
 
     def _settle_host(self, block_hash: int) -> bool:
         """Settle an in-flight offload for `block_hash` (if any) before
@@ -198,6 +205,9 @@ class KvBlockManager:
         [dslot] = self.disk.allocate(1)
         self._disk_data[dslot] = self._host_data[slot]
         self.disk.register(dslot, block_hash)
+        fl = flight_recorder.get_recorder()
+        if fl.enabled:
+            fl.record("tier_demote", src="G2", dst="G3", slot=dslot)
         self.disk.release([dslot])
         self.offloaded_blocks += 1
 

@@ -60,6 +60,8 @@ class HttpService:
         self.app.router.add_get("/metrics", self.prometheus)
         self.app.router.add_get("/debug/traces", self.debug_traces)
         self.app.router.add_get("/debug/slo", self.debug_slo)
+        self.app.router.add_get("/debug/flightrecorder",
+                                self.debug_flightrecorder)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
         self._runner: Optional[web.AppRunner] = None
@@ -171,6 +173,20 @@ class HttpService:
             return self._error(400, "n must be an integer")
         return web.json_response(
             tracing.debug_traces_payload(n, self.tracer))
+
+    async def debug_flightrecorder(self, req: web.Request) -> web.Response:
+        """The frontend's flight-recorder ring (`?n=K`, default 256):
+        SLO state transitions and slow-request markers — the frontend
+        half of a fleet postmortem (worker rings ride their
+        StatusServers)."""
+        from dynamo_tpu.runtime import flight_recorder
+
+        try:
+            n = int(req.query.get("n", "256"))
+        except ValueError:
+            return self._error(400, "n must be an integer")
+        return web.json_response(
+            flight_recorder.get_recorder().debug_payload(n))
 
     async def debug_slo(self, _req: web.Request) -> web.Response:
         """Current SLO burn-rate evaluation over this frontend's request

@@ -58,6 +58,10 @@ class MetricsAggregator:
         self._scrape_failures = self.registry.counter(
             "scrape_failures_total",
             "Failed /metrics scrapes of advertised status endpoints")
+        self._endpoint_reaps = self.registry.counter(
+            "endpoint_reaps_total",
+            "Stale status-endpoint registrations deleted because their "
+            "recorded pid is provably dead (kill -9'd worker cleanup)")
         self._watcher = LoadMetricsWatcher(cp, stale_secs=STALE_SECS,
                                            name="aggregator")
         self._tasks = []
@@ -129,15 +133,25 @@ class MetricsAggregator:
         `dynamo_aggregate_scrape_failures_total`, its last-good series
         stay in the exposition behind a STALE comment for
         `stale_drop_secs` after the last success, and only then drop.
-        Targets no longer advertised drop immediately."""
+        Targets no longer advertised drop immediately.  A failed target
+        whose registration pid is provably dead (ISSUE 14:
+        `runtime/status.registration_pid_dead` — loopback address +
+        signal-0 probe) is REAPED: its key is deleted from the control
+        plane and `dynamo_aggregate_endpoint_reaps_total` counts it, so
+        kill -9'd workers stop haunting discovery forever."""
         import aiohttp
 
-        from dynamo_tpu.runtime.status import STATUS_ENDPOINTS_PREFIX
+        from dynamo_tpu.runtime.status import (
+            STATUS_ENDPOINTS_PREFIX, registration_pid_dead)
 
         entries = await self.cp.get_prefix(f"{STATUS_ENDPOINTS_PREFIX}/")
-        addrs = sorted({
-            entry["address"] for entry in entries.values()
-            if isinstance(entry, dict) and entry.get("address")})
+        # addr → (key, entry): the reap path needs the key to delete and
+        # the entry's pid to probe (first registration per address wins).
+        by_addr: Dict[str, tuple] = {}
+        for key, entry in sorted(entries.items()):
+            if isinstance(entry, dict) and entry.get("address"):
+                by_addr.setdefault(entry["address"], (key, entry))
+        addrs = sorted(by_addr)
         results = []
         if addrs:
             # Per-endpoint timeout: one hung target must not consume the
@@ -169,6 +183,20 @@ class MetricsAggregator:
                 fresh[addr] = {"text": text, "last_ok": now,
                                "stale": False}
                 continue
+            key, entry = by_addr[addr]
+            if registration_pid_dead(entry):
+                # Dead process, stale registration: reap the key so the
+                # fleet view (and every future sweep) stops carrying it.
+                try:
+                    await self.cp.delete(key)
+                    self._endpoint_reaps.inc(labels={"endpoint": addr})
+                    logger.info(
+                        "reaped stale status endpoint %s (%s, pid %s "
+                        "dead)", key, addr, entry.get("pid"))
+                    continue  # no STALE carry: the target is gone
+                except Exception:
+                    logger.warning("failed to reap stale endpoint %s",
+                                   key, exc_info=True)
             self._scrape_failures.inc(labels={"endpoint": addr})
             prev = self._scraped.get(addr)
             if prev is not None and (now - prev["last_ok"]

@@ -17,8 +17,9 @@ import logging
 import math
 import threading
 from bisect import bisect_right
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
+from dynamo_tpu.runtime import flight_recorder
 from dynamo_tpu.runtime.contracts import never_engine_thread
 from dynamo_tpu.runtime.logutil import warn_rate_limited
 
@@ -292,6 +293,11 @@ class EngineStepCounters:
         self.prefill_cost_samples = 0
         self._cost_ewma_alpha = 0.25
         self._seen_shapes: set = set()
+        # Optional first-seen-shape hook (the engine points this at its
+        # flight recorder so every recompile leaves a postmortem event);
+        # called ONLY on cache misses, so the steady window never pays
+        # for it.
+        self.on_recompile: Optional[Callable] = None
 
     def note_dispatch(self, tag: str, *sig) -> None:
         """Record a jitted-program dispatch; a first-seen (tag, sig)
@@ -300,6 +306,9 @@ class EngineStepCounters:
         if key not in self._seen_shapes:
             self._seen_shapes.add(key)
             self.xla_cache_misses += 1
+            cb = self.on_recompile
+            if cb is not None:
+                cb(key)
 
     def note_kv_read(self, nbytes: int, tokens: int) -> None:
         """Tally modeled decode KV traffic (bytes swept) and the tokens
@@ -712,6 +721,7 @@ class HbmPoller:
         except Exception:  # pre-init failure / no backend: fallback below
             devices = []
         reported = 0
+        used_total = limit_total = 0
         for i, dev in enumerate(devices):
             stats = None
             try:
@@ -723,13 +733,21 @@ class HbmPoller:
             labels = {"device": str(i),
                       "kind": getattr(dev, "platform", "unknown")}
             self.metrics.hbm_used.set(stats["bytes_in_use"], labels=labels)
+            used_total += int(stats["bytes_in_use"])
             limit = stats.get("bytes_limit") or stats.get(
                 "bytes_reservable_limit")
             if limit:
                 self.metrics.hbm_limit.set(limit, labels=labels)
+                limit_total += int(limit)
             reported += 1
         if not reported:
             self._poll_host_fallback()
+        else:
+            # Flight-recorder HBM sample: one aggregate event per poll —
+            # the "was HBM climbing before the death" postmortem series.
+            flight_recorder.get_recorder().record(
+                "hbm", devices=reported, used_bytes=used_total,
+                limit_bytes=limit_total)
         return reported
 
     @staticmethod

@@ -36,6 +36,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
+from dynamo_tpu.runtime import flight_recorder
 from dynamo_tpu.runtime.contracts import never_engine_thread
 from dynamo_tpu.runtime.logutil import warn_rate_limited
 from dynamo_tpu.runtime.metrics import (
@@ -235,6 +236,18 @@ class SloMonitor:
                 "compliant": compliant,
                 "state": state,
             })
+        if worst != self.state:
+            # SLO state transition → flight-recorder event; a transition
+            # INTO PAGE additionally dumps the ring — the black box's
+            # "what led up to the page" trigger (throttled per reason so
+            # a burn rate flapping at the threshold can't grind disk).
+            rec = flight_recorder.get_recorder()
+            rec.record("slo_state", prev=self.state, state=worst,
+                       burn=round(worst_burn, 3))
+            if worst == PAGE and rec.enabled:
+                # Async: tick may run on the serving event loop, which
+                # must not stall behind ring serialization + file I/O.
+                rec.dump_async("slo_page")
         self.state = worst
         self.last_max_burn = worst_burn
         if self._g_state is not None:

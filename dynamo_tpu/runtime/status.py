@@ -29,14 +29,44 @@ async def register_status_endpoint(cp, component: str, port: int,
                                    host: str = "127.0.0.1") -> str:
     """Advertise a status server for aggregator scraping; returns the
     key written.  Unleased on purpose: the aggregator treats unreachable
-    targets as gone, so a stale key after a crash is harmless noise.
-    `host` must be a cross-host-routable address when the aggregator
-    runs on another machine (same rule as the worker's --rpc-host)."""
+    targets as gone — and since ISSUE 14 the registration carries the
+    owning PID, so scrapers (`dynamo top`, metrics_aggregator) can REAP
+    a kill -9'd worker's stale entry instead of rendering it
+    unreachable forever.  `host` must be a cross-host-routable address
+    when the aggregator runs on another machine (same rule as the
+    worker's --rpc-host)."""
     import os
 
     key = f"{STATUS_ENDPOINTS_PREFIX}/{component}/{os.getpid()}"
-    await cp.put(key, {"address": f"{host}:{port}", "component": component})
+    await cp.put(key, {"address": f"{host}:{port}", "component": component,
+                       "pid": os.getpid()})
     return key
+
+
+def registration_pid_dead(entry) -> bool:
+    """True only when a status-endpoint registration names a pid that is
+    PROVABLY gone: the entry carries a pid, its address is loopback
+    (pid liveness is only decidable same-host — a loopback address from
+    another machine was never scrapeable by us anyway), and signal-0
+    probing reports no such process.  Everything ambiguous — foreign
+    hosts, permission errors, malformed entries — reads as alive, so
+    reaping can never take down a live worker's discovery entry."""
+    import os
+
+    if not isinstance(entry, dict):
+        return False
+    pid = entry.get("pid")
+    addr = entry.get("address") or ""
+    host = addr.rsplit(":", 1)[0] if ":" in addr else ""
+    if not pid or host not in ("127.0.0.1", "localhost", "::1", "[::1]"):
+        return False
+    try:
+        os.kill(int(pid), 0)
+        return False
+    except ProcessLookupError:
+        return True
+    except (PermissionError, OSError, ValueError, TypeError):
+        return False
 
 
 def register_status_endpoint_task(cp, component: str, port: int,
@@ -95,6 +125,8 @@ class StatusServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/debug/traces", self._debug_traces)
         app.router.add_get("/debug/slo", self._debug_slo)
+        app.router.add_get("/debug/flightrecorder",
+                           self._debug_flightrecorder)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -133,6 +165,20 @@ class StatusServer:
             return web.json_response({"error": "n must be an integer"},
                                      status=400)
         return web.json_response(tracing.debug_traces_payload(n))
+
+    async def _debug_flightrecorder(self, req: web.Request) -> web.Response:
+        """This process's flight-recorder ring (`?n=K`, default 256) —
+        same payload shape as the frontend's route, so chaos tooling and
+        `tools/trace_merge.py --flight` treat every process uniformly."""
+        from dynamo_tpu.runtime import flight_recorder
+
+        try:
+            n = int(req.query.get("n", "256"))
+        except ValueError:
+            return web.json_response({"error": "n must be an integer"},
+                                     status=400)
+        return web.json_response(
+            flight_recorder.get_recorder().debug_payload(n))
 
     async def _debug_slo(self, _req: web.Request) -> web.Response:
         """Current SLO burn-rate evaluation (runtime/slo.py) — same

@@ -476,6 +476,21 @@ class Tracer:
             except OSError:
                 logger.exception("slow-trace JSONL write failed")
         slow_logger.warning("%s", line)
+        # Slow-request force-sample is also a flight-recorder trigger
+        # (ISSUE 14): the ring at the moment of the slow request is the
+        # "what was the engine doing" half the trace alone can't show.
+        # Per-reason throttled dump — sustained overload produces many
+        # slow requests but the ring only needs snapshotting so often.
+        from dynamo_tpu.runtime import flight_recorder
+
+        rec = flight_recorder.get_recorder()
+        if rec.enabled:
+            rec.record("slow_request", trace_id=trace_id,
+                       span=root_span.name,
+                       duration_ms=round(dur_s * 1000.0, 3))
+            # Async: _finish_span runs on whatever thread ended the
+            # root span — often the serving event loop.
+            rec.dump_async("slow_request")
 
     # -- export ------------------------------------------------------------
 

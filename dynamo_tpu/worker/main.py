@@ -177,11 +177,13 @@ def parse_args(argv=None):
                         "routable address (K8s manifests inject the pod "
                         "IP) — the 127.0.0.1 default only works "
                         "single-host")
+    from dynamo_tpu.runtime.flight_recorder import add_flight_args
     from dynamo_tpu.runtime.slo import add_slo_args
     from dynamo_tpu.runtime.tracing import add_trace_args
 
     add_trace_args(p)
     add_slo_args(p)
+    add_flight_args(p)
     apply_to_parser_defaults(p, load_layered_config(
         {"control_plane": None, "namespace": "dynamo",
          "component": "backend", "endpoint": "generate",
@@ -368,9 +370,17 @@ async def run_encode(args, cp, runtime) -> None:
 
 async def run(args) -> None:
     from dynamo_tpu import native
+    from dynamo_tpu.runtime import flight_recorder
     from dynamo_tpu.runtime.tracing import configure_from_args
 
     configure_from_args(args, service=f"worker-{args.component}")
+    # Flight recorder: the worker's black box (ISSUE 14).  Configured
+    # before anything serves so startup compiles/admissions land in the
+    # ring; crash triggers (faulthandler, atexit, SIGUSR2) armed here on
+    # the main thread.
+    recorder = flight_recorder.configure_from_args(
+        args, service=f"worker-{args.component}")
+    recorder.install_crash_dump()
     await native.warmup()  # build the C++ hasher off the event loop
     cp = ControlPlaneClient(*_split(args.control_plane))
     await cp.start()
@@ -396,6 +406,23 @@ async def run(args) -> None:
 
     engine, metrics_fn, shutdown, card_fields, transfer_engine = \
         await build_engine(args, kv_event_sink)
+    # Engine-thread stall watchdog (ISSUE 14): the step loop stamps a
+    # heartbeat every iteration; no progress for --watchdog-stall-s
+    # seconds while prefill/decode work is pending ⇒ stall event +
+    # dynamo_engine_stalls_total + automatic flight-recorder dump.
+    # Real engines only — the mocker has no step-loop heartbeat.
+    watchdog = None
+    if args.watchdog_stall_s > 0 and transfer_engine is not None:
+        _wd_core = transfer_engine.core
+
+        def _pending_work(core=_wd_core):
+            # Off-thread read of live engine state; the watchdog treats
+            # any exception here as "no pending work".
+            return core.has_work
+
+        watchdog = flight_recorder.StallWatchdog(
+            recorder, _pending_work, stall_s=args.watchdog_stall_s)
+        watchdog.start()
     lockstep = None
     if args.num_processes > 1:
         from dynamo_tpu.parallel.multihost import LockstepLeader
@@ -571,6 +598,17 @@ async def run(args) -> None:
             if counters is not None:
                 for k, v in counters.to_dict().items():
                     lines.append(f"dynamo_worker_engine_{k} {v}")
+            # Flight-recorder / stall-watchdog series (ISSUE 14): the
+            # step-loop heartbeat age feeds `dynamo top`'s AGE/STL
+            # column; the stall counter is the chaos-era "worker wedged
+            # under load" alarm.
+            age = recorder.last_step_age_s()
+            if age is not None:
+                lines.append(
+                    f"dynamo_engine_last_step_age_seconds {age:.3f}")
+            lines.append(f"dynamo_engine_stalls_total {recorder.stalls}")
+            lines.append("dynamo_engine_stalled "
+                         f"{1 if watchdog is not None and watchdog.stalled else 0}")
             # Memory-plane sample at scrape time: pool occupancy /
             # eviction / prefix-hit series land in the shared registry.
             # Runs on the status server's event loop (host ints only),
@@ -640,6 +678,8 @@ async def run(args) -> None:
         await disagg_client.stop()
     if status_reg_task is not None:
         status_reg_task.cancel()
+    if watchdog is not None:
+        watchdog.stop()
     if hbm_poller is not None:
         hbm_poller.stop()
     if slo_monitor is not None:

@@ -125,6 +125,36 @@ def test_capacity_headroom_from_profile_knee():
     assert fe["capacity_headroom"] is None
 
 
+def test_summarize_engine_age_and_stalls():
+    """ISSUE 14: the flight-recorder/watchdog series land in the row and
+    the AGE/STL column renders them (with the `!` marker while the
+    watchdog holds the worker stalled)."""
+    samples = [
+        ("dynamo_engine_last_step_age_seconds", {}, 12.3),
+        ("dynamo_engine_stalls_total", {}, 2.0),
+        ("dynamo_engine_stalled", {}, 1.0),
+    ]
+    row = dynamo_top.summarize("worker-both", "a:1", samples, None)
+    assert row["engine_step_age_s"] == 12.3
+    assert row["engine_stalls"] == 2.0
+    assert row["engine_stalled"] == 1.0
+    assert dynamo_top._fmt_age_stall(row) == "12.3s/2!"
+    # Healthy worker: no marker.
+    healthy = dynamo_top.summarize("worker-both", "a:1", [
+        ("dynamo_engine_last_step_age_seconds", {}, 0.02),
+        ("dynamo_engine_stalls_total", {}, 0.0),
+        ("dynamo_engine_stalled", {}, 0.0)], None)
+    assert dynamo_top._fmt_age_stall(healthy) == "0.0s/0"
+    # Mocker/frontend rows (no engine series): the no-data dash.
+    empty = dynamo_top.summarize("frontend", "a:1", [], None)
+    assert dynamo_top._fmt_age_stall(empty) == "—"
+    # The column is part of the rendered table.
+    table = dynamo_top.render_table(
+        {"control_plane": "x", "processes": [row]})
+    assert "AGE/STL" in table
+    assert "12.3s/2!" in table
+
+
 def test_knee_concurrency_extraction():
     prof = {"prefill": {}, "decode": {},
             "meta": {"capacity": {"knee_concurrency_per_worker": 2.5}}}
@@ -236,24 +266,94 @@ def test_dynamo_top_once_json_covers_every_process():
 
 
 def test_collect_marks_dead_process_unreachable():
+    """A registration owned by a LIVE pid (ours) that stops answering
+    renders unreachable — and is NOT reaped (the process may be wedged,
+    which is exactly when its row matters)."""
     async def main():
         from dynamo_tpu.runtime.control_plane_tcp import (
             ControlPlaneClient, ControlPlaneServer)
-        from dynamo_tpu.runtime.status import register_status_endpoint
+        from dynamo_tpu.runtime.status import (
+            STATUS_ENDPOINTS_PREFIX, register_status_endpoint)
 
         srv = ControlPlaneServer()
         cp_port = await srv.start()
         cp = ControlPlaneClient("127.0.0.1", cp_port)
         await cp.start()
-        # Advertised but nothing listening.
+        # Advertised but nothing listening; pid = this (live) process.
         await register_status_endpoint(cp, "worker-ghost", 1)
         try:
             snapshot = await dynamo_top.collect(
                 f"127.0.0.1:{cp_port}", timeout=1.0)
+            remaining = await cp.get_prefix(f"{STATUS_ENDPOINTS_PREFIX}/")
         finally:
             await cp.close()
             await srv.stop()
         assert len(snapshot["processes"]) == 1
         assert snapshot["processes"][0]["unreachable"]
+        assert snapshot["reaped"] == 0
+        assert len(remaining) == 1     # live-pid registration kept
 
     _run(main())
+
+
+def test_collect_reaps_dead_pid_registration():
+    """ISSUE 14 satellite: a kill -9'd worker's stale status_endpoints
+    entry (pid provably dead, loopback address) is DELETED on scrape and
+    rendered once as a reaped row instead of UNREACHABLE forever."""
+    import subprocess
+
+    async def main():
+        from dynamo_tpu.runtime.control_plane_tcp import (
+            ControlPlaneClient, ControlPlaneServer)
+        from dynamo_tpu.runtime.status import STATUS_ENDPOINTS_PREFIX
+
+        # A pid that provably no longer exists.
+        child = subprocess.Popen([sys.executable, "-c", "pass"])
+        child.wait()
+        dead_pid = child.pid
+
+        srv = ControlPlaneServer()
+        cp_port = await srv.start()
+        cp = ControlPlaneClient("127.0.0.1", cp_port)
+        await cp.start()
+        key = f"{STATUS_ENDPOINTS_PREFIX}/worker-dead/{dead_pid}"
+        await cp.put(key, {"address": "127.0.0.1:1",
+                           "component": "worker-dead", "pid": dead_pid})
+        try:
+            snapshot = await dynamo_top.collect(
+                f"127.0.0.1:{cp_port}", timeout=1.0)
+            remaining = await cp.get_prefix(f"{STATUS_ENDPOINTS_PREFIX}/")
+        finally:
+            await cp.close()
+            await srv.stop()
+        assert snapshot["reaped"] == 1
+        row = snapshot["processes"][0]
+        assert row["reaped"] and row["pid"] == dead_pid
+        assert remaining == {}         # key gone: no haunting next sweep
+        # The reaped row renders as such (not UNREACHABLE).
+        table = dynamo_top.render_table(snapshot)
+        assert "REAPED" in table and "UNREACHABLE" not in table
+
+    _run(main())
+
+
+def test_registration_pid_dead_is_conservative():
+    """Only loopback + provably-gone pids reap; everything ambiguous
+    reads as alive."""
+    from dynamo_tpu.runtime.status import registration_pid_dead
+
+    assert not registration_pid_dead(None)
+    assert not registration_pid_dead({"address": "127.0.0.1:1"})  # no pid
+    # Live pid (ours) never reaps.
+    assert not registration_pid_dead(
+        {"address": "127.0.0.1:1", "pid": os.getpid()})
+    # Foreign-host addresses are undecidable from here.
+    assert not registration_pid_dead(
+        {"address": "10.0.0.7:8080", "pid": 2 ** 22 - 1})
+    # Loopback + dead pid reaps.
+    import subprocess
+
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    assert registration_pid_dead(
+        {"address": "127.0.0.1:1", "pid": child.pid})

@@ -339,6 +339,66 @@ def test_dl005_skips_ambiguous_and_generic_names(tmp_path):
     assert findings == []
 
 
+# -- DL006: flight-recorder args in @hot_path ------------------------------
+
+
+def test_dl006_flags_allocating_record_args(tmp_path):
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime.contracts import hot_path
+
+        class Engine:
+            @hot_path
+            def step(self, bucket, req, flight_recorder):
+                self.flight.record("w", msg=f"bucket {bucket}")
+                self.flight.record("w", shape=[bucket, 2])
+                self.flight.record("w", info={"b": bucket})
+                self.flight.record("w", n=len(req.pages))
+                self.flight.record("w", deep=self.a.b.c.d)
+                self.flight.record("w", s=bucket + 1)
+                # The inline singleton spelling must not evade the rule.
+                flight_recorder.get_recorder().record("w", m=f"{bucket}")
+        """)
+    assert codes(findings) == ["DL006"] * 7
+
+
+def test_dl006_allows_scalar_args_and_cold_paths(tmp_path):
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime.contracts import hot_path
+
+        class Engine:
+            @hot_path
+            def step(self, bucket, width, work):
+                fl = self.flight
+                if fl.enabled:
+                    fl.record("window", bucket=bucket, width=width,
+                              pages=work.pages, neg=-1, tag="steady",
+                              syncs=self.counters.host_syncs)
+                fl.record_always("stall", age_s=bucket)
+
+            def cold(self, req):
+                # No @hot_path: formatting is allowed off the hot path.
+                self.flight.record("admit", msg=f"req {req}",
+                                   n=len(req.pages))
+
+            @hot_path
+            def other(self, sink, x):
+                sink.record(f"not a recorder {x}")   # receiver not matched
+        """)
+    assert findings == []
+
+
+def test_dl006_suppressible(tmp_path):
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime.contracts import hot_path
+
+        @hot_path
+        def step(flight, xs):
+            # dynamo-lint: disable=DL006 one-time warmup event
+            flight.record("warmup", shapes=[x for x in xs])
+        """)
+    assert findings == []
+
+
 # -- suppression -----------------------------------------------------------
 
 

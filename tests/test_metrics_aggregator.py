@@ -135,6 +135,47 @@ def test_unregistered_target_drops_immediately_without_stale():
     _run(main())
 
 
+def test_scrape_reaps_dead_pid_registration():
+    """ISSUE 14 satellite: an unreachable endpoint whose registration
+    pid is provably dead gets its control-plane key DELETED (counted in
+    dynamo_aggregate_endpoint_reaps_total) instead of being carried as
+    STALE forever; live-pid failures keep the stale-carry behavior."""
+    async def main():
+        import subprocess
+        import sys
+
+        from dynamo_tpu.runtime.status import STATUS_ENDPOINTS_PREFIX
+
+        child = subprocess.Popen([sys.executable, "-c", "pass"])
+        child.wait()
+        dead_pid = child.pid
+
+        cp = InProcessControlPlane()
+        await cp.start()
+        agg = MetricsAggregator(cp)
+        key = f"{STATUS_ENDPOINTS_PREFIX}/worker-dead/{dead_pid}"
+        addr = "127.0.0.1:1"
+        await cp.put(key, {"address": addr, "component": "worker-dead",
+                           "pid": dead_pid})
+        try:
+            await agg._scrape_once()
+            # Key deleted; reap counted; no scrape-failure/stale carry.
+            assert await cp.get_prefix(
+                f"{STATUS_ENDPOINTS_PREFIX}/") == {}
+            assert agg._endpoint_reaps.value({"endpoint": addr}) == 1
+            assert agg._scrape_failures.value({"endpoint": addr}) == 0
+            assert "dynamo_aggregate_endpoint_reaps_total" in agg.expose()
+
+            # Next sweep: nothing advertised, nothing re-reaped.
+            await agg._scrape_once()
+            assert agg._endpoint_reaps.value({"endpoint": addr}) == 1
+        finally:
+            await agg.stop()
+            await cp.close()
+
+    _run(main())
+
+
 def test_http_exposition():
     async def main():
         import aiohttp

@@ -208,6 +208,77 @@ def telemetry_overhead_checks() -> dict:
     }
 
 
+def flight_recorder_overhead_checks() -> dict:
+    """ISSUE 14: the flight recorder must be free where it matters — a
+    steady decode window with the ring ENABLED produces EngineStepCounters
+    deltas byte-identical to recorder-off (0 extra host syncs, 0 extra
+    dispatches, 0 recompiles) and stays inside the per-window ring-write
+    budget: at most one ring write per window dispatch plus one periodic
+    counters breadcrumb.  A fabricated chatty recorder (several writes
+    per step — the regression this gate exists to catch) must FAIL the
+    budget check."""
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models import config as mcfg
+    from dynamo_tpu.runtime import flight_recorder
+
+    rec = flight_recorder.get_recorder()
+
+    def steady_run(chatty: int = 0):
+        core = EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=128,
+            enable_prefix_cache=False, decode_window=2,
+            window_pipeline_depth=2,
+            scheduler=SchedulerConfig(
+                max_seqs=8, block_size=8, max_pages_per_seq=32,
+                max_prefill_chunk=128, decode_buckets=(1, 2, 4, 8),
+                prefill_buckets=(16, 128))))
+        core.add_request("a", list(range(1, 71)),
+                         SamplingParams(max_tokens=64))
+        for _ in range(8):   # prefill + window warmup
+            core.step()
+        base = core.counters.snapshot()
+        writes0 = rec.events_written
+        for _ in range(20):
+            core.step()
+            for _ in range(chatty):   # fabricated chatty recorder
+                rec.record("chatty", x=1)
+        return (core.counters.delta(base),
+                rec.events_written - writes0)
+
+    def budget_ok(ring_writes: int, delta: dict) -> bool:
+        # One write per window dispatch + one periodic counters
+        # breadcrumb (cadence 64 ⇒ ≤ 1 over a 20-step window).
+        return ring_writes <= delta["window_dispatches"] + 1
+
+    try:
+        rec.reset()
+        rec.enabled = False
+        d_off, _ = steady_run()
+        rec.configure(enabled=True, ring_size=4096)
+        d_on, writes_on = steady_run()
+        _, writes_chatty = steady_run(chatty=3)
+    finally:
+        # Never leak an enabled recorder into the other smoke checks.
+        rec.enabled = False
+        rec.reset()
+
+    return {
+        "flight_extra_host_syncs":
+            d_on["host_syncs"] - d_off["host_syncs"],
+        "flight_zero_extra_syncs":
+            d_on["host_syncs"] == d_off["host_syncs"]
+            and d_on["xla_cache_misses"] == d_off["xla_cache_misses"],
+        "flight_counters_byte_identical": d_on == d_off,
+        "flight_ring_writes": writes_on,
+        "flight_window_budget_ok": budget_ok(writes_on, d_on),
+        # The budget check must actually have teeth: a recorder writing
+        # several events per steady step blows it.
+        "flight_chatty_run_fails": not budget_ok(writes_chatty, d_on),
+    }
+
+
 def decode_wall_checks() -> dict:
     """ISSUE 6 smoke: the decode-bandwidth-wall features measured on CPU
     with the tiny model —
@@ -514,6 +585,10 @@ def run_smoke(args) -> int:
        land TTFT near max(prefill, transfer) + tail, not their sum;
     7. bound KV/HBM telemetry overhead: per-step memory-plane sampling
        adds 0 host syncs and 0 dispatches to the steady decode window;
+    7b. bound flight-recorder overhead (ISSUE 14): recorder-on steady
+       decode keeps EngineStepCounters deltas byte-identical to
+       recorder-off (0 extra host syncs) and within the one-ring-write-
+       per-window budget; a fabricated chatty recorder must fail it;
     8. decode-bandwidth-wall features (ISSUE 6): int8-KV traffic ratio
        <= 0.55 at serving geometry, tiny-model greedy pin bf16 == int8,
        spec-decode acceptance >= 0.6 + modeled sweep speedup >= 1.3 on
@@ -699,6 +774,7 @@ def run_smoke(args) -> int:
         "disagg_ttft_near_max_bound": disagg["ttft_near_max_bound"],
         **tracing_overhead_checks(),
         **telemetry_overhead_checks(),
+        **flight_recorder_overhead_checks(),
         **decode_wall_checks(),
         **prefill_plane_checks(),
         **transfer_plane_checks(),

@@ -30,6 +30,13 @@ Rules:
          function may not call a `@never_engine_thread` one (or vice
          versa) — resolved per-class when possible, by globally-unique
          method name otherwise
+  DL006  flight-recorder discipline: `FlightRecorder.record(...)`
+         calls inside `@hot_path` bodies must pass pre-computed
+         scalars only — plain names, constants, shallow attribute
+         reads.  f-strings, %-formatting, container displays,
+         comprehensions, call expressions and deep attribute chains
+         allocate/format on the hot path and are rejected; do the
+         formatting at dump time, not per step
 
 Suppression: append `# dynamo-lint: disable=DL003 <reason>` to the
 flagged line (or put it on its own line immediately above).  Multiple
@@ -602,9 +609,109 @@ class ContractConsistency(Rule):
         return out
 
 
+class FlightRecorderDiscipline(Rule):
+    """DL006: `FlightRecorder.record(...)` in `@hot_path` bodies must
+    pass pre-computed scalars only.
+
+    The recorder's hot-path contract (runtime/flight_recorder.py) is
+    that `record` itself does no formatting — which only holds if call
+    sites don't smuggle the formatting into the ARGUMENTS.  Allowed
+    argument expressions: constants, bare names, attribute chains up to
+    `a.b.c` (a plain slot read), and unary +/- of those.  Rejected:
+    f-strings / %-formatting / `.format()` and any call expression,
+    container displays and comprehensions (they allocate per event),
+    and deeper attribute chains (`a.b.c.d` — in this tree, a chain that
+    deep is reaching through an object graph and usually hides a
+    property).  Receivers recognized as flight recorders: any
+    `*.record(...)` whose receiver chain ends in `flight`, `recorder`,
+    `flight_recorder`, or the conventional local alias `fl`."""
+
+    code = "DL006"
+    name = "flight-recorder-hot-path-args"
+
+    RECEIVERS = frozenset({"flight", "recorder", "flight_recorder", "fl"})
+    MAX_ATTR_PARTS = 3        # self.x.y is a slot read; deeper is a smell
+
+    def _is_recorder_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("record", "record_always")):
+            return False
+        recv = f.value
+        if isinstance(recv, ast.Name):
+            return recv.id in self.RECEIVERS
+        if isinstance(recv, ast.Attribute):
+            return recv.attr in self.RECEIVERS
+        if isinstance(recv, ast.Call):
+            # flight_recorder.get_recorder().record(...) — the inline
+            # singleton spelling must not evade the rule.
+            name = _decorator_name(recv.func)
+            return name == "get_recorder"
+        return False
+
+    def _arg_problem(self, node: ast.expr) -> Optional[str]:
+        """Why this argument expression is too expensive for a hot
+        record site, or None when it is scalar-cheap."""
+        if isinstance(node, ast.Constant):
+            return None
+        if isinstance(node, ast.Name):
+            return None
+        if isinstance(node, ast.Attribute):
+            parts = 1
+            cur = node
+            while isinstance(cur, ast.Attribute):
+                parts += 1
+                cur = cur.value
+            if not isinstance(cur, ast.Name):
+                return "attribute chain on a computed receiver"
+            if parts > self.MAX_ATTR_PARTS:
+                return (f"attribute chain deeper than "
+                        f"{self.MAX_ATTR_PARTS} parts")
+            return None
+        if isinstance(node, ast.UnaryOp) and isinstance(
+                node.operand, (ast.Constant, ast.Name)):
+            return None
+        if isinstance(node, ast.JoinedStr):
+            return "f-string (formats per event)"
+        if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.Tuple)):
+            return "container display (allocates per event)"
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            return "comprehension (allocates per event)"
+        if isinstance(node, ast.Call):
+            return "call expression (compute before the hot path)"
+        if isinstance(node, (ast.BinOp, ast.BoolOp, ast.Compare,
+                             ast.IfExp)):
+            return "computed expression (pre-compute the scalar)"
+        return "non-scalar expression"
+
+    def check(self, ctx: FileCtx, project: Project) -> List[Finding]:
+        out: List[Finding] = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if "hot_path" not in _fn_contracts(fn):
+                continue
+            for node in _own_statements(fn):
+                if not isinstance(node, ast.Call) \
+                        or not self._is_recorder_call(node):
+                    continue
+                exprs = list(node.args) + [kw.value for kw in node.keywords]
+                for expr in exprs:
+                    why = self._arg_problem(expr)
+                    if why is not None:
+                        out.append(self.finding(
+                            ctx, expr,
+                            f"FlightRecorder.record arg in @hot_path "
+                            f"function {fn.name!r} is not a pre-computed "
+                            f"scalar: {why}"))
+        return out
+
+
 RULES: Sequence[Rule] = (HostSyncInHotPath(), BlockingInAsync(),
                          SilentSwallow(), MetricsDiscipline(),
-                         ContractConsistency())
+                         ContractConsistency(),
+                         FlightRecorderDiscipline())
 
 RULE_TABLE = {r.code: r.name for r in RULES}
 

@@ -206,6 +206,13 @@ def summarize(component: str, address: str, samples: List[Sample],
         "slo_max_burn": (max_burn(slo)
                          if slo and slo.get("enabled") else None),
         "capacity_headroom": headroom,
+        # Flight-recorder / stall-watchdog series (ISSUE 14): heartbeat
+        # age of the engine step loop, cumulative stall count, and the
+        # watchdog's currently-stalled flag — the AGE/STL column.
+        "engine_step_age_s": total(
+            samples, "dynamo_engine_last_step_age_seconds"),
+        "engine_stalls": total(samples, "dynamo_engine_stalls_total"),
+        "engine_stalled": total(samples, "dynamo_engine_stalled"),
     }
 
 
@@ -247,38 +254,60 @@ async def collect(cp_addr: str, timeout: float = 3.0,
     every process concurrently, summarize.  Importable (the mini-fleet
     e2e test calls this in-process; the CLI wraps it).
     `knee_concurrency` (from `--profile`) fills per-row capacity
-    headroom."""
+    headroom.
+
+    Stale-registration reaping (ISSUE 14): a kill -9'd worker leaves
+    its `status_endpoints/` key behind.  An unreachable target whose
+    registration pid is provably dead (loopback address + signal-0
+    probe — `runtime/status.registration_pid_dead`) gets its key
+    DELETED and renders once as a `reaped` row instead of an
+    UNREACHABLE row forever."""
+    from dynamo_tpu.runtime.status import registration_pid_dead
+
     host, _, port = cp_addr.rpartition(":")
     cp = ControlPlaneClient(host or "127.0.0.1", int(port))
     await cp.start()
+    reaped = 0
     try:
         entries = await cp.get_prefix(f"{STATUS_ENDPOINTS_PREFIX}/")
+        targets = []
+        seen = set()
+        for key, entry in sorted(entries.items()):
+            if not isinstance(entry, dict) or not entry.get("address"):
+                continue
+            addr = entry["address"]
+            if addr in seen:
+                continue  # one process may be re-registered across restarts
+            seen.add(addr)
+            targets.append((entry.get("component")
+                            or key.split("/")[1], addr, key, entry))
+        scrapes = await asyncio.gather(
+            *(_scrape(addr, timeout) for _, addr, _, _ in targets))
+        processes = []
+        for (component, addr, key, entry), (text, slo) in zip(targets,
+                                                              scrapes):
+            if text is None and slo is None:
+                if registration_pid_dead(entry):
+                    try:
+                        await cp.delete(key)
+                        reaped += 1
+                        processes.append({
+                            "component": component, "address": addr,
+                            "pid": entry.get("pid"), "reaped": True})
+                        continue
+                    except Exception:
+                        # dynamo-lint: disable=DL003 reap is best-effort
+                        pass  # fall through to the unreachable row
+                processes.append({"component": component, "address": addr,
+                                  "unreachable": True})
+                continue
+            processes.append(summarize(component, addr,
+                                       parse_prom(text or ""), slo,
+                                       knee_concurrency=knee_concurrency))
     finally:
         await cp.close()
-    targets = []
-    seen = set()
-    for key, entry in sorted(entries.items()):
-        if not isinstance(entry, dict) or not entry.get("address"):
-            continue
-        addr = entry["address"]
-        if addr in seen:
-            continue  # one process may be re-registered across restarts
-        seen.add(addr)
-        targets.append((entry.get("component")
-                        or key.split("/")[1], addr))
-    scrapes = await asyncio.gather(
-        *(_scrape(addr, timeout) for _, addr in targets))
-    processes = []
-    for (component, addr), (text, slo) in zip(targets, scrapes):
-        if text is None and slo is None:
-            processes.append({"component": component, "address": addr,
-                              "unreachable": True})
-            continue
-        processes.append(summarize(component, addr,
-                                   parse_prom(text or ""), slo,
-                                   knee_concurrency=knee_concurrency))
     return {"generated_at": time.time(), "control_plane": cp_addr,
-            "processes": processes}
+            "reaped": reaped, "processes": processes}
 
 
 # -- rendering -----------------------------------------------------------
@@ -300,6 +329,21 @@ def _fmt(v, kind: str = "num") -> str:
     if kind == "int":
         return str(int(v))
     return f"{v:g}"
+
+
+def _fmt_age_stall(r: dict) -> str:
+    """AGE/STL cell: engine heartbeat age / cumulative stall count,
+    suffixed `!` while the watchdog holds the worker stalled.  A row
+    with neither series (mocker/frontend) renders the no-data dash."""
+    age = r.get("engine_step_age_s")
+    stalls = r.get("engine_stalls")
+    if age is None and stalls is None:
+        return "—"
+    a = ("—" if age is None
+         else f"{age:.1f}s" if age < 100 else f"{age:.0f}s")
+    s = "—" if stalls is None else str(int(stalls))
+    mark = "!" if (r.get("engine_stalled") or 0) > 0 else ""
+    return f"{a}/{s}{mark}"
 
 
 COLUMNS = (
@@ -324,6 +368,9 @@ COLUMNS = (
     ("TPOTp50", 8, lambda r: _fmt(r.get("tpot_p50_s"), "ms")),
     ("TPOTp99", 8, lambda r: _fmt(r.get("tpot_p99_s"), "ms")),
     ("SLO", 5, lambda r: r.get("slo_state") or "—"),
+    # Engine heartbeat age / stall count (flight recorder + watchdog):
+    # a wedged step loop reads as a growing AGE with a `!` marker.
+    ("AGE/STL", 9, _fmt_age_stall),
     # How far from the profiled saturation knee (--profile): 100% idle,
     # 0% at the knee, negative past it.
     ("HEADRM", 7, lambda r: _fmt(r.get("capacity_headroom"), "pct")),
@@ -335,6 +382,12 @@ def render_table(snapshot: dict) -> str:
              f"{snapshot['control_plane']}  (latencies in ms)"]
     lines.append("  ".join(h.ljust(w) for h, w, _ in COLUMNS))
     for row in snapshot["processes"]:
+        if row.get("reaped"):
+            lines.append("  ".join([
+                row["component"].ljust(16), row["address"].ljust(21),
+                f"REAPED (pid {row.get('pid')} dead; "
+                "registration removed)"]))
+            continue
         if row.get("unreachable"):
             lines.append("  ".join([
                 row["component"].ljust(16), row["address"].ljust(21),

@@ -19,6 +19,17 @@ intact across the frontend → router → RPC → worker → engine path.
 Sources may be base URLs (the /debug/traces path is appended), full URLs,
 or paths to saved payload files; spans duplicated across payloads (e.g.
 co-located processes sharing a tracer) dedupe by (trace_id, span_id).
+
+Flight-recorder dumps (ISSUE 14) merge into the same timeline:
+
+    python tools/trace_merge.py http://127.0.0.1:8080 \
+        --flight /tmp/flight_worker-backend_12345.jsonl -o merged.json
+
+Each recorder event (admissions, dispatch shapes, recompiles, KV plane
+choices, SLO transitions, stalls) becomes a Perfetto INSTANT marker on
+the owning process's track, time-aligned with the trace spans by their
+shared wall clock and deduped by (service, seq) — so "what was the
+engine doing when this request went slow" is one view, not two files.
 """
 
 from __future__ import annotations
@@ -77,6 +88,79 @@ def merge_payloads(payloads: List[dict]) -> dict:
     return chrome_trace(traces)
 
 
+def load_flight_dump(path: str) -> List[dict]:
+    """Parse one flight-recorder JSONL dump into event dicts.  Header
+    lines (`flight_dump: true`) set the owning service for the events
+    that follow (a dump file may hold several appended dumps); malformed
+    lines are skipped — a truncated crash dump must still merge."""
+    events: List[dict] = []
+    service = "flight"
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # crash-truncated tail / faulthandler traceback
+            if not isinstance(row, dict):
+                continue
+            if row.get("flight_dump"):
+                service = row.get("service") or service
+                continue
+            if "ts" not in row or "kind" not in row:
+                continue
+            row["_service"] = service
+            events.append(row)
+    return events
+
+
+def merge_flight_events(merged: dict, flight_events: List[dict]) -> int:
+    """Append flight-recorder events to a Chrome trace doc as instant
+    ("ph":"i") markers on the owning process's track, reusing the
+    process lane the service's spans already occupy (or allocating a
+    new one).  Dedupes by (service, seq) so overlapping dumps — e.g. a
+    stall dump and the atexit dump of the same death — merge cleanly.
+    Returns the number of events added."""
+    events = merged["traceEvents"]
+    pids: Dict[str, int] = {}
+    max_pid = 0
+    for ev in events:
+        pid = ev.get("pid", 0)
+        max_pid = max(max_pid, pid)
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pids[ev["args"]["name"]] = pid
+    seen: set = set()
+    added = 0
+    new_services: List[str] = []
+    for row in flight_events:
+        service = row.pop("_service", "flight")
+        key = (service, row.get("seq"), row.get("ts"), row.get("kind"))
+        if key in seen:
+            continue
+        seen.add(key)
+        pid = pids.get(service)
+        if pid is None:
+            max_pid += 1
+            pid = pids[service] = max_pid
+            new_services.append(service)
+        args = {k: v for k, v in row.items()
+                if k not in ("ts", "kind")}
+        events.append({
+            "name": f"fr.{row['kind']}", "cat": "flight", "ph": "i",
+            "s": "p",                      # process-scoped instant
+            "ts": round(float(row["ts"]) * 1e6, 3),
+            "pid": pid, "tid": 0, "args": args,
+        })
+        added += 1
+    for service in new_services:
+        events.append({"name": "process_name", "ph": "M",
+                       "pid": pids[service], "tid": 0,
+                       "args": {"name": service}})
+    return added
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "tools/trace_merge.py", description=__doc__.splitlines()[0])
@@ -88,6 +172,11 @@ def main(argv=None) -> int:
                         "merged_trace.json)")
     p.add_argument("--n", type=int, default=64,
                    help="traces to request per process (default 64)")
+    p.add_argument("--flight", action="append", default=[],
+                   metavar="DUMP.jsonl",
+                   help="flight-recorder JSONL dump(s) "
+                        "(runtime/flight_recorder.py) merged as instant "
+                        "markers on the owning process track; repeatable")
     args = p.parse_args(argv)
 
     payloads = []
@@ -100,11 +189,21 @@ def main(argv=None) -> int:
         print("error: no source produced a payload", file=sys.stderr)
         return 1
     merged = merge_payloads(payloads)
+    flight_events: List[dict] = []
+    for fpath in args.flight:
+        try:
+            flight_events.extend(load_flight_dump(fpath))
+        except OSError as e:
+            print(f"warning: skipping flight dump {fpath}: {e}",
+                  file=sys.stderr)
+    n_flight = merge_flight_events(merged, flight_events) \
+        if flight_events else 0
     n_spans = sum(1 for ev in merged["traceEvents"] if ev["ph"] == "X")
     with open(args.out, "w") as f:
         json.dump(merged, f)
+    extra = f" + {n_flight} flight event(s)" if n_flight else ""
     print(f"wrote {args.out}: {n_spans} spans from {len(payloads)} "
-          f"process(es) — open in https://ui.perfetto.dev")
+          f"process(es){extra} — open in https://ui.perfetto.dev")
     return 0
 
 
