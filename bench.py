@@ -485,6 +485,16 @@ def main():
     prefix_fleet = _asyncio.run(
         _asyncio.wait_for(run_prefix_fleet(), 120))
 
+    # Drain migration (ISSUE 15): KV-carrying resume of a handed-off
+    # stream (real PrefixFetcher over the modeled wire) vs cold
+    # re-prefill — the scale-down TTFT blip the elastic fleet pays.
+    # Smoke-gated: blip_ratio < 1.0 with blocks carried and zero
+    # fallbacks; a fabricated drop-the-KV donor must fail it.
+    from dynamo_tpu.bench.drain import run_drain_migration_model
+
+    drain_migration = _asyncio.run(
+        _asyncio.wait_for(run_drain_migration_model(), 120))
+
     # Transfer plane (ISSUE 13): GB/s of the host-staged vs
     # device-direct vs streamed KV planes between two real engines, vs
     # the ICI/DCN datasheet (transfer_mbu) — transfer gets a roofline
@@ -588,6 +598,7 @@ def main():
         "spec_decode": spec_decode,
         "prefill_plane": prefill_plane,
         "prefix_fleet": prefix_fleet,
+        "drain_migration": drain_migration,
         "sharded_decode": sharded_decode,
         "transfer": transfer,
         "peak_flops_nominal": round(peak / 1e12, 1),

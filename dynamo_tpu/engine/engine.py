@@ -95,6 +95,12 @@ class TokenDelta:
     # log p(token) per entry of token_ids; only populated for requests
     # with sampling.logprobs set.
     logprobs: Optional[List[float]] = None
+    # Drain handoff (llm/drain.py): a worker leaving the fleet ends the
+    # stream with this set instead of a finish — {"reason", "covered_tokens",
+    # "address"?} tells the frontend's MigrationClient to resume the
+    # stream on a peer, pulling the resident KV from `address` first.
+    # Never reaches end clients; the migration layer consumes it.
+    migrate: Optional[dict] = None
 
 
 @dataclass(frozen=True)
@@ -637,6 +643,12 @@ class EngineCore:
                 sched_cfg,
                 shard_of_slot=lambda s: s // rows_per_shard)
         self.scheduler = Scheduler(sched_cfg, self.allocator)
+        # QoS preemption (ISSUE 15 leg 3): the scheduler picks victims,
+        # the engine executes the preempt so seal bookkeeping resets and
+        # the victim's sealed KV demotes to the host tier (resume is a
+        # tier onboard, not a re-prefill).
+        self.scheduler.qos_preempt_sink = self._qos_preempt
+        self.qos_demoted_blocks = 0
 
         # Padding writes target this position; it indexes past every
         # runtime table width, so slots_for_positions resolves it to the
@@ -708,6 +720,7 @@ class EngineCore:
         prompt_tokens: List[int],
         sampling: SamplingParams,
         prompt_embeds=None,
+        priority: int = 1,
     ) -> None:
         if request_id in self._requests:
             raise ValueError(f"duplicate request id {request_id}")
@@ -733,10 +746,12 @@ class EngineCore:
             self._lockstep.broadcast({
                 "op": "add", "rid": request_id,
                 "prompt": list(prompt_tokens),
-                "sampling": encode_sampling(sampling)})
+                "sampling": encode_sampling(sampling),
+                "priority": int(priority)})
         req = Request(request_id=request_id,
                       prompt_tokens=list(prompt_tokens), sampling=sampling,
-                      prompt_embeds=prompt_embeds)
+                      prompt_embeds=prompt_embeds,
+                      priority=int(priority))
         if prompt_embeds is not None:
             # Placeholder tokens must neither match nor seed the prefix
             # cache (different images share placeholder ids).
@@ -1065,7 +1080,8 @@ class EngineCore:
                 keys = keys.at[rows[i] if rows is not None else i].set(
                     jax.random.fold_in(
                         jax.random.key(r.sampling.seed),
-                        r.prior_output + len(r.output_tokens)))
+                        r.sampling.seed_offset + r.prior_output
+                        + len(r.output_tokens)))
         return keys
 
     def _run_decode_spec(self, work: DecodeWork) -> Optional[List[TokenDelta]]:
@@ -1930,8 +1946,8 @@ class EngineCore:
             temp[i] = req.sampling.temperature
             top_k[i] = req.sampling.top_k
             top_p[i] = req.sampling.top_p
-            offsets[i] = (req.prior_output + len(req.output_tokens)
-                          + lag * K)
+            offsets[i] = (req.sampling.seed_offset + req.prior_output
+                          + len(req.output_tokens) + lag * K)
         # Keys are RAW uint32 key data (wrapped on device by the window
         # fn): host-buildable numpy, which the multihost global-array
         # conversion requires (typed key arrays can't cross it).
@@ -2026,6 +2042,36 @@ class EngineCore:
         self._hash_seqs.pop(req.request_id, None)
         self._published_blocks.pop(req.request_id, None)
         self.scheduler.preempt(req)
+
+    def _qos_preempt(self, req: Request) -> None:
+        """Scheduler-chosen QoS victim (best-effort request displaced by a
+        higher class or by SLO burn): recompute-preempt it, then demote
+        its sealed blocks G1→host so the freed HBM is real capacity and
+        the eventual resume onboards KV from the tier instead of paying a
+        full re-prefill.  Mirrors _preempt_or_finish's seal-bookkeeping
+        reset (publication must follow recomputed KV)."""
+        rid = req.request_id
+        seq = self._hash_seqs.get(rid)
+        published = self._published_blocks.get(rid, 0)
+        sealed = ([b.block_hash for b in seq.blocks[:published]]
+                  if seq is not None else [])
+        n_sealed = len(sealed)
+        if not self._managed_cache:
+            self._publish_removed_blocks(req)
+        self._hash_seqs.pop(rid, None)
+        self._published_blocks.pop(rid, None)
+        self.scheduler.preempt(req)
+        demoted = 0
+        if self._managed_cache and sealed:
+            demoted = self.allocator.manager.demote_blocks(sealed)
+            self.qos_demoted_blocks += demoted
+        fl = self.flight
+        if fl.enabled:
+            fl.record("qos_preempt", rid=rid, prio=req.priority,
+                      sealed=n_sealed, demoted=demoted)
+        logger.info("qos-preempted %s (priority %d): %d sealed blocks, "
+                    "%d demoted to host tier", rid, req.priority,
+                    n_sealed, demoted)
 
     def _fetch_host(self, arr) -> np.ndarray:
         """Device → host read valid under any topology (multihost
@@ -2548,10 +2594,11 @@ class InferenceEngine:
                 self._resolve(fut, None, e)
             else:
                 self._resolve(fut, result, None)
-        for rid, prompt, sampling, embeds in adds:
+        for rid, prompt, sampling, embeds, priority in adds:
             try:
                 self.core.add_request(rid, prompt, sampling,
-                                      prompt_embeds=embeds)
+                                      prompt_embeds=embeds,
+                                      priority=priority)
             except ValueError as e:
                 self._dispatch(TokenDelta(
                     request_id=rid, token_ids=[], finished=True,
@@ -2589,6 +2636,7 @@ class InferenceEngine:
         prompt_tokens: List[int],
         sampling: SamplingParams,
         prompt_embeds=None,
+        priority: int = 1,
     ) -> AsyncIterator[TokenDelta]:
         """Submit and stream deltas until the request finishes.
 
@@ -2599,7 +2647,7 @@ class InferenceEngine:
         self._queues[request_id] = q
         with self._cmd_lock:
             self._pending_adds.append((request_id, prompt_tokens, sampling,
-                                       prompt_embeds))
+                                       prompt_embeds, priority))
         self._wake.set()
         try:
             while True:

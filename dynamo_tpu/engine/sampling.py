@@ -38,7 +38,12 @@ class SamplingParams:
     logprobs: bool = False
     # Migration support (reference migration.rs:148-163): tokens already
     # generated before a retry are appended to the prompt and max_tokens is
-    # decremented by the caller.
+    # decremented by the caller.  `seed_offset` carries how many tokens a
+    # previous incarnation of this stream already emitted, so seeded rows
+    # keep the (seed, token-index) contract across a cross-worker
+    # migration: the engine folds seed_offset into the per-token fold_in
+    # index exactly like a local preemption's prior_output.
+    seed_offset: int = 0
 
 
 def chosen_logprobs(logits: jax.Array, tokens: jax.Array) -> jax.Array:

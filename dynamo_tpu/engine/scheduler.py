@@ -79,6 +79,12 @@ class Request:
     # dp-attention locality: the allocator shard this request's pages
     # come from (derived from its slot at admission; None = shard-less).
     locality_shard: Optional[int] = None
+    # QoS class (ISSUE 15): 0 = best-effort (preemptible under SLO burn,
+    # held at admission while the budget burns), 1 = standard (default),
+    # 2 = interactive.  Admission picks the highest class first (FCFS
+    # within a class); capacity shortfalls preempt strictly-lower
+    # classes before refusing a higher one.
+    priority: int = 1
 
     @property
     def total_len(self) -> int:
@@ -516,6 +522,20 @@ class Scheduler:
         # mixed_prefill_tokens / per-row slack caps while decode rows are
         # live.  None = legacy static caps.
         self.mixed_budget_override: Optional[int] = None
+        # QoS pressure (ISSUE 15 leg 3): `qos_pressure_fn() -> float` is
+        # the SLO monitor's worst fast-window burn rate (worker wires
+        # `SloMonitor.last_max_burn`); at or above `qos_threshold` the
+        # error budget is actively burning — best-effort (priority <= 0)
+        # admissions hold, and running best-effort requests are shed one
+        # per plan() while a higher class waits.  `qos_preempt_sink(req)`
+        # executes the preempt (the engine's _qos_preempt: recompute
+        # preemption + sealed-block demotion to the host tier); a bare
+        # scheduler without a sink falls back to plain preempt().
+        self.qos_pressure_fn: Optional[Callable[[], float]] = None
+        self.qos_threshold: float = 1.0
+        self.qos_preempt_sink: Optional[Callable[[Request], None]] = None
+        self.qos_preemptions = 0          # cumulative victims
+        self.qos_active = False           # pressure state at last plan()
 
     # -- admission --------------------------------------------------------
 
@@ -530,10 +550,74 @@ class Scheduler:
     def _pages_needed(self, tokens: int) -> int:
         return (tokens + self.config.block_size - 1) // self.config.block_size
 
+    def _qos_pressure(self) -> bool:
+        """True while the installed SLO burn signal is at or above the
+        QoS threshold (a broken/missing signal reads as no pressure —
+        QoS must never wedge admission)."""
+        fn = self.qos_pressure_fn
+        if fn is None:
+            return False
+        try:
+            burn = fn()
+        except Exception:
+            return False
+        return burn is not None and burn >= self.qos_threshold
+
+    def _next_admit_index(self, pressure: bool) -> Optional[int]:
+        """Waiting index to admit next: highest priority class first,
+        FCFS within a class; under SLO-burn pressure best-effort
+        (priority <= 0) requests hold in the queue."""
+        best = None
+        best_p = None
+        for i, r in enumerate(self.waiting):
+            if pressure and r.priority <= 0:
+                continue
+            if best is None or r.priority > best_p:
+                best, best_p = i, r.priority
+        return best
+
+    def _qos_victim(self, min_priority: int) -> Optional[Request]:
+        """Newest running request of the lowest class strictly below
+        `min_priority` — the least-progressed work of the most
+        preemptible class."""
+        victims = [r for r in self.running if r.priority < min_priority]
+        if not victims:
+            return None
+        low = min(r.priority for r in victims)
+        return [r for r in victims if r.priority == low][-1]
+
+    def _qos_preempt(self, req: Request) -> None:
+        """Execute one QoS preemption through the engine's sink (which
+        resets seal bookkeeping and demotes the victim's sealed KV to
+        the host tier); a bare scheduler preempts in place."""
+        self.qos_preemptions += 1
+        sink = self.qos_preempt_sink
+        if sink is not None:
+            sink(req)
+        else:
+            self.preempt(req)
+
+    def _qos_shed(self) -> None:
+        """SLO burn at/above threshold: shed ONE running best-effort
+        request per plan() — bounded work — but only while a higher
+        class is actually in the machine or waiting for it (an
+        all-best-effort fleet has nobody to yield to; parking it would
+        just idle the hardware)."""
+        if not (any(r.priority > 0 for r in self.waiting)
+                or any(r.priority > 0 for r in self.running)):
+            return
+        victims = [r for r in self.running if r.priority <= 0]
+        if victims:
+            self._qos_preempt(victims[-1])
+
     def _try_admit(self) -> None:
         usable = self.allocator.num_blocks - 1
+        pressure = self.qos_active
         while self.waiting and len(self.running) < self.config.max_seqs:
-            req = self.waiting[0]
+            idx = self._next_admit_index(pressure)
+            if idx is None:
+                break  # only held best-effort requests remain queued
+            req = self.waiting[idx]
             slot = next(
                 (i for i, s in enumerate(self._slots) if s is None), None)
             if slot is None:
@@ -559,14 +643,22 @@ class Scheduler:
             if free_here - need_new < self.config.watermark * usable:
                 if cached_pages:
                     self.allocator.release(cached_pages)
+                # Priority preemption: a capacity-blocked higher class
+                # displaces the newest strictly-lower-class request (its
+                # sealed KV demotes down-tier via the engine sink) and
+                # the admission retries with the freed pages.
+                victim = self._qos_victim(req.priority)
+                if victim is not None:
+                    self._qos_preempt(victim)
+                    continue
                 # Nothing running means nothing will ever free pages — the
                 # head request can never fit; fail it instead of spinning.
                 if not self.running:
-                    self.waiting.pop(0)
+                    self.waiting.pop(idx)
                     req.state = RequestState.FINISHED
                     req.finish_reason = FinishReason.LENGTH
                 break
-            self.waiting.pop(0)
+            self.waiting.pop(idx)
             req.locality_shard = shard
             req.pages = list(cached_pages) + self._allocate(need_new, shard)
             # Cached prefix skips prefill compute, but at least the last
@@ -619,6 +711,9 @@ class Scheduler:
         Decode-first (latency): all DECODE sequences take one step; the
         remaining token budget goes to prefill chunks, longest-waiting
         first (FCFS, like the reference mocker)."""
+        self.qos_active = self._qos_pressure()
+        if self.qos_active:
+            self._qos_shed()
         self._try_admit()
         bs = self.config.block_size
 

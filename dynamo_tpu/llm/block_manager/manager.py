@@ -315,6 +315,25 @@ class KvBlockManager:
         self.onboarded_blocks += 1
         return True
 
+    @engine_thread_only
+    def demote_blocks(self, hashes: Sequence[int]) -> int:
+        """QoS preemption demotion: push the given G1-resident INACTIVE
+        blocks down to the host tier now, freeing their device slots.
+        Without a host tier this is a deliberate no-op — the blocks stay
+        inactive in G1 (still resumable until LRU pressure reclaims
+        them) rather than being destroyed; "demoted, not lost" is the
+        contract.  Returns how many blocks actually moved."""
+        if self.host is None:
+            return 0
+        n = 0
+        for h in hashes:
+            # device.on_evict is the chained hook (ManagedBlockSource):
+            # offload to G2 first, then the REMOVED KV event that keeps
+            # router indexes truthful about G1 residency.
+            if self.device.demote_hash(h):
+                n += 1
+        return n
+
     def set_eviction_bias(self, fn, scan: int = 8) -> None:
         """Install the eviction-bias hook on every demoting tier: G1
         eviction chooses what rides down to G2, G2 eviction what spills

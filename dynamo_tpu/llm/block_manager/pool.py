@@ -252,6 +252,26 @@ class BlockPool:
                 del self._slots[idx]
                 self._free.append(idx)
 
+    def demote_hash(self, block_hash: int) -> bool:
+        """Evict a specific INACTIVE registered block NOW, firing the
+        on_evict chain (offload down-tier + removal events) — the QoS
+        preemption demotion primitive: a preempted request's sealed
+        blocks move to the host tier immediately instead of waiting for
+        allocation pressure to pick them.  Pinned or unknown hashes are
+        refused (a block another request still holds must not move).
+        Deliberately not counted in `evictions` — demotion is policy,
+        not pressure."""
+        slot = self.registry.inactive.get(block_hash)
+        if slot is None:
+            return False
+        del self.registry.inactive[block_hash]
+        del self.registry.by_hash[block_hash]
+        del self._slots[slot.index]
+        self._free.append(slot.index)
+        if self.on_evict:
+            self.on_evict(block_hash, slot.index)
+        return True
+
     def clear_inactive(self) -> List[int]:
         """Drop EVERY inactive registered block (admin cache flush —
         reference `clear_kv_blocks.rs`): returns the dropped hashes.

@@ -69,6 +69,12 @@ logger = logging.getLogger(__name__)
 # request's annotations dict keeps the wire codec unchanged: old
 # workers ignore the key, old routers simply never set it.
 HINT_ANNOTATION = "remote_prefix"
+# KV-carrying migration (ISSUE 15): the frontend's MigrationClient sets
+# this on a drain-handoff re-issue, pointing at the DRAINING worker's
+# kv_blocks endpoint with the migrated stream's sealed high-water mark.
+# Separate key from HINT_ANNOTATION because the KV router clears/rewrites
+# that one per routing decision — a migration hint must survive routing.
+MIGRATE_ANNOTATION = "migrate_kv"
 
 
 def encode_hint(address: str, covered_tokens: int,
@@ -141,6 +147,9 @@ class PrefixFetcher:
         self.pull_timeout = pull_timeout
         self.plane = plane
         self.device_pulled_blocks = 0   # blocks that crossed device-direct
+        # KV-carrying migration landings (migrate_kv hints consumed with
+        # >= 1 block pulled) — `dynamo_requests_migrated_in_total`.
+        self.migrated_in = 0
         # One pull per prefix head at a time: a burst of requests
         # sharing a root must not fetch the identical blocks N times —
         # later pulls wait, re-check residency, and skip the wire.
@@ -158,13 +167,21 @@ class PrefixFetcher:
 
     @never_engine_thread
     async def pull(self, prompt_tokens: List[int], address: str,
-                   covered_tokens: int = 0) -> int:
+                   covered_tokens: int = 0,
+                   stats: Optional[dict] = None) -> int:
         """Pull up to `covered_tokens` (the donor's high-water mark; <=0
         means every sealed block) of the prompt's sealed prefix from the
         peer at `address`.  Returns tokens now locally covered.  Never
         raises: transfer errors, donor death and kv-quant refusals count
         a fallback and return whatever contiguous prefix landed — the
-        caller's local prefill covers the rest."""
+        caller's local prefill covers the rest.
+
+        `stats`: optional dict filled with THIS call's outcome
+        (`gained_blocks`) — per-call attribution the shared fetcher's
+        cumulative counters can't give (concurrent pulls interleave)."""
+        if stats is None:
+            stats = {}
+        stats["gained_blocks"] = 0
         hashes = sealed_hashes(list(prompt_tokens), self.block_size)
         want_blocks = len(hashes)
         if covered_tokens > 0:
@@ -183,14 +200,16 @@ class PrefixFetcher:
         try:
             async with entry[0]:
                 return await self._pull_locked(prompt_tokens, address,
-                                               hashes, want_blocks)
+                                               hashes, want_blocks,
+                                               stats)
         finally:
             entry[1] -= 1
             if entry[1] == 0:
                 self._inflight.pop(hashes[0], None)
 
     async def _pull_locked(self, prompt_tokens, address: str,
-                           hashes: List[int], want_blocks: int) -> int:
+                           hashes: List[int], want_blocks: int,
+                           stats: dict) -> int:
         from dynamo_tpu.runtime import tracing
 
         # Locally resident prefix needs no wire work (a repeat request,
@@ -240,6 +259,7 @@ class PrefixFetcher:
                 self.remote_hits += 1
                 self.pulled_blocks += gained
                 self.pulled_tokens += gained * self.block_size
+            stats["gained_blocks"] = max(0, gained)
             span.set_attr(blocks_pulled=max(0, gained),
                           tokens_covered=covered)
             return covered
@@ -398,6 +418,28 @@ class PrefixShareClient:
 
     @never_engine_thread
     async def generate(self, request):
+        from dynamo_tpu.runtime import flight_recorder
+
+        # KV-carrying migration first (ISSUE 15): the migrate hint covers
+        # prompt + already-generated tokens of a handed-off stream, so it
+        # supersedes any router donor hint for the same blocks (the
+        # residency check makes the second pull a no-op anyway).
+        mig = decode_hint(request.annotations.get(MIGRATE_ANNOTATION))
+        if mig is not None:
+            # Per-call stats, not a delta of the shared fetcher's
+            # cumulative counters: concurrent router-hint pulls by other
+            # requests would be misattributed to this migration.
+            pull_stats: dict = {}
+            covered = await self.fetcher.pull(
+                request.token_ids, mig["address"], mig["covered_tokens"],
+                stats=pull_stats)
+            gained = pull_stats.get("gained_blocks", 0)
+            if gained > 0:
+                self.fetcher.migrated_in += 1
+            fl = flight_recorder.get_recorder()
+            if fl.enabled:
+                fl.record("migrate_in", rid=request.request_id,
+                          covered=covered, pulled=gained)
         hint = decode_hint(request.annotations.get(HINT_ANNOTATION))
         if hint is not None:
             await self.fetcher.pull(request.token_ids, hint["address"],

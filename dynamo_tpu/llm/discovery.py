@@ -48,6 +48,7 @@ def request_to_wire(req: PreprocessedRequest) -> dict:
             "max_tokens": s.max_tokens,
             "stop_token_ids": list(s.stop_token_ids), "seed": s.seed,
             "logprobs": s.logprobs,
+            "seed_offset": s.seed_offset,
         },
         "stop_sequences": list(req.stop_sequences),
         "annotations": dict(req.annotations),
@@ -82,7 +83,8 @@ def request_from_wire(d: dict) -> PreprocessedRequest:
             max_tokens=s.get("max_tokens", 16),
             stop_token_ids=tuple(s.get("stop_token_ids", ())),
             seed=s.get("seed"),
-            logprobs=bool(s.get("logprobs", False))),
+            logprobs=bool(s.get("logprobs", False)),
+            seed_offset=int(s.get("seed_offset", 0))),
         stop_sequences=list(d.get("stop_sequences", [])),
         annotations=dict(d.get("annotations", {})),
         prompt_embeds=embeds,
@@ -97,17 +99,23 @@ def delta_to_wire(delta: TokenDelta) -> dict:
     }
     if delta.logprobs is not None:
         d["logprobs"] = list(delta.logprobs)
+    if delta.migrate is not None:
+        # Drain handoff marker (llm/drain.py): old frontends simply
+        # never see it set; old workers never set it.
+        d["migrate"] = dict(delta.migrate)
     return d
 
 
 def delta_from_wire(d: dict) -> TokenDelta:
     fr = d.get("finish_reason")
     lp = d.get("logprobs")
+    mig = d.get("migrate")
     return TokenDelta(
         request_id="", token_ids=list(d.get("token_ids", [])),
         finished=bool(d.get("finished")),
         finish_reason=FinishReason(fr) if fr else None,
-        logprobs=list(lp) if lp is not None else None)
+        logprobs=list(lp) if lp is not None else None,
+        migrate=dict(mig) if mig is not None else None)
 
 
 EMBED_ENDPOINT = "embed"
@@ -147,8 +155,14 @@ def engine_wire_handler(engine_client, request_metrics=None) -> Callable:
         start = _time.monotonic()
         last_t = None
         finished_ok = None
+        observe = True
         try:
             async for delta in engine_client.generate(req):
+                if getattr(delta, "migrate", None) is not None:
+                    # Drain handoff: the PEER serves (and observes) the
+                    # remainder of this stream — one request, one
+                    # outcome.
+                    observe = False
                 if request_metrics is not None and delta.token_ids:
                     now = _time.monotonic()
                     if last_t is None:
@@ -162,15 +176,25 @@ def engine_wire_handler(engine_client, request_metrics=None) -> Callable:
                 yield delta_to_wire(delta)
         except (GeneratorExit, asyncio.CancelledError):
             raise  # client disconnect / teardown: not an engine failure
-        except Exception:
-            # A raising generate() (dead disagg peer, engine fault) IS a
-            # served-request failure — it must burn error-rate budget
-            # even though no ERROR delta was yielded.
-            finished_ok = False
+        except Exception as e:
+            from dynamo_tpu.llm.drain import DRAIN_REFUSAL
+
+            if DRAIN_REFUSAL in str(e):
+                # Draining worker refusing an admission: the retryable
+                # marker re-routes the request to a peer, which serves
+                # and OBSERVES it — counting an outcome here would
+                # double-count the request (and burn error budget on a
+                # request that succeeds).
+                observe = False
+            else:
+                # A raising generate() (dead disagg peer, engine fault)
+                # IS a served-request failure — it must burn error-rate
+                # budget even though no ERROR delta was yielded.
+                finished_ok = False
             raise
         finally:
             tracer.unbind(req.request_id)
-            if request_metrics is not None:
+            if request_metrics is not None and observe:
                 # A stream torn down without a terminal delta (client
                 # disconnect mid-generation) is not an engine failure.
                 request_metrics.observe_outcome(
@@ -412,7 +436,7 @@ class ModelWatcher:
                                 registry=self.registry)
                      if self.router_mode == "kv" else RemoteOp())
         pipeline = Pipeline([
-            MigrationOp(limit=self.migration_limit),
+            MigrationOp(limit=self.migration_limit, registry=self.registry),
             router_op,
         ])
         engine_client = await pipeline.attach(client)

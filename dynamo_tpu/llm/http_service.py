@@ -236,6 +236,7 @@ class HttpService:
             err = self._validate_context(handle, pre)
             if err is not None:
                 return err
+            self._attach_priority(request, pre)
             logger.info("request %s: chat model=%s prompt_tokens=%d "
                         "stream=%s", rid, body.model, len(pre.token_ids),
                         body.stream)
@@ -247,6 +248,18 @@ class HttpService:
             return await self._unary_chat(handle, body, pre, rid)
         finally:
             self._end_trace(root, tok)
+
+    @staticmethod
+    def _attach_priority(request: web.Request, pre) -> None:
+        """QoS class (ISSUE 15): the x-dynamo-priority header (named
+        class or 0..2 integer) rides the preprocessed request's
+        annotations to the worker's scheduler.  Absent header = standard;
+        the worker side is equally forgiving (service.priority_of)."""
+        header = request.headers.get("x-dynamo-priority")
+        if header:
+            from dynamo_tpu.llm.service import PRIORITY_ANNOTATION
+
+            pre.annotations[PRIORITY_ANNOTATION] = header.strip()
 
     @staticmethod
     def _has_image_parts(messages) -> bool:
@@ -280,6 +293,7 @@ class HttpService:
         err = self._validate_context(handle, pre)
         if err is not None:
             return err
+        self._attach_priority(request, pre)
         logger.info("request %s: completion model=%s prompt_tokens=%d "
                     "stream=%s", rid, body.model, len(pre.token_ids),
                     body.stream)
@@ -367,6 +381,7 @@ class HttpService:
         err = self._validate_context(handle, pre)
         if err is not None:
             return err
+        self._attach_priority(request, pre)
         logger.info("request %s: responses model=%s prompt_tokens=%d "
                     "stream=%s", rid, body.model, len(pre.token_ids),
                     body.stream)

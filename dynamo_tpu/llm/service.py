@@ -30,6 +30,31 @@ class EngineClient(Protocol):
     ) -> AsyncIterator[TokenDelta]: ...
 
 
+# QoS classes (ISSUE 15): the frontend's x-dynamo-priority header (or a
+# router/operator annotation) rides the request's annotations dict under
+# this key; the worker resolves it to the scheduler's integer class.
+PRIORITY_ANNOTATION = "priority"
+PRIORITY_CLASSES = {"best_effort": 0, "best-effort": 0, "batch": 0,
+                    "standard": 1, "default": 1,
+                    "interactive": 2, "realtime": 2}
+
+
+def priority_of(request) -> int:
+    """Scheduler priority from a request's `priority` annotation: a
+    named class or a bare integer; anything malformed (version-skewed
+    frontend) is standard — never fail a request over QoS metadata."""
+    raw = request.annotations.get(PRIORITY_ANNOTATION)
+    if raw is None:
+        return 1
+    raw = str(raw).strip().lower()
+    if raw in PRIORITY_CLASSES:
+        return PRIORITY_CLASSES[raw]
+    try:
+        return max(0, min(2, int(raw)))
+    except ValueError:
+        return 1
+
+
 class LocalEngineClient:
     """EngineClient over an in-process InferenceEngine."""
 
@@ -51,7 +76,8 @@ class LocalEngineClient:
         try:
             async for delta in self._engine.generate(
                     request.request_id, request.token_ids, request.sampling,
-                    prompt_embeds=request.prompt_embeds):
+                    prompt_embeds=request.prompt_embeds,
+                    priority=priority_of(request)):
                 yield delta
         finally:
             tracer.unbind(request.request_id)

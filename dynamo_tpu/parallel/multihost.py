@@ -281,14 +281,16 @@ def _decode_sampling(d: dict):
         temperature=d["temperature"], top_k=d["top_k"], top_p=d["top_p"],
         max_tokens=d["max_tokens"],
         stop_token_ids=tuple(d["stop_token_ids"]),
-        seed=d["seed"], logprobs=d["logprobs"])
+        seed=d["seed"], logprobs=d["logprobs"],
+        seed_offset=d.get("seed_offset", 0))
 
 
 def encode_sampling(s) -> dict:
     return {"temperature": s.temperature, "top_k": s.top_k,
             "top_p": s.top_p, "max_tokens": s.max_tokens,
             "stop_token_ids": list(s.stop_token_ids), "seed": s.seed,
-            "logprobs": s.logprobs}
+            "logprobs": s.logprobs,
+            "seed_offset": getattr(s, "seed_offset", 0)}
 
 
 def run_follower(core, chan: LockstepFollower,
@@ -320,7 +322,8 @@ def _follower_loop(core, chan: LockstepFollower,
         elif op == "add":
             try:
                 core.add_request(cmd["rid"], cmd["prompt"],
-                                 _decode_sampling(cmd["sampling"]))
+                                 _decode_sampling(cmd["sampling"]),
+                                 priority=cmd.get("priority", 1))
             except ValueError:
                 logger.warning("follower: rejected add %s (mirrors "
                                "leader rejection)", cmd["rid"])

@@ -25,13 +25,26 @@ class LocalConnector:
     def __init__(self, control_plane_addr: str, *,
                  worker_args: Optional[List[str]] = None,
                  env: Optional[dict] = None,
-                 log_dir: str = "/tmp") -> None:
+                 log_dir: str = "/tmp",
+                 drain_timeout_s: float = 45.0) -> None:
         """`worker_args`: extra argv after `--control-plane ADDR`
-        (e.g. ["--mocker", "--model-name", "m"])."""
+        (e.g. ["--mocker", "--model-name", "m"]).
+
+        `drain_timeout_s`: scale-down budget — SIGTERM starts the
+        worker's KV-migrating drain (worker/main.py `--drain on`); a
+        worker that hasn't exited inside the budget is force-killed,
+        counted and logged DISTINCTLY from a clean drain (ISSUE 15: the
+        two used to read as one SIGTERM in the logs, hiding every drain
+        regression)."""
         self.control_plane_addr = control_plane_addr
         self.worker_args = list(worker_args or [])
         self.env = dict(env if env is not None else os.environ)
         self.log_dir = log_dir
+        self.drain_timeout_s = drain_timeout_s
+        # Scale-down outcome accounting (planner_metrics_text exports
+        # these as dynamo_planner_drains_total{outcome}).
+        self.clean_drains = 0
+        self.force_kills = 0
         self._procs: List[subprocess.Popen] = []
         # add_worker's spawn thread appends while _reap (event loop,
         # via a concurrent /metrics scrape) rebuilds the list — both
@@ -90,24 +103,40 @@ class LocalConnector:
         logger.info("connector: spawned worker pid %d", proc.pid)
 
     async def remove_worker(self) -> None:
-        """Drain the newest worker: SIGTERM → it leaves routing and
-        finishes in-flight streams before exiting."""
+        """Scale-down = drain, not drop: SIGTERM starts the worker's
+        KV-migrating drain (it leaves routing instantly, hands each
+        in-flight stream to a peer with its sealed KV, lingers for the
+        peers' pulls, then exits).  This call WAITS for drain-complete —
+        worker exit — up to `drain_timeout_s`; only then does the reaper
+        escalate to SIGKILL, logging and counting the force-kill
+        distinctly from a clean drain."""
         self._reap()
         with self._procs_lock:
             if not self._procs:
                 return
             proc = self._procs.pop()
-        logger.info("connector: draining worker pid %d", proc.pid)
+        logger.info("connector: draining worker pid %d (budget %.1fs)",
+                    proc.pid, self.drain_timeout_s)
         proc.send_signal(signal.SIGTERM)
-
-        # Reap off-loop: the drain can take as long as its longest
-        # in-flight stream.
-        async def reap():
-            while proc.poll() is None:
-                await asyncio.sleep(0.5)
-            self._close_log(proc)
-
-        asyncio.get_running_loop().create_task(reap())
+        deadline = (asyncio.get_running_loop().time()
+                    + max(0.0, self.drain_timeout_s))
+        while proc.poll() is None \
+                and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.1)
+        if proc.poll() is None:
+            self.force_kills += 1
+            logger.error(
+                "connector: worker pid %d did NOT drain within %.1fs — "
+                "force-killing (SIGKILL); its in-flight KV is lost and "
+                "peers fall back to re-prefill", proc.pid,
+                self.drain_timeout_s)
+            proc.kill()
+            await asyncio.to_thread(proc.wait, 10)
+        else:
+            self.clean_drains += 1
+            logger.info("connector: worker pid %d drained cleanly "
+                        "(rc=%s)", proc.pid, proc.returncode)
+        self._close_log(proc)
 
     async def shutdown(self) -> None:
         self._reap()

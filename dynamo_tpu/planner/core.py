@@ -64,6 +64,11 @@ class LoadPlanner:
         self._usage_pred = make_predictor(self.config.predictor)
         self._waiting_pred = make_predictor(self.config.predictor)
         self._tasks = []
+        # In-flight scale-down: remove_worker waits out the worker's
+        # KV-migrating drain (up to the connector's drain_timeout_s), so
+        # it runs as a background task — the adjustment loop must stay
+        # responsive to scale-UP pressure mid-drain.
+        self._drain_task: Optional[asyncio.Task] = None
         self.decisions: list = []              # (ts, kind, reason) log
 
     async def start(self) -> None:
@@ -72,6 +77,13 @@ class LoadPlanner:
 
     async def stop(self) -> None:
         await self._watcher.stop()
+        if self._drain_task is not None and not self._drain_task.done():
+            # Let an in-flight drain finish (bounded by the connector's
+            # own timeout) rather than orphan a half-drained worker.
+            try:
+                await self._drain_task
+            except Exception:
+                logger.exception("planner: in-flight drain failed at stop")
         for t in self._tasks:
             t.cancel()
             try:
@@ -104,6 +116,8 @@ class LoadPlanner:
         """One planning decision from current predictions; returns
         "up" | "down" | None.  Synchronous and side-effect-free on the
         connector (unit-testable; the loop applies it)."""
+        draining = (self._drain_task is not None
+                    and not self._drain_task.done())
         replicas = self.connector.replicas()
         if replicas < self.config.min_replicas:
             # Floor check needs no observations — it's how the fleet
@@ -127,9 +141,12 @@ class LoadPlanner:
                 and replicas < self.config.max_replicas):
             return "up"
         # Scale down only if the survivors could absorb the load under
-        # kv_low: usage*n / (n-1) stays below the low-water mark — and
-        # never while an SLO is actively burning budget.
-        if (replicas > self.config.min_replicas and p_waiting < 1.0
+        # kv_low: usage*n / (n-1) stays below the low-water mark — never
+        # while an SLO is actively burning budget, and one drain at a
+        # time (a scale-down is committed until its background
+        # remove_worker lands; stacking removals would over-shed).
+        if (not draining
+                and replicas > self.config.min_replicas and p_waiting < 1.0
                 and n_reporting > 1 and burn < 1.0
                 and p_usage * n_reporting / (n_reporting - 1)
                 < self.config.kv_low):
@@ -169,7 +186,11 @@ class LoadPlanner:
                     self.decisions.append((time.monotonic(), "down",
                                            self._reason()))
                     logger.info("planner: scaling DOWN (%s)", self._reason())
-                    await self.connector.remove_worker()
+                    # Background: remove_worker waits out the drain
+                    # (plan_step holds further decisions off until it
+                    # lands; scale-up pressure still gets polled).
+                    self._drain_task = asyncio.create_task(
+                        self.connector.remove_worker())
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -203,6 +224,16 @@ def planner_metrics_text(planner, connector) -> str:
     lines.append('dynamo_planner_decisions_total{direction="up"} %d' % ups)
     lines.append('dynamo_planner_decisions_total{direction="down"} %d'
                  % downs)
+    # Scale-down outcomes (ISSUE 15): clean KV-migrating drains vs
+    # drain-timeout force-kills — a rising force_kill count is the
+    # "drains are broken" alarm, previously invisible.
+    for attr, outcome in (("clean_drains", "clean"),
+                          ("force_kills", "force_kill")):
+        n = getattr(connector, attr, None)
+        if n is not None:
+            lines.append(
+                'dynamo_planner_drains_total{outcome="%s"} %d'
+                % (outcome, n))
     for attr, name in (("_usage_pred", "kv_usage"),
                        ("_waiting_pred", "requests_waiting")):
         pred = getattr(planner, attr, None)

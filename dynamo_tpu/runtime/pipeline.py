@@ -48,16 +48,19 @@ class FnOp:
 
 
 class MigrationOp:
-    """Retry/resume streams across worker death (llm/migration.py;
-    reference `migration.rs:27`)."""
+    """Retry/resume streams across worker death and planned drain
+    (llm/migration.py; reference `migration.rs:27`).  `registry` counts
+    `dynamo_migrations_total{reason}` on the frontend's /metrics."""
 
-    def __init__(self, limit: int = 3) -> None:
+    def __init__(self, limit: int = 3, registry=None) -> None:
         self.limit = limit
+        self.registry = registry
 
     def wrap(self, inner):
         from dynamo_tpu.llm.migration import MigrationClient
 
-        return MigrationClient(inner, migration_limit=self.limit)
+        return MigrationClient(inner, migration_limit=self.limit,
+                               registry=self.registry)
 
 
 class KvRouterOp:

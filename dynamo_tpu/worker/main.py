@@ -177,6 +177,25 @@ def parse_args(argv=None):
                         "routable address (K8s manifests inject the pod "
                         "IP) — the 127.0.0.1 default only works "
                         "single-host")
+    p.add_argument("--drain", choices=("on", "off"), default="on",
+                   help="SIGTERM drain with live KV migration (ISSUE "
+                        "15): leave routing instantly, hand each "
+                        "in-flight stream to a peer WITH its sealed KV "
+                        "(migrate delta + kv_blocks pull), linger for "
+                        "the peers' pulls, then exit.  'off' restores "
+                        "the wait-out-every-stream SIGTERM.  The "
+                        "control-plane key drain/<pid> (or "
+                        "drain/instance/<id>) triggers the same drain "
+                        "without a signal")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="bound on each drain phase (stream handoff; "
+                        "peer KV pulls): past it the worker exits "
+                        "anyway — peers fall back to re-prefill, "
+                        "requests still survive")
+    p.add_argument("--drain-linger-s", type=float, default=1.0,
+                   help="grace after the last stream handoff for peers "
+                        "to OPEN their KV pulls before the worker "
+                        "starts watching for zero active streams")
     from dynamo_tpu.runtime.flight_recorder import add_flight_args
     from dynamo_tpu.runtime.slo import add_slo_args
     from dynamo_tpu.runtime.tracing import add_trace_args
@@ -546,9 +565,30 @@ async def run(args) -> None:
 
             manager.set_eviction_bias(slo_eviction_bias(
                 lambda: slo_monitor.last_max_burn))
+        # QoS preemption lever (ISSUE 15 leg 3): burn >= 1 holds
+        # best-effort admissions and sheds running best-effort requests
+        # (their KV demotes to the host tier; resume = tier onboard).
+        # NOT under multihost lockstep: follower shadow schedulers never
+        # see the leader's host-local burn signal, and a pressure-driven
+        # preempt only on rank 0 would diverge the SPMD batch shapes.
+        if args.num_processes == 1:
+            transfer_engine.core.scheduler.qos_pressure_fn = (
+                lambda: slo_monitor.last_max_burn)
 
+    # Drain wrapper (ISSUE 15): OUTERMOST serving stage so a drain
+    # cancels the whole disagg/prefix-share/engine chain beneath it and
+    # ends each wire stream with the KV-carrying migrate delta.
+    from dynamo_tpu.llm.drain import (
+        DRAIN_PREFIX, DrainableService, drain_key_instance, drain_key_pid)
+
+    drainable = DrainableService(serve_client,
+                                 block_size=args.block_size)
     instance = await endpoint.serve(engine_wire_handler(
-        serve_client, request_metrics=request_metrics))
+        drainable, request_metrics=request_metrics))
+    if transfer_engine is not None:
+        # Peers pull the handed-off KV from this worker's kv_blocks
+        # endpoint — the instance address IS the donor descriptor.
+        drainable.kv_address = instance.address
     # (Transfer-plane discovery needs no control-plane record: the peer's
     # RPC address is already the instance record, and the per-transfer
     # descriptor — uuid + transfer address — travels in the kv_offer
@@ -609,6 +649,20 @@ async def run(args) -> None:
             lines.append(f"dynamo_engine_stalls_total {recorder.stalls}")
             lines.append("dynamo_engine_stalled "
                          f"{1 if watchdog is not None and watchdog.stalled else 0}")
+            # Elasticity / QoS plane (ISSUE 15): feeds `dynamo top`'s
+            # QOS/DRN column and the chaos-test oracles.
+            lines.append("dynamo_requests_migrated_total "
+                         f"{drainable.migrated_out}")
+            lines.append("dynamo_worker_draining "
+                         f"{1 if drainable.draining else 0}")
+            if core is not None:
+                lines.append("dynamo_qos_preemptions_total "
+                             f"{core.scheduler.qos_preemptions}")
+                lines.append("dynamo_qos_demoted_blocks_total "
+                             f"{core.qos_demoted_blocks}")
+            if prefix_fetcher is not None:
+                lines.append("dynamo_requests_migrated_in_total "
+                             f"{prefix_fetcher.migrated_in}")
             # Memory-plane sample at scrape time: pool occupancy /
             # eviction / prefix-hit series land in the shared registry.
             # Runs on the status server's event loop (host ints only),
@@ -662,13 +716,84 @@ async def run(args) -> None:
              asyncio.create_task(pump_metrics())]
 
     stop_ev = asyncio.Event()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop_ev.set)
+    drain_started = [False]
+
+    async def start_drain(reason: str) -> None:
+        """Planned drain (ISSUE 15): leave routing, hand every in-flight
+        stream to a peer with its KV, linger for the peers' pulls, then
+        let the normal shutdown path run.  Idempotent — a SIGTERM racing
+        a control-plane drain command drains once."""
+        if drain_started[0]:
+            return
+        drain_started[0] = True
+        try:
+            logger.warning("drain (%s): leaving routing, handing off %d "
+                           "in-flight stream(s)", reason,
+                           drainable.active_requests)
+            await endpoint.leave()      # instant removal from routing
+            await drainable.drain(args.drain_timeout_s)
+            if drainable.migrated_out and transfer_engine is not None:
+                # Handed-off KV only moves if the peers' kv_blocks pulls
+                # get to run: give them a beat to open their streams,
+                # then wait (bounded) until the RPC plane goes quiet.
+                await asyncio.sleep(max(0.0, args.drain_linger_s))
+                deadline = loop.time() + max(0.0, args.drain_timeout_s)
+                while runtime.rpc.active_streams > 0 \
+                        and loop.time() < deadline:
+                    await asyncio.sleep(0.05)
+            logger.info("drain complete: %d stream(s) migrated out",
+                        drainable.migrated_out)
+        except Exception:
+            # A drain that trips over a dead control plane must still
+            # END the worker — a latched drain_started with no stop_ev
+            # would make every later SIGTERM inert until the connector
+            # escalates to SIGKILL (dropping the KV this path exists to
+            # save).
+            logger.exception("drain (%s) failed; shutting down anyway",
+                             reason)
+        finally:
+            stop_ev.set()
+
+    def on_sigterm():
+        if args.drain == "off":
+            stop_ev.set()
+        else:
+            asyncio.ensure_future(start_drain("sigterm"))
+
+    loop.add_signal_handler(signal.SIGINT, stop_ev.set)
+    loop.add_signal_handler(signal.SIGTERM, on_sigterm)
+
+    async def watch_drain_commands():
+        """The control-plane `drain` command: a put under drain/<pid> or
+        drain/instance/<id> drains this worker exactly like SIGTERM —
+        the operator/planner surface for boxes where signals don't reach
+        (containers, remote hosts)."""
+        import os as _os
+
+        mine = {drain_key_pid(_os.getpid()),
+                drain_key_instance(instance.instance_id)}
+        try:
+            watch = await cp.watch_prefix(DRAIN_PREFIX)
+            async for ev in watch:
+                if ev.kind == "put" and ev.key in mine:
+                    logger.warning("control-plane drain command: %s",
+                                   ev.key)
+                    await start_drain("control_plane")
+                    return
+        except (ConnectionError, asyncio.CancelledError):
+            return  # cp gone / shutdown: the SIGTERM path still drains
+
+    drain_watch = (asyncio.create_task(watch_drain_commands())
+                   if args.drain != "off" else None)
     await stop_ev.wait()
 
-    # Graceful drain: leave routing instantly, finish in-flight streams.
+    # Graceful drain: leave routing instantly, finish in-flight streams
+    # (already done — and bounded — when start_drain ran).
+    if drain_watch is not None:
+        drain_watch.cancel()
     await endpoint.leave()
-    while runtime.rpc.active_streams > 0:
+    stream_deadline = loop.time() + max(5.0, args.drain_timeout_s)
+    while runtime.rpc.active_streams > 0 and loop.time() < stream_deadline:
         await asyncio.sleep(0.05)
     for t in pumps:
         t.cancel()

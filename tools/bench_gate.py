@@ -477,6 +477,34 @@ def prefix_fleet_checks() -> dict:
     }
 
 
+def drain_migration_checks() -> dict:
+    """ISSUE 15 smoke: the KV-carrying drain-migration resume (real
+    PrefixFetcher over the modeled wire) must beat cold re-prefill —
+    blip_ratio < 1.0 with blocks actually carried and zero re-prefill
+    fallbacks — and the FABRICATED drop-the-KV donor (serves nothing)
+    must FAIL that same claim: a gate that can't catch the KV silently
+    not moving isn't a gate."""
+    import asyncio
+
+    from dynamo_tpu.bench.drain import run_drain_migration_model
+
+    out = asyncio.run(asyncio.wait_for(run_drain_migration_model(), 120))
+    dropped = asyncio.run(asyncio.wait_for(
+        run_drain_migration_model(drop_kv=True), 120))
+    return {
+        "drain_migration_blip_ratio": out["blip_ratio"],
+        "drain_migration_kv_carried_blocks": out["kv_carried_blocks"],
+        "drain_migration_beats_reprefill": out["migration_beats_reprefill"],
+        # The happy path took zero re-prefill fallbacks (acceptance pin).
+        "drain_migration_no_fallbacks": out["reprefill_fallbacks"] == 0,
+        # Fabricated drop-the-KV run: carried nothing, so the
+        # beats-reprefill claim must come out False.
+        "drain_fabricated_drop_kv_fails": (
+            not dropped["migration_beats_reprefill"]
+            and dropped["kv_carried_blocks"] == 0),
+    }
+
+
 def sla_profiler_checks() -> dict:
     """ISSUE 11 smoke: the SLA profiler + capacity frontier on CPU —
     the deterministic mocker-cell sweep must emit a profile SlaPlanner
@@ -614,7 +642,11 @@ def run_smoke(args) -> int:
         the capacity model names the pinned cheapest fleet and REFUSES
         a fabricated over-SLO requirement, and a mocker fleet cell
         scraped through dynamo_top agrees with the model within the
-        documented tolerance.
+        documented tolerance;
+    12. drain migration (ISSUE 15): the KV-carrying drain resume (real
+        PrefixFetcher over the modeled wire) beats cold re-prefill
+        (blip_ratio < 1, blocks carried, zero fallbacks), and the
+        fabricated drop-the-KV donor must FAIL the same claim.
     """
     # The sharded checks need a multi-device rig: force the 8-way
     # virtual-CPU platform BEFORE anything imports jax (this smoke is
@@ -781,6 +813,7 @@ def run_smoke(args) -> int:
         **prefix_fleet_checks(),
         **sharded_decode_checks(),
         **sla_profiler_checks(),
+        **drain_migration_checks(),
     }
     ok = all(v is not False for v in checks.values())
     print(json.dumps({"smoke": "pass" if ok else "fail", **checks},

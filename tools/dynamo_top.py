@@ -213,6 +213,14 @@ def summarize(component: str, address: str, samples: List[Sample],
             samples, "dynamo_engine_last_step_age_seconds"),
         "engine_stalls": total(samples, "dynamo_engine_stalls_total"),
         "engine_stalled": total(samples, "dynamo_engine_stalled"),
+        # Elasticity / QoS plane (ISSUE 15): QoS preemption count,
+        # streams migrated out (drain handoffs), and whether the worker
+        # is currently draining — the QOS/DRN column.
+        "qos_preemptions": total(samples, "dynamo_qos_preemptions_total"),
+        "migrated_out": total(samples, "dynamo_requests_migrated_total"),
+        "migrated_in": total(samples,
+                             "dynamo_requests_migrated_in_total"),
+        "draining": total(samples, "dynamo_worker_draining"),
     }
 
 
@@ -346,6 +354,20 @@ def _fmt_age_stall(r: dict) -> str:
     return f"{a}/{s}{mark}"
 
 
+def _fmt_qos_drain(r: dict) -> str:
+    """QOS/DRN cell: QoS preemption count / streams migrated out,
+    suffixed `D` while the worker is draining.  Rows without the series
+    (frontend, old workers) render the no-data dash."""
+    qos = r.get("qos_preemptions")
+    mig = r.get("migrated_out")
+    if qos is None and mig is None:
+        return "—"
+    q = "—" if qos is None else str(int(qos))
+    m = "—" if mig is None else str(int(mig))
+    mark = "D" if (r.get("draining") or 0) > 0 else ""
+    return f"{q}/{m}{mark}"
+
+
 COLUMNS = (
     ("ROLE", 16, lambda r: r["component"]),
     ("ADDRESS", 21, lambda r: r["address"]),
@@ -371,6 +393,8 @@ COLUMNS = (
     # Engine heartbeat age / stall count (flight recorder + watchdog):
     # a wedged step loop reads as a growing AGE with a `!` marker.
     ("AGE/STL", 9, _fmt_age_stall),
+    # QoS preemptions / drain-migrated streams, `D` while draining.
+    ("QOS/DRN", 8, _fmt_qos_drain),
     # How far from the profiled saturation knee (--profile): 100% idle,
     # 0% at the knee, negative past it.
     ("HEADRM", 7, lambda r: _fmt(r.get("capacity_headroom"), "pct")),
