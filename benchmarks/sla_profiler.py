@@ -40,6 +40,16 @@ Two measurement backends share the sweep:
   sweep is the designated re-baselining vehicle now that BENCH_r*.json
   ends at r05.
 
+Note on the disagg axis (ISSUE 16): the `disagg=True` cells here are
+still *modeled* (the simulator folds the P/D split into its timing
+constants), but a disagg cell is now MEASURABLE end-to-end — the slice
+topology plane (`dynamo_tpu/fleet/topology.py`) runs a real
+heterogeneous prefill/decode pair with different meshes and
+byte-identical output (`dynamo_tpu/bench/disagg_topology.py`, gated in
+`bench_gate --smoke`).  Wiring that measured cell into this sweep
+(replacing the modeled constants for `disagg=True`) is the remaining
+depth carried on ROADMAP item 4.
+
 Validation rides the observability plane: `run_fleet` drives N real
 `MockEngine` workers (each with its own `/metrics` + `/debug/slo`
 status server registered under `status_endpoints/`) under generated

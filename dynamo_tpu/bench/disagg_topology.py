@@ -1,0 +1,191 @@
+"""Heterogeneous disagg-cell benchmark: the slice topology plane e2e.
+
+ISSUE 16 tentpole evidence, bench edition: a ring-SP int8 PREFILL slice
+(sp2xtp2) feeds a head-sharded int8 DECODE slice (tp2) through the
+device transfer plane — two differently-sharded meshes in one disagg
+cell.  The wire block crosses in the SOURCE layout and lands directly on
+the decode engine's `block_inject_sharding` (the generalized cross-mesh
+reshard), so no canonical gather ever pins a chip.
+
+Reported (the `disagg_topology` BENCH section):
+
+  token_parity     — greedy output byte-identical to a MESHLESS oracle
+                     running the same kv mode (the composition is
+                     lossless, not just "plausible");
+  remote_prefills / device_pulls / reshard_pulls / onboarded_blocks —
+                     the KV provably moved device-direct AND landed
+                     sharded on the decode mesh (counters, not logs);
+  prefill_slice / decode_slice — the `SliceSpec.describe()` strings the
+                     workers would publish for these cells;
+  placement_guard_refuses_mesh_blind — `validate_placement` refusing a
+                     fabricated mesh-blind planner decision (decode role
+                     deployed onto the prefill-only slice): a topology
+                     plane that can't veto a bad placement isn't one.
+
+CPU rig: 8 virtual devices, local device fabric; wall times are not
+gated — parity + counters + the placement veto are (`bench_gate
+--smoke`).
+
+    python -m dynamo_tpu.bench.disagg_topology     # tiny CPU run, JSON
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict
+
+PREFILL_SLICE = "sp2xtp2,int8,role=prefill"
+DECODE_SLICE = "tp2,int8,role=decode"
+BLOCK_SIZE = 8
+
+
+def _build_engine(mesh_cfg, mesh_kwargs):
+    import jax
+
+    from dynamo_tpu.engine.engine import (
+        EngineConfig, EngineCore, InferenceEngine)
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models import config as mcfg
+    from dynamo_tpu.parallel import make_mesh
+
+    mesh = None
+    if mesh_cfg is not None:
+        mesh = make_mesh(mesh_cfg, jax.devices()[:mesh_cfg.size])
+    core = EngineCore(EngineConfig(
+        model=mcfg.get_config("tiny-test"), num_blocks=64, mesh=mesh,
+        kv_quant="int8",
+        scheduler=SchedulerConfig(
+            max_seqs=4, block_size=BLOCK_SIZE, max_pages_per_seq=8,
+            max_prefill_chunk=16, decode_buckets=(2, 4),
+            prefill_buckets=(8, 16)),
+        **mesh_kwargs))
+    return InferenceEngine(core)
+
+
+async def _collect(client, rid, prompt, n=4):
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+
+    req = PreprocessedRequest(request_id=rid, model="m",
+                              token_ids=list(prompt),
+                              sampling=SamplingParams(max_tokens=n))
+    out = []
+    async for d in client.generate(req):
+        out.extend(d.token_ids)
+        if d.finished:
+            break
+    return out
+
+
+async def run_disagg_topology() -> Dict:
+    """Serve one long prompt through the heterogeneous cell and the
+    meshless oracle; returns the `disagg_topology` BENCH section."""
+    from dynamo_tpu.fleet.topology import parse_slice, validate_placement
+    from dynamo_tpu.llm.block_manager.device_transfer import (
+        KV_OFFER_ENDPOINT, KV_PULLED_ENDPOINT, KvTransferPlane)
+    from dynamo_tpu.llm.block_manager.transfer import (
+        KV_BLOCKS_ENDPOINT, make_kv_blocks_handler)
+    from dynamo_tpu.llm.disagg import (
+        DisaggDecodeClient, disagg_config_key, prefill_worker_loop)
+    from dynamo_tpu.llm.service import LocalEngineClient
+    from dynamo_tpu.parallel import MeshConfig
+    from dynamo_tpu.runtime.control_plane import InProcessControlPlane
+    from dynamo_tpu.runtime.rpc import RpcServer
+
+    NS = "bench-topology"
+    p_spec = parse_slice(PREFILL_SLICE)
+    d_spec = parse_slice(DECODE_SLICE)
+
+    class _Worker:
+        async def start(self, mesh_cfg, mesh_kwargs):
+            self.engine = _build_engine(mesh_cfg, mesh_kwargs)
+            await self.engine.start()
+            self.client = LocalEngineClient(self.engine)
+            self.plane = KvTransferPlane(self.engine)
+            self.plane.start()
+            self.rpc = RpcServer()
+            self.rpc.register(KV_BLOCKS_ENDPOINT,
+                              make_kv_blocks_handler(self.engine))
+            self.rpc.register(KV_OFFER_ENDPOINT,
+                              self.plane.make_offer_handler())
+            self.rpc.register(KV_PULLED_ENDPOINT,
+                              self.plane.make_pulled_handler())
+            self.address = await self.rpc.start()
+            return self
+
+        async def stop(self):
+            await self.rpc.stop()
+            self.plane.stop()
+            await self.engine.stop()
+
+    cp = InProcessControlPlane()
+    await cp.start()
+    await cp.put(disagg_config_key(NS), {"max_local_prefill_length": 12})
+
+    prefill = await _Worker().start(MeshConfig(sp=2, tp=2),
+                                    dict(sp_prefill_threshold=8))
+    decode = await _Worker().start(MeshConfig(tp=2), {})
+    ploop = asyncio.create_task(prefill_worker_loop(
+        cp, NS, prefill.client, prefill.address))
+    dec = DisaggDecodeClient(decode.client, decode.engine, cp, NS,
+                             BLOCK_SIZE, transfer_plane=decode.plane)
+    await dec.start()
+    try:
+        oracle = _build_engine(None, {})
+        await oracle.start()
+        prompt = list(range(1, 28))   # 3 sealed blocks + tail
+        want = await _collect(LocalEngineClient(oracle), "ref", prompt)
+        await oracle.stop()
+
+        got = await _collect(dec, "r1", prompt)
+        mgr = decode.engine.core.allocator.manager
+        out = {
+            "prefill_slice": p_spec.describe(),
+            "decode_slice": d_spec.describe(),
+            "kv_quant": "int8",
+            "token_parity": got == want,
+            "remote_prefills": dec.remote_prefills,
+            "local_fallbacks": dec.local_fallbacks,
+            "device_pulls": dec.device_pulls,
+            "tokens_onboarded": dec.tokens_onboarded,
+            "reshard_pulls": decode.plane.reshard_pulls,
+            "pulled_blocks": decode.plane.pulled_blocks,
+            "onboarded_blocks": mgr.onboarded_blocks,
+        }
+    finally:
+        ploop.cancel()
+        await dec.stop()
+        await prefill.stop()
+        await decode.stop()
+        await cp.close()
+
+    # Fabricated mesh-blind planner decision: deploy the DECODE role
+    # onto the prefill-only sp slice.  The topology guard must refuse —
+    # and the matching placement must pass — or the veto has no teeth.
+    blind_ok, blind_reason = validate_placement("decode", p_spec)
+    match_ok, _ = validate_placement("prefill", p_spec)
+    out["placement_guard_refuses_mesh_blind"] = (not blind_ok
+                                                 and bool(blind_reason)
+                                                 and match_ok)
+    return out
+
+
+def main() -> int:
+    import json
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if ("xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+    out = asyncio.run(asyncio.wait_for(run_disagg_topology(), 300))
+    print(json.dumps(out, indent=2))
+    ok = (out["token_parity"] and out["reshard_pulls"] > 0
+          and out["placement_guard_refuses_mesh_blind"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -611,7 +611,7 @@ class EngineCore:
                                      self.cache_cfg.quantized))
             else:
                 self._extract_jit, self._inject_jit = kvc.make_block_ops(
-                    self.block_size)
+                    self.block_size, constrain_mesh=self.mesh)
             self.allocator = ManagedBlockSource(
                 TieredConfig(
                     device_blocks=config.num_blocks,
@@ -2313,21 +2313,29 @@ class EngineCore:
         return out
 
     @engine_thread_only
-    def export_blocks_device(self, hashes) -> Dict[int, object]:
+    def export_blocks_device(self, hashes, canonical: bool = True
+                             ) -> Dict[int, object]:
         """G1-resident blocks as DEVICE arrays (the device-direct transfer
         plane's extract side; no host staging).  Engine thread only.
 
-        Sharded caches (tp/dp mesh): the extracted block gathers onto
-        device 0 over ICI — the canonical [2, L, bs, F] block format is
-        sharding-independent, so a prefill tp=x → decode tp=y handoff is
-        a gather here + scatter at the peer's inject (the XLA-collective
-        answer to the reference's `block_copy.cu:41` layout transpose;
-        `disagg_serving.md:96-99`)."""
+        Sharded caches (tp/dp/sp mesh), `canonical=True`: the extracted
+        block gathers onto device 0 over ICI — the pjrt transport moves
+        single-device buffers, and the canonical [2, L, bs, F] block
+        format is sharding-independent, so a prefill tp=x → decode tp=y
+        handoff is a gather here + scatter at the peer's inject (the
+        XLA-collective answer to the reference's `block_copy.cu:41`
+        layout transpose; `disagg_serving.md:96-99`).
+
+        `canonical=False` (ISSUE 16, the local device fabric): skip the
+        gather and hand the block out in whatever sharding the extract
+        produced — the puller's ONE device_put reshards source layout →
+        dest layout directly (arbitrary PartitionSpec pairs), and no
+        device ever holds the whole block."""
         out: Dict[int, object] = {}
         if not self._managed_cache:
             return out
         single = None
-        if self.mesh is not None:
+        if self.mesh is not None and canonical:
             from jax.sharding import SingleDeviceSharding
 
             single = SingleDeviceSharding(jax.devices()[0])
@@ -2347,12 +2355,31 @@ class EngineCore:
         (pre-fix every pull committed to jax.devices()[0], which under a
         mesh double-copied on inject and piled every block onto one
         chip).  Meshless: the cache's own device (host metadata read —
-        safe off-thread); mesh: replicated over the mesh, the layout the
-        sharded inject scatters from."""
+        safe off-thread).  Single-process mesh: the wire block sharded
+        the way the CACHE shards (kv_cache.wire_block_pspec) — the
+        generalized cross-mesh landing, so a pull from ANY source layout
+        reshards straight into this engine's layout with no replication
+        hop.  pp / multihost meshes keep the replicated layout their
+        dedicated block ops scatter from."""
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            return NamedSharding(self.mesh, PartitionSpec())
+            if self._mh or self._pp:
+                return NamedSharding(self.mesh, PartitionSpec())
+            sh = self.__dict__.get("_wire_inject_sharding")
+            if sh is None:
+                from dynamo_tpu.parallel.sharding import cache_pspecs
+
+                spec = kvc.wire_block_pspec(
+                    self.mesh,
+                    cache_pspecs(self.config.model.num_layers,
+                                 dp_attention=self.config.dp_attention,
+                                 dp_local=self._dp_local,
+                                 kv_quant=self.cache_cfg.quantized),
+                    self.cache_cfg.block_wire_shape)
+                sh = NamedSharding(self.mesh, spec)
+                self.__dict__["_wire_inject_sharding"] = sh
+            return sh
         leaves = jax.tree.leaves(self.cache)
         if leaves:
             return leaves[0].sharding
@@ -2441,10 +2468,11 @@ class EngineCore:
         self._validate_block(data)
         if (self.mesh is not None and isinstance(data, jax.Array)
                 and not self._mh):
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            data = jax.device_put(
-                data, NamedSharding(self.mesh, PartitionSpec()))
+            # A no-op when the transfer plane already landed the block
+            # on block_inject_sharding; a real relayout (the cross-mesh
+            # scatter half) for anything else — replicated legacy pulls,
+            # host-staged arrays committed to one device.
+            data = jax.device_put(data, self.block_inject_sharding)
         self.cache = self._inject_jit(self.cache, np.int32(page),
                                       self._dev(data))
 
@@ -2732,9 +2760,12 @@ class InferenceEngine:
             lambda: self.core.resident_prefix_blocks(hashes))
 
     @never_engine_thread
-    async def export_blocks_device(self, hashes) -> Dict[int, object]:
+    async def export_blocks_device(self, hashes,
+                                   canonical: bool = True
+                                   ) -> Dict[int, object]:
         return await self.run_in_engine(
-            lambda: self.core.export_blocks_device(hashes))
+            lambda: self.core.export_blocks_device(hashes,
+                                                   canonical=canonical))
 
     @property
     def metrics(self) -> ForwardPassMetrics:

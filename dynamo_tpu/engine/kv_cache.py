@@ -361,7 +361,52 @@ def gather_kv_quant(
     return k, v
 
 
-def make_block_ops(block_size: int, mesh=None, cache_specs=None):
+def wire_block_pspec(mesh, cache_specs, wire_shape):
+    """PartitionSpec for the canonical wire block [2, L, bs, F*] that
+    mirrors how THIS cache shards its pages: the cache K-leaf spec
+    [slots, features] maps axis-for-axis onto the wire block's
+    (block_size, features) trailing dims.
+
+    This is the generalized cross-mesh reshard's landing layout (ISSUE
+    16): a pulled block device_put directly onto this sharding scatters
+    straight into the cache's own layout — head-sharded tp lands
+    head-sharded, dp_local slot-sharded lands slot-sharded — so an
+    sp-prefill worker's KV arrives on a tp+int8 decode worker with ONE
+    puller-side device_put and zero device-0 pileup, for ARBITRARY
+    source→dest PartitionSpec pairs (the source's layout never appears
+    here; device_put reshards whatever arrives).
+
+    Falls back to fully replicated P() when a sharded axis would not
+    divide the wire shape (jax refuses non-divisible NamedShardings) —
+    replicated is always a correct landing, just not a balanced one.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        spec = cache_specs["k"][0]
+    except (KeyError, IndexError, TypeError):
+        return P()
+    slot_ax = spec[0] if len(spec) > 0 else None
+    feat_ax = spec[1] if len(spec) > 1 else None
+
+    def shards(ax) -> int:
+        names = ax if isinstance(ax, tuple) else (ax,) if ax else ()
+        n = 1
+        for nm in names:
+            n *= dict(mesh.shape).get(nm, 1)
+        return n
+
+    bs, fw = int(wire_shape[2]), int(wire_shape[3])
+    # Packed int8 note: F* = Hkv*(D+4) and tp | Hkv, so the feature
+    # split stays divisible even with scales in-band; the guard is for
+    # tiny test geometries where it is not.
+    if bs % shards(slot_ax) or fw % shards(feat_ax):
+        return P()
+    return P(None, None, slot_ax, feat_ax)
+
+
+def make_block_ops(block_size: int, mesh=None, cache_specs=None,
+                   constrain_mesh=None):
     """Jitted whole-block extract/inject against the cache pytree.
 
     These are the device ends of every tier/wire movement — G1→G2 offload,
@@ -387,6 +432,15 @@ def make_block_ops(block_size: int, mesh=None, cache_specs=None):
     eager streaming) as ONE array, so no path can ship one without the
     other.  Inject unpacks and bitcasts back.  The branch is static: the
     cache pytree's structure selects it at trace time.
+
+    `constrain_mesh` (single-process mesh engines): the quantized pack's
+    concatenate — int8 rows sharded on the feature axis joined with
+    bitcast scale bytes — is mis-partitioned by GSPMD on meshes that
+    carry a replicated axis alongside the sharded one (sp×tp: every
+    byte comes back doubled, a partial-sum over the sp replicas).  An
+    explicit replicated constraint on the packed result forces a real
+    all-gather instead, so the wire block is byte-correct on every
+    mesh.  bf16 extracts are unaffected and stay unconstrained.
     """
 
     def _slice_layers(layers, start):
@@ -403,6 +457,12 @@ def make_block_ops(block_size: int, mesh=None, cache_specs=None):
 
         ks = _slice_layers(cache["k_scale"], start)  # [L, bs, Hkv] f32
         vs = _slice_layers(cache["v_scale"], start)
+        if constrain_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(constrain_mesh, PartitionSpec())
+            k, v, ks, vs = (jax.lax.with_sharding_constraint(x, rep)
+                            for x in (k, v, ks, vs))
 
         def pack(q, s):
             # f32 [L, bs, Hkv] -> int8 [L, bs, Hkv, 4] -> [L, bs, 4*Hkv]

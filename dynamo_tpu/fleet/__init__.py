@@ -1,0 +1,14 @@
+"""Fleet-level topology model (ISSUE 16): SliceSpec and the placement
+reads the router/planner consult.  Kept jax-free so control-plane
+processes (frontend, planner, dynamo top) import it without a device
+runtime."""
+
+from dynamo_tpu.fleet.topology import (  # noqa: F401
+    SliceSpec,
+    donor_preference_key,
+    free_hbm_bytes,
+    parse_slice,
+    place_role,
+    stable_id_key,
+    validate_placement,
+)

@@ -184,6 +184,10 @@ class _PjrtTransport:
     """Cross-host device fabric over jax.experimental.transfer."""
 
     kind = "pjrt"
+    # The transfer service moves single-device buffers: holders gather
+    # to the canonical device-0 block before staging, pullers land on
+    # one device and reshard with a second device_put.
+    direct_multi_device = False
 
     def __init__(self) -> None:
         self._server = _get_transfer_server()
@@ -241,6 +245,12 @@ class _LocalTransport:
     offer probe (can_serve) and use the host-staged plane."""
 
     kind = "local"
+    # device_put reshards arbitrary source→dest shardings in one hop
+    # (ISSUE 16): holders stage blocks in the source mesh's own layout
+    # (no device-0 gather) and pullers land straight on
+    # block_inject_sharding — the generalized cross-mesh reshard, with
+    # no chip ever holding a whole block.
+    direct_multi_device = True
 
     def __init__(self) -> None:
         self.address = f"local:{os.getpid()}"
@@ -300,6 +310,11 @@ class KvTransferPlane:
         self.refused_offers = 0
         self.expired_offers = 0
         self.pulled_blocks = 0
+        # Cross-mesh landings: pulls whose target sharding spanned >1
+        # device, i.e. the block was resharded source→dest layout on
+        # the wire (the bench gate's disagg_topology section pins this
+        # alongside the device plane counter).
+        self.reshard_pulls = 0
         self.last_refusal: Optional[str] = None
 
     def start(self) -> str:
@@ -411,7 +426,15 @@ class KvTransferPlane:
             self.refused_offers += 1
             self.last_refusal = "transport"
             return None
-        blocks = await self.engine.export_blocks_device(hashes)
+        # pjrt moves single-device buffers → canonical device-0 gather;
+        # the local fabric reshards arbitrarily → export in the source
+        # mesh's own layout and skip the gather entirely.  (TypeError:
+        # test stubs predating the flag — canonical is their only mode.)
+        try:
+            blocks = await self.engine.export_blocks_device(
+                hashes, canonical=not self._transport.direct_multi_device)
+        except TypeError:
+            blocks = await self.engine.export_blocks_device(hashes)
         return self.stage(blocks, hashes, peer_fabric=peer_fabric)
 
     def make_offer_handler(self):
@@ -469,9 +492,13 @@ class KvTransferPlane:
     @never_engine_thread
     async def pull(self, meta: dict) -> Dict[int, object]:
         """Pull the staged arrays device-to-device; returns hash → array
-        committed to the engine's inject sharding.  Multi-device targets
-        (mesh engines) land on one device and reshard via device_put on
-        the puller — the generalized cross-TP reshard; the host never
+        committed to the engine's inject sharding
+        (`block_inject_sharding`: the wire block laid out the way THIS
+        cache shards — the generalized cross-mesh reshard target).  On
+        the local fabric the landing device_put reshards any source
+        layout to the target in one hop; pjrt delivers single-device
+        buffers, so multi-device targets land on one device first and
+        reshard with a second device_put.  Either way the host never
         touches the bytes."""
         import jax
 
@@ -486,9 +513,10 @@ class KvTransferPlane:
         target = self._target_sharding()
         reshard = None
         land = target
-        if len(target.device_set) > 1:
-            # Transports deliver to one device; the mesh layout is a
-            # puller-side device_put after landing.
+        if (len(target.device_set) > 1
+                and not self._transport.direct_multi_device):
+            # This transport delivers to one device; the mesh layout is
+            # a puller-side device_put after landing.
             land = jax.sharding.SingleDeviceSharding(
                 min(target.device_set, key=lambda d: d.id))
             reshard = target
@@ -502,6 +530,8 @@ class KvTransferPlane:
         if reshard is not None:
             arrays = await asyncio.to_thread(
                 lambda: list(jax.device_put(list(arrays), reshard)))
+        if len(target.device_set) > 1:
+            self.reshard_pulls += len(arrays)
         self.pulled_blocks += len(arrays)
         return dict(zip(meta["hashes"], arrays))
 

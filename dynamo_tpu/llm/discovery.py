@@ -318,7 +318,9 @@ async def register_llm(
 ) -> None:
     """Publish the model entry bound to this instance's lease: when the
     worker dies, the entry dies with it (reference ModelEntry under
-    MODEL_ROOT_PATH + lease semantics)."""
+    MODEL_ROOT_PATH + lease semantics).  The instance record's published
+    SliceSpec (ISSUE 16, `fleet.topology`) rides along so frontends see
+    a worker's mesh/role/HBM without a second lookup."""
     entry = {
         "card": card.to_dict(),
         "namespace": endpoint.namespace,
@@ -326,6 +328,9 @@ async def register_llm(
         "endpoint": endpoint.name,
         "instance_id": instance.instance_id,
     }
+    slice_spec = (instance.metadata or {}).get("slice")
+    if slice_spec is not None:
+        entry["slice"] = slice_spec
 
     async def _put():
         # Bound to the endpoint's CURRENT lease so a control-plane

@@ -242,6 +242,29 @@ class KvRoutedEngineClient:
         healthy = [w for w in live if w not in self._penalty]
         return healthy or live  # all penalised → try anyway
 
+    @staticmethod
+    def _request_priority(request) -> Optional[int]:
+        """QoS class from the request's annotations (the http frontend's
+        `x-dynamo-priority` header lands there) — the selector biases
+        interactive traffic away from deep queues."""
+        from dynamo_tpu.llm.service import PRIORITY_ANNOTATION, priority_of
+
+        if PRIORITY_ANNOTATION not in getattr(request, "annotations", {}):
+            return None  # unannotated: keep the topology-blind cost
+        return priority_of(request)
+
+    def _worker_slices(self) -> dict:
+        """Published SliceSpec per live instance (instance-record
+        metadata, `fleet.topology`): the selector's HBM-capacity
+        weighting and the donor pick's fabric-reachability read.
+        Workers predating the topology plane map to None."""
+        from dynamo_tpu.fleet.topology import SliceSpec
+
+        return {
+            i.instance_id: SliceSpec.from_dict(i.metadata.get("slice"))
+            for i in self.client.instances()
+        }
+
     async def embed(self, token_lists):
         from dynamo_tpu.llm.discovery import RemoteEngineClient
 
@@ -265,7 +288,9 @@ class KvRoutedEngineClient:
             worker_id, overlap = self.router.find_best_match(
                 request.request_id, request.token_ids, workers,
                 expected_output_tokens=request.sampling.max_tokens,
-                metrics=self._metrics.fresh())
+                metrics=self._metrics.fresh(),
+                priority=self._request_priority(request),
+                slices=self._worker_slices())
         except BaseException as e:
             # No candidates / selector failure: the span must still end,
             # or an empty fleet leaks one open span per rejected request.

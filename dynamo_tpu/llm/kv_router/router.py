@@ -123,6 +123,8 @@ class KvRouter:
         update_states: bool = True,
         expected_output_tokens: int = 0,
         metrics: Optional[Dict[WorkerId, object]] = None,
+        priority: Optional[int] = None,
+        slices: Optional[Dict[WorkerId, object]] = None,
     ) -> Tuple[WorkerId, int]:
         """Choose a worker for the request; returns (worker, overlap_blocks).
 
@@ -131,6 +133,13 @@ class KvRouter:
         later `free(request_id)`).  `expected_output_tokens` (e.g. the
         request's max_tokens) pre-reserves decode-growth blocks in that
         accounting so the selector sees future occupancy.
+
+        `priority` (llm.service.priority_of) enables the QoS bias:
+        interactive requests avoid over-threshold queues.  `slices` maps
+        worker id → published SliceSpec (instance-record metadata) so the
+        selector weighs per-slice HBM capacity and the donor pick prefers
+        device-fabric-reachable peers; both default to the topology-blind
+        behavior for fleets that publish nothing.
         """
         if not workers:
             raise ValueError("no live workers to route to")
@@ -154,10 +163,17 @@ class KvRouter:
                 decode_blocks=decode_blocks.get(w, 0),
                 prefill_blocks=(prefill_tokens.get(w, 0) + bs - 1) // bs,
                 metrics=(metrics or {}).get(w),
+                slice=(slices or {}).get(w),
             )
             for w in workers
         ]
-        chosen = self.selector.select(candidates, request_blocks)
+        try:
+            chosen = self.selector.select(candidates, request_blocks,
+                                          priority=priority)
+        except TypeError:
+            # Custom selectors predating the QoS surface keep working;
+            # they just route priority-blind.
+            chosen = self.selector.select(candidates, request_blocks)
 
         # Fleet prefix reuse: offer the deepest-overlap LIVE peer as a
         # donor when it beats the chosen worker's own prefix coverage.
@@ -170,7 +186,8 @@ class KvRouter:
                 live_scores, chosen.worker_id, chosen.overlap_blocks,
                 request_blocks,
                 min_donor_frac=self.config.remote_prefix_min_frac,
-                min_gain_blocks=self.config.remote_prefix_min_gain_blocks)
+                min_gain_blocks=self.config.remote_prefix_min_gain_blocks,
+                slices=slices, metrics=metrics)
 
         if update_states:
             self.active.add_request(

@@ -28,9 +28,34 @@ import random
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
+from dynamo_tpu.fleet.topology import (
+    SliceSpec,
+    donor_preference_key,
+    free_hbm_bytes,
+)
 from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, WorkerId
 
 logger = logging.getLogger(__name__)
+
+# QoS routing bias (ISSUE 16 satellite): interactive-class requests
+# (priority >= INTERACTIVE_PRIORITY, see llm.service.PRIORITY_CLASSES)
+# avoid workers whose published waiting queue exceeds the threshold —
+# a deep queue is head-of-line latency an interactive request must not
+# eat for a few blocks of prefix overlap.  Best-effort and standard
+# traffic keeps the plain cost (it FILLS the busy workers interactive
+# traffic vacates).  When EVERY candidate is over the threshold the
+# bias cancels out by construction — the degenerate all-busy fleet
+# routes exactly as before rather than herding onto an arbitrary pick.
+INTERACTIVE_PRIORITY = 2
+QUEUE_DEPTH_THRESHOLD = 4
+BUSY_QUEUE_PENALTY = 1024.0
+
+# Slice-capacity weighting: decode load is normalized by the slice's
+# total HBM relative to the largest candidate slice — 10 busy blocks on
+# a v5e-1 decode cell mean more pressure than 10 on a v5p-16.  Clamped
+# so a tiny or absurd published HBM figure cannot dominate the cost.
+HBM_FACTOR_MIN = 0.25
+HBM_FACTOR_MAX = 4.0
 
 
 @dataclass(frozen=True)
@@ -69,6 +94,8 @@ def pick_donor(
     *,
     min_donor_frac: float = 0.5,
     min_gain_blocks: int = 2,
+    slices: Optional[Dict[WorkerId, Optional[SliceSpec]]] = None,
+    metrics: Optional[Dict[WorkerId, object]] = None,
 ) -> Optional[RemotePrefixHint]:
     """The remote-prefix donor decision: when the chosen worker's local
     overlap is poor but a peer's is deep, pulling the peer's sealed
@@ -77,31 +104,39 @@ def pick_donor(
     A peer qualifies as donor when it covers at least `min_donor_frac`
     of the request's blocks AND beats the chosen worker's own overlap by
     at least `min_gain_blocks` (a 1-block gain isn't worth a pull RPC).
-    Deepest overlap wins; EQUAL overlaps tie-break deterministically on
-    worker id (ascending) so replica routers agree on the donor and
-    tests are reproducible.  `scores` must already be restricted to
-    LIVE workers — `KvIndexer.remove_worker` purges departed workers
-    from the index, so hints never point at dead donors."""
+    Among qualifiers the preference is topology-aware
+    (`fleet.topology.donor_preference_key`): a donor the CHOSEN worker
+    can reach over the device fabric beats any host-wire-only one, then
+    deepest coverage, then most free HBM (from the donor's published
+    SliceSpec × its last metrics — an evicting donor may drop the
+    blocks mid-pull), and exact ties break on the STABLE id key.  The
+    old inline tie-break compared ids with a type-tagged tuple that
+    ordered every int lease id before every string instance id, so a
+    mixed fleet's replica routers could disagree on equal-overlap
+    donors; `fleet.topology.stable_id_key` is now the one total order.
+    `scores` must already be restricted to LIVE workers —
+    `KvIndexer.remove_worker` purges departed workers from the index,
+    so hints never point at dead donors."""
     if request_blocks <= 0:
         return None
-
-    def id_key(w):
-        # Numeric ids compare numerically (lease ids are ints — worker 2
-        # must beat worker 10), everything else lexically; the type tag
-        # keeps mixed fleets deterministic.
-        return (0, w, "") if isinstance(w, int) else (1, 0, str(w))
-
     floor = max(1, math.ceil(min_donor_frac * request_blocks))
+    puller_spec = (slices or {}).get(chosen)
     best: Optional[RemotePrefixHint] = None
+    best_key = None
     for w, ov in scores.items():
         if w == chosen:
             continue
         if ov < floor or ov - chosen_overlap < min_gain_blocks:
             continue
-        if (best is None or ov > best.overlap_blocks
-                or (ov == best.overlap_blocks
-                    and id_key(w) < id_key(best.worker_id))):
+        spec = (slices or {}).get(w)
+        key = donor_preference_key(
+            w, ov,
+            reachable=bool(puller_spec is not None and spec is not None
+                           and puller_spec.reachable(spec)),
+            free_hbm=free_hbm_bytes(spec, (metrics or {}).get(w)))
+        if best_key is None or key > best_key:
             best = RemotePrefixHint(worker_id=w, overlap_blocks=ov)
+            best_key = key
     return best
 
 
@@ -115,6 +150,10 @@ class WorkerLoadSnapshot:
     decode_blocks: int = 0
     prefill_blocks: int = 0  # outstanding prefill work already routed there
     metrics: Optional[ForwardPassMetrics] = None
+    # Published slice topology (instance-record metadata), None for
+    # workers predating the topology plane — every read degrades to the
+    # topology-blind cost.
+    slice: Optional[SliceSpec] = None
 
 
 def softmax_sample(
@@ -157,20 +196,42 @@ class DefaultWorkerSelector:
         waiting_request_weight: float = 8.0,
         rng: Optional[random.Random] = None,
         on_hit_rate_event: Optional[Callable[[KVHitRateEvent], None]] = None,
+        queue_depth_threshold: int = QUEUE_DEPTH_THRESHOLD,
+        busy_queue_penalty: float = BUSY_QUEUE_PENALTY,
     ) -> None:
         self.overlap_score_weight = overlap_score_weight
         self.temperature = temperature
         self.waiting_request_weight = waiting_request_weight
         self.rng = rng or random.Random()
         self.on_hit_rate_event = on_hit_rate_event
+        self.queue_depth_threshold = queue_depth_threshold
+        self.busy_queue_penalty = busy_queue_penalty
 
     def select(
         self,
         candidates: Sequence[WorkerLoadSnapshot],
         request_blocks: int,
+        priority: Optional[int] = None,
     ) -> WorkerLoadSnapshot:
         if not candidates:
             raise ValueError("no candidate workers")
+        # Slice-capacity reference: the biggest candidate slice with a
+        # published HBM figure normalizes everyone else's decode load.
+        ref_hbm = max((c.slice.total_hbm_bytes for c in candidates
+                       if c.slice is not None
+                       and c.slice.total_hbm_bytes > 0), default=0)
+        waiting_by_id: Dict[WorkerId, int] = {
+            c.worker_id: (c.metrics.worker_stats.num_requests_waiting
+                          if c.metrics is not None else 0)
+            for c in candidates
+        }
+        # QoS: the interactive bias only applies when SOME candidate is
+        # under the queue threshold — an all-busy fleet must route
+        # unbiased (degenerate case), not herd on a random worker.
+        bias_busy = (priority is not None
+                     and priority >= INTERACTIVE_PRIORITY
+                     and any(w <= self.queue_depth_threshold
+                             for w in waiting_by_id.values()))
         costs: Dict[WorkerId, float] = {}
         by_id: Dict[WorkerId, WorkerLoadSnapshot] = {}
         for c in candidates:
@@ -183,16 +244,23 @@ class DefaultWorkerSelector:
             # this router never saw (other frontends, engine-internal
             # state) — r2 published these metrics and routed on neither.
             decode_load = c.decode_blocks
-            waiting = 0
+            waiting = waiting_by_id[c.worker_id]
             if c.metrics is not None:
                 decode_load = max(decode_load,
                                   c.metrics.kv_stats.kv_active_blocks)
-                waiting = c.metrics.worker_stats.num_requests_waiting
-            costs[c.worker_id] = (
+            if ref_hbm and c.slice is not None \
+                    and c.slice.total_hbm_bytes > 0:
+                factor = ref_hbm / c.slice.total_hbm_bytes
+                decode_load *= min(HBM_FACTOR_MAX,
+                                   max(HBM_FACTOR_MIN, factor))
+            cost = (
                 self.overlap_score_weight * (potential_prefill + c.prefill_blocks)
                 + decode_load
                 + self.waiting_request_weight * waiting
             )
+            if bias_busy and waiting > self.queue_depth_threshold:
+                cost += self.busy_queue_penalty
+            costs[c.worker_id] = cost
             by_id[c.worker_id] = c
         chosen_id = softmax_sample(costs, self.temperature, self.rng)
         chosen = by_id[chosen_id]

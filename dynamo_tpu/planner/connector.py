@@ -16,7 +16,7 @@ import signal
 import subprocess
 import sys
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -26,9 +26,20 @@ class LocalConnector:
                  worker_args: Optional[List[str]] = None,
                  env: Optional[dict] = None,
                  log_dir: str = "/tmp",
-                 drain_timeout_s: float = 45.0) -> None:
+                 drain_timeout_s: float = 45.0,
+                 role_worker_args: Optional[Dict[str, List[str]]] = None,
+                 ) -> None:
         """`worker_args`: extra argv after `--control-plane ADDR`
         (e.g. ["--mocker", "--model-name", "m"]).
+
+        `role_worker_args` (ISSUE 16, heterogeneous cells): role →
+        ADDITIONAL argv appended when `add_worker(role=...)` spawns that
+        role's slice — typically a `--slice` spec per role, e.g.
+        {"prefill": ["--slice", "sp2xtp2,int8,role=prefill"],
+         "decode":  ["--slice", "tp2,int8,role=decode"]} — so the
+        planner deploys a big-prefill/small-decode cell from ONE
+        connector.  Spawned procs remember their role; `replicas(role=)`
+        and `remove_worker(role=)` filter on it.
 
         `drain_timeout_s`: scale-down budget — SIGTERM starts the
         worker's KV-migrating drain (worker/main.py `--drain on`); a
@@ -38,6 +49,8 @@ class LocalConnector:
         regression)."""
         self.control_plane_addr = control_plane_addr
         self.worker_args = list(worker_args or [])
+        self.role_worker_args = {
+            r: list(a) for r, a in (role_worker_args or {}).items()}
         self.env = dict(env if env is not None else os.environ)
         self.log_dir = log_dir
         self.drain_timeout_s = drain_timeout_s
@@ -53,9 +66,12 @@ class LocalConnector:
         self._procs_lock = threading.Lock()
         self._seq = 0
 
-    def replicas(self) -> int:
+    def replicas(self, role: Optional[str] = None) -> int:
         self._reap()
-        return len(self._procs)
+        if role is None:
+            return len(self._procs)
+        return sum(1 for p in self._procs
+                   if getattr(p, "_role", None) == role)
 
     @staticmethod
     def _close_log(proc) -> None:
@@ -73,11 +89,12 @@ class LocalConnector:
                     self._close_log(p)
             self._procs = live
 
-    async def add_worker(self) -> None:
+    async def add_worker(self, role: Optional[str] = None) -> None:
         self._seq += 1
         log_path = os.path.join(
             self.log_dir,
             f"dynamo_planner_worker_{os.getpid()}_{self._seq}.log")
+        extra = self.role_worker_args.get(role, []) if role else []
 
         def spawn():
             # Log-file open AND fork+exec both block (slow/network
@@ -92,29 +109,40 @@ class LocalConnector:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "dynamo_tpu.worker",
                  "--control-plane", self.control_plane_addr,
-                 *self.worker_args],
+                 *self.worker_args, *extra],
                 env=self.env, stdout=log, stderr=subprocess.STDOUT)
             proc._logfile = log  # type: ignore[attr-defined]
+            proc._role = role  # type: ignore[attr-defined]
             with self._procs_lock:
                 self._procs.append(proc)
             return proc
 
         proc = await asyncio.to_thread(spawn)
-        logger.info("connector: spawned worker pid %d", proc.pid)
+        logger.info("connector: spawned %s worker pid %d",
+                    role or "plain", proc.pid)
 
-    async def remove_worker(self) -> None:
+    async def remove_worker(self, role: Optional[str] = None) -> None:
         """Scale-down = drain, not drop: SIGTERM starts the worker's
         KV-migrating drain (it leaves routing instantly, hands each
         in-flight stream to a peer with its sealed KV, lingers for the
         peers' pulls, then exits).  This call WAITS for drain-complete —
         worker exit — up to `drain_timeout_s`; only then does the reaper
         escalate to SIGKILL, logging and counting the force-kill
-        distinctly from a clean drain."""
+        distinctly from a clean drain.
+
+        `role` drains the newest worker of THAT role (heterogeneous
+        cells must thin the pool the planner named, not whichever proc
+        spawned last); no such worker → no-op."""
         self._reap()
         with self._procs_lock:
-            if not self._procs:
+            proc = None
+            for i in range(len(self._procs) - 1, -1, -1):
+                if role is None or getattr(self._procs[i], "_role",
+                                           None) == role:
+                    proc = self._procs.pop(i)
+                    break
+            if proc is None:
                 return
-            proc = self._procs.pop()
         logger.info("connector: draining worker pid %d (budget %.1fs)",
                     proc.pid, self.drain_timeout_s)
         proc.send_signal(signal.SIGTERM)

@@ -10,8 +10,9 @@ import asyncio
 import logging
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
+from dynamo_tpu.fleet.topology import SliceSpec, validate_placement
 from dynamo_tpu.llm.kv_router.watcher import LoadMetricsWatcher
 from dynamo_tpu.planner.predictor import make_predictor
 
@@ -27,6 +28,12 @@ class PlannerConfig:
     adjustment_interval: float = 5.0
     metrics_stale_secs: float = 10.0
     predictor: str = "moving_average"
+    # Heterogeneous disagg cell (ISSUE 16): non-empty → every scale
+    # decision names one of these roles, each spawned with its own mesh
+    # (connector role_worker_args, e.g. a big sp-prefill slice and a
+    # small tp+int8-decode slice — the DistServe/Splitwise phase-fitted
+    # pool shape).  Empty = aggregated fleet, decisions role-less.
+    roles: Tuple[str, ...] = ()
     # SLO bias (runtime/slo.py): when a watched /debug/slo reports a
     # fast-window burn rate at or above this, scale up even though KV
     # usage looks fine — latency SLOs burn before memory fills (the
@@ -52,11 +59,17 @@ class LoadPlanner:
 
     def __init__(self, cp, connector,
                  config: Optional[PlannerConfig] = None,
-                 slo_url: Optional[str] = None) -> None:
+                 slo_url: Optional[str] = None,
+                 slices_fn: Optional[Callable[[], Dict]] = None) -> None:
         self.cp = cp
         self.connector = connector
         self.config = config or PlannerConfig()
         self.slo_url = slo_url
+        # Topology source: worker id → published SliceSpec (or its wire
+        # dict), usually wired to the runtime client's instance records.
+        # None = no topology view; role decisions fall back to replica
+        # counts alone.
+        self._slices_fn = slices_fn
         self._slo: Optional[dict] = None       # last /debug/slo payload
         self._slo_ts: float = 0.0              # when it was fetched
         self._watcher = LoadMetricsWatcher(
@@ -112,10 +125,100 @@ class LoadPlanner:
             return 0.0
         return max_burn(self._slo)
 
+    # -- topology reads (ISSUE 16) -----------------------------------------
+
+    def topology(self) -> Dict[object, Optional[SliceSpec]]:
+        """Published slice topology: worker id → SliceSpec (None for
+        workers that publish nothing).  Tolerant of a failing source —
+        the planner must keep scaling a fleet whose discovery hiccups."""
+        if self._slices_fn is None:
+            return {}
+        try:
+            raw = self._slices_fn() or {}
+        except Exception:
+            logger.exception("planner: topology source failed; planning "
+                             "topology-blind this step")
+            return {}
+        return {
+            w: (s if isinstance(s, SliceSpec) or s is None
+                else SliceSpec.from_dict(s))
+            for w, s in raw.items()
+        }
+
+    def placement_ok(self, role: str, worker_id=None,
+                     spec: Optional[SliceSpec] = None) -> Tuple[bool, str]:
+        """Is assigning `role` work to this worker topology-sane?  THE
+        planner's SliceSpec consult (fleet.topology.validate_placement):
+        a mesh-blind decision — decode role on a dedicated prefill
+        slice — is refused here, and the bench gate fabricates exactly
+        that decision to prove the consult happens."""
+        if spec is None and worker_id is not None:
+            spec = self.topology().get(worker_id)
+        return validate_placement(role, spec)
+
+    def _role_replicas(self, role: str) -> int:
+        try:
+            return self.connector.replicas(role=role)
+        except TypeError:
+            # Role-less connector: every replica counts for every role.
+            return self.connector.replicas()
+
+    def plan_role(self, decision: Optional[str]) -> Optional[str]:
+        """Which role a scale decision targets in heterogeneous-cell
+        mode (config.roles): scale-up fills the thinnest pool first
+        (declaration order breaks ties — list prefill first to absorb
+        ISL pressure); scale-down thins the fattest pool and NEVER
+        drops a role's last replica (a cell without a prefill slice
+        serves nothing).  None in aggregated mode."""
+        if not self.config.roles or decision is None:
+            return None
+        counts = {r: self._role_replicas(r) for r in self.config.roles}
+        if decision == "up":
+            order = {r: i for i, r in enumerate(self.config.roles)}
+            return min(self.config.roles,
+                       key=lambda r: (counts[r], order[r]))
+        victims = [r for r in self.config.roles if counts[r] > 1]
+        if not victims:
+            return None
+        return max(victims, key=lambda r: counts[r])
+
     def plan_step(self) -> Optional[str]:
         """One planning decision from current predictions; returns
         "up" | "down" | None.  Synchronous and side-effect-free on the
-        connector (unit-testable; the loop applies it)."""
+        connector (unit-testable; the loop applies it).
+
+        Heterogeneous-cell mode additionally consults the published
+        SliceSpecs: a "down" that would leave some role with no
+        placeable slice among the survivors is vetoed (plan_role names
+        the victim role; `topology()` + `fleet.topology.place_role`
+        check the survivors)."""
+        decision = self._plan_step_load()
+        if decision == "down" and self.config.roles:
+            role = self.plan_role("down")
+            if role is None:
+                return None  # every role at its floor
+            top = self.topology()
+            if top:
+                from dynamo_tpu.fleet.topology import place_role
+
+                survivors = dict(top)
+                # Drop ONE published slice of the victim role (the
+                # connector pops newest-first; any same-role member is
+                # equivalent for the coverage check).
+                for w, s in top.items():
+                    if s is not None and s.role == role:
+                        survivors.pop(w)
+                        break
+                for r in self.config.roles:
+                    if place_role(r, survivors) is None:
+                        logger.info(
+                            "planner: scale-down of a %s slice vetoed — "
+                            "no surviving slice could serve role %r",
+                            role, r)
+                        return None
+        return decision
+
+    def _plan_step_load(self) -> Optional[str]:
         draining = (self._drain_task is not None
                     and not self._drain_task.done())
         replicas = self.connector.replicas()
@@ -177,29 +280,54 @@ class LoadPlanner:
             try:
                 await self._fetch_slo()
                 decision = self.plan_step()
+                role = self.plan_role(decision)
                 if decision == "up":
                     self.decisions.append((time.monotonic(), "up",
-                                           self._reason()))
-                    logger.info("planner: scaling UP (%s)", self._reason())
-                    await self.connector.add_worker()
+                                           self._reason(role)))
+                    logger.info("planner: scaling UP (%s)",
+                                self._reason(role))
+                    await self._apply_add(role)
                 elif decision == "down":
                     self.decisions.append((time.monotonic(), "down",
-                                           self._reason()))
-                    logger.info("planner: scaling DOWN (%s)", self._reason())
+                                           self._reason(role)))
+                    logger.info("planner: scaling DOWN (%s)",
+                                self._reason(role))
                     # Background: remove_worker waits out the drain
                     # (plan_step holds further decisions off until it
                     # lands; scale-up pressure still gets polled).
                     self._drain_task = asyncio.create_task(
-                        self.connector.remove_worker())
+                        self._apply_remove(role))
             except asyncio.CancelledError:
                 raise
             except Exception:
                 logger.exception("planner: adjustment failed; continuing")
 
-    def _reason(self) -> str:
+    async def _apply_add(self, role: Optional[str]) -> None:
+        if role is None:
+            await self.connector.add_worker()
+            return
+        try:
+            await self.connector.add_worker(role=role)
+        except TypeError:
+            # Role-less connector under a roles config: spawn the plain
+            # worker rather than stall the fleet.
+            await self.connector.add_worker()
+
+    async def _apply_remove(self, role: Optional[str]) -> None:
+        if role is None:
+            await self.connector.remove_worker()
+            return
+        try:
+            await self.connector.remove_worker(role=role)
+        except TypeError:
+            await self.connector.remove_worker()
+
+    def _reason(self, role: Optional[str] = None) -> str:
         reason = (f"usage~{self._usage_pred.predict_next():.2f} "
                   f"waiting~{self._waiting_pred.predict_next():.1f} "
                   f"replicas={self.connector.replicas()}")
+        if role is not None:
+            reason += f" role={role}"
         burn = self.slo_pressure()
         if burn > 0:
             reason += f" slo_burn~{burn:.1f}"
@@ -218,6 +346,15 @@ def planner_metrics_text(planner, connector) -> str:
     except Exception:
         # dynamo-lint: disable=DL003 best-effort metrics text
         pass  # connector variant without replicas(): omit the series
+    # Heterogeneous-cell mode: per-role pool sizes (ISSUE 16).
+    for role in (getattr(getattr(planner, "config", None), "roles", ())
+                 or ()):
+        try:
+            lines.append('dynamo_planner_replicas{role="%s"} %d'
+                         % (role, connector.replicas(role=role)))
+        except Exception:
+            # dynamo-lint: disable=DL003 best-effort metrics text
+            pass  # role-less connector: omit the per-role series
     decisions = getattr(planner, "decisions", []) or []
     ups = sum(1 for d in decisions if len(d) > 1 and d[1] == "up")
     downs = sum(1 for d in decisions if len(d) > 1 and d[1] == "down")

@@ -26,7 +26,8 @@ STATUS_ENDPOINTS_PREFIX = "status_endpoints"
 
 
 async def register_status_endpoint(cp, component: str, port: int,
-                                   host: str = "127.0.0.1") -> str:
+                                   host: str = "127.0.0.1",
+                                   extra: Optional[dict] = None) -> str:
     """Advertise a status server for aggregator scraping; returns the
     key written.  Unleased on purpose: the aggregator treats unreachable
     targets as gone — and since ISSUE 14 the registration carries the
@@ -34,12 +35,19 @@ async def register_status_endpoint(cp, component: str, port: int,
     a kill -9'd worker's stale entry instead of rendering it
     unreachable forever.  `host` must be a cross-host-routable address
     when the aggregator runs on another machine (same rule as the
-    worker's --rpc-host)."""
+    worker's --rpc-host).
+
+    `extra`: additional registration fields (ISSUE 16: workers attach
+    their SliceSpec wire dict under "slice" so `dynamo top` can render
+    a MESH column without scraping anything new).  Reserved keys
+    (address/component/pid) cannot be overridden."""
     import os
 
     key = f"{STATUS_ENDPOINTS_PREFIX}/{component}/{os.getpid()}"
-    await cp.put(key, {"address": f"{host}:{port}", "component": component,
-                       "pid": os.getpid()})
+    entry = dict(extra or {})
+    entry.update({"address": f"{host}:{port}", "component": component,
+                  "pid": os.getpid()})
+    await cp.put(key, entry)
     return key
 
 
@@ -71,7 +79,8 @@ def registration_pid_dead(entry) -> bool:
 
 def register_status_endpoint_task(cp, component: str, port: int,
                                   host: str = "127.0.0.1",
-                                  retry_interval: float = 1.0):
+                                  retry_interval: float = 1.0,
+                                  extra: Optional[dict] = None):
     """Best-effort registration as a background task: retries until the
     put lands (the control-plane client reconnects underneath), so a
     control plane that is briefly down at process startup neither
@@ -83,7 +92,7 @@ def register_status_endpoint_task(cp, component: str, port: int,
         while True:
             try:
                 await register_status_endpoint(cp, component, port,
-                                               host=host)
+                                               host=host, extra=extra)
                 return
             except asyncio.CancelledError:
                 raise

@@ -78,6 +78,19 @@ def parse_args(argv=None):
                         "workers seal + announce blocks per chunk, so "
                         "smaller chunks mean finer-grained eager KV "
                         "streaming at the cost of more prefill steps")
+    # Declarative slice spec (ISSUE 16, fleet/topology.py): ONE string
+    # naming the worker's mesh, KV mode, role and plane features —
+    # expanded over the loose flags below after parsing, published in
+    # the instance record, and consumed by make_sharded_step via the
+    # same EngineConfig path.  The loose flags keep working; --slice is
+    # the form the planner's role_worker_args and deploy tooling emit.
+    p.add_argument("--slice", default=None, metavar="SPEC",
+                   help="declarative slice spec, e.g. "
+                        "'sp2xtp2,int8,packed,role=prefill' or "
+                        "'tp2,int8,role=decode' — mesh descriptor + kv "
+                        "mode + role + features (packed/spec/windowN/"
+                        "dp_attention); overrides the corresponding "
+                        "--tp/--sp/--pp/--kv-quant/--role flags")
     # Parallelism as a serving capability (reference: one-flag TP,
     # `components/backends/sglang/launch/disagg.sh:25`): degrees multiply
     # to the device count; the worker builds the mesh and the engine
@@ -210,10 +223,72 @@ def parse_args(argv=None):
          "metrics_interval": 1.0},
         section="worker"))
     args = p.parse_args(argv)
+    if args.slice:
+        try:
+            _apply_slice_spec(args)
+        except ValueError as e:
+            p.error(str(e))
     if not args.control_plane and args.process_id == 0:
         p.error("--control-plane is required (flag, DYN_CONTROL_PLANE, "
                 "or dynamo.toml)")
     return args
+
+
+def _apply_slice_spec(args) -> None:
+    """Expand `--slice` over the loose mesh/plane flags — the ONE
+    declarative source the engine config, the published instance record
+    and the planner's per-role spawn all agree on."""
+    from dynamo_tpu.fleet.topology import parse_slice
+
+    spec = parse_slice(args.slice)
+    args.dp, args.pp, args.sp, args.ep, args.tp = spec.mesh
+    args.role = spec.role
+    args.kv_quant = spec.kv_quant if spec.kv_quant != "none" else "none"
+    feats = set(spec.features)
+    if "packed_prefill" in feats:
+        args.packed_prefill = "on"
+    if "dp_attention" in feats:
+        args.dp_attention = True
+    if "spec" in feats and getattr(args, "spec_decode", 0) <= 0:
+        args.spec_decode = 3
+    for f in feats:
+        if f.startswith("window"):
+            args.decode_window = int(f[len("window"):])
+
+
+def derive_slice_spec(args, fabric: str = ""):
+    """The SliceSpec this worker PUBLISHES (instance record metadata +
+    status registration): mesh degrees, role, kv mode and plane features
+    from the resolved flags, per-chip HBM probed from the runtime (0
+    when the backend reports none — CPU rigs), and the device-fabric id
+    the transfer plane answers on."""
+    from dynamo_tpu.fleet.topology import SliceSpec
+
+    feats = []
+    if getattr(args, "packed_prefill", "auto") == "on":
+        feats.append("packed_prefill")
+    if getattr(args, "dp_attention", False):
+        feats.append("dp_attention")
+    if getattr(args, "spec_decode", 0) > 0:
+        feats.append("spec")
+    if getattr(args, "decode_window", 1) > 1:
+        feats.append(f"window{args.decode_window}")
+    hbm = 0
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        hbm = int(stats.get("bytes_limit", 0))
+    except Exception:
+        hbm = 0  # backend without memory_stats (CPU rig): unknown
+    return SliceSpec(
+        mesh=(args.dp, getattr(args, "pp", 1), getattr(args, "sp", 1),
+              args.ep, args.tp),
+        role=args.role,
+        kv_quant=getattr(args, "kv_quant", "none"),
+        features=tuple(feats),
+        hbm_per_chip_bytes=hbm,
+        fabric=fabric)
 
 
 def build_mesh(args):
@@ -481,20 +556,29 @@ async def run(args) -> None:
                 KV_OFFER_ENDPOINT, KV_PULLED_ENDPOINT, KvTransferPlane,
                 transfer_available)
 
+            # ALWAYS started (ISSUE 16): start() picks the pjrt
+            # transport when this jax build ships the transfer service
+            # and falls back to the same-process local fabric otherwise,
+            # so drain migration and prefix pulls ride the device plane
+            # even on rigs without jax.experimental.transfer —
+            # cross-process peers on the local fabric are refused at the
+            # offer probe and fall back to the host-staged plane per
+            # transfer, not per worker.
+            transfer_plane = KvTransferPlane(transfer_engine)
+            taddr = transfer_plane.start()
+            runtime.rpc.register(KV_OFFER_ENDPOINT,
+                                 transfer_plane.make_offer_handler())
+            runtime.rpc.register(KV_PULLED_ENDPOINT,
+                                 transfer_plane.make_pulled_handler())
             if transfer_available():
-                transfer_plane = KvTransferPlane(transfer_engine)
-                taddr = transfer_plane.start()
-                runtime.rpc.register(KV_OFFER_ENDPOINT,
-                                     transfer_plane.make_offer_handler())
-                runtime.rpc.register(KV_PULLED_ENDPOINT,
-                                     transfer_plane.make_pulled_handler())
-                logger.info("device transfer plane on %s", taddr)
+                logger.info("device transfer plane on %s (pjrt)", taddr)
             else:
-                logger.warning(
-                    "jax.experimental.transfer not in this jax build; "
-                    "device-direct KV transfer disabled for this worker "
-                    "— every bulk pull rides the host-staged plane "
-                    "(dynamo top PLANE column shows no device pulls)")
+                logger.info(
+                    "device transfer plane on %s (local fabric: "
+                    "jax.experimental.transfer not in this build; "
+                    "same-process peers pull device-direct, "
+                    "cross-process pulls ride the host-staged plane)",
+                    taddr)
 
     disagg_client = None
     prefill_task = None
@@ -583,8 +667,16 @@ async def run(args) -> None:
 
     drainable = DrainableService(serve_client,
                                  block_size=args.block_size)
-    instance = await endpoint.serve(engine_wire_handler(
-        drainable, request_metrics=request_metrics))
+    # Published slice topology (ISSUE 16): the instance record carries
+    # this worker's SliceSpec so the fleet brain — KvRouter donor picks,
+    # QoS selector HBM scaling, planner placement — reasons about mesh
+    # shape, role, kv mode and transfer-plane reachability WITHOUT any
+    # new scrape path.
+    slice_spec = derive_slice_spec(
+        args, fabric=transfer_plane.fabric if transfer_plane else "")
+    instance = await endpoint.serve(
+        engine_wire_handler(drainable, request_metrics=request_metrics),
+        metadata={"slice": slice_spec.to_dict()})
     if transfer_engine is not None:
         # Peers pull the handed-off KV from this worker's kv_blocks
         # endpoint — the instance address IS the donor descriptor.
@@ -687,7 +779,9 @@ async def run(args) -> None:
         # `dynamo top` renders it.  Best-effort with retry — a control
         # plane mid-restart must not crash the worker.
         status_reg_task = register_status_endpoint_task(
-            cp, f"worker-{args.role}", hport, host=args.rpc_host)
+            cp, f"worker-{args.role}", hport, host=args.rpc_host,
+            extra={"mesh": slice_spec.describe(),
+                   "slice": slice_spec.to_dict()})
         if args.hbm_poll_interval > 0:
             hbm_poller = HbmPoller(kv_metrics,
                                    interval=args.hbm_poll_interval)

@@ -59,25 +59,37 @@ def _core(host_blocks=0, num_blocks=64):
 
 
 class _Worker:
-    """One in-process worker: engine + RPC server with kv_blocks."""
+    """One in-process worker: engine + RPC server with kv_blocks, plus
+    the device transfer plane (ISSUE 16: the worker ALWAYS starts one —
+    local fabric when pjrt cross-host transfer is absent — so drain
+    migration rides device-direct instead of the host-staged wire)."""
 
     def __init__(self, **core_kw):
         self._core_kw = core_kw
 
     async def start(self):
+        from dynamo_tpu.llm.block_manager.device_transfer import (
+            KV_OFFER_ENDPOINT, KV_PULLED_ENDPOINT, KvTransferPlane)
         from dynamo_tpu.runtime.rpc import RpcServer
 
         self.engine = InferenceEngine(_core(**self._core_kw))
         await self.engine.start()
         self.client = LocalEngineClient(self.engine)
+        self.plane = KvTransferPlane(self.engine)
+        self.plane.start()
         self.rpc = RpcServer()
         self.rpc.register(KV_BLOCKS_ENDPOINT,
                           make_kv_blocks_handler(self.engine))
+        self.rpc.register(KV_OFFER_ENDPOINT,
+                          self.plane.make_offer_handler())
+        self.rpc.register(KV_PULLED_ENDPOINT,
+                          self.plane.make_pulled_handler())
         self.address = await self.rpc.start()
         return self
 
     async def stop(self):
         await self.rpc.stop()
+        self.plane.stop()
         await self.engine.stop()
 
 
@@ -132,7 +144,8 @@ def _drain_scenario(sampling, drain_after_tokens):
 
             drainable = DrainableService(wa.client, kv_address=wa.address,
                                          block_size=BS)
-            fetcher = PrefixFetcher(wb.engine, lambda a: rpc, BS)
+            fetcher = PrefixFetcher(wb.engine, lambda a: rpc, BS,
+                                    plane=wb.plane)
             survivor = PrefixShareClient(wb.client, fetcher)
             mc = MigrationClient(_FleetRouter(drainable, survivor),
                                  migration_limit=3, retry_delay=0.001)
@@ -170,11 +183,15 @@ def test_drain_migration_byte_identical_greedy():
         SamplingParams(max_tokens=20), drain_after_tokens=6)
     assert got == want, (got, want)
     assert drainable.migrated_out == 1
-    # Plane counters pinned: KV crossed the wire (device-or-host > 0),
-    # and the happy path never fell back to re-prefill.
+    # Plane counters pinned: KV crossed the wire, and the happy path
+    # never fell back to re-prefill.
     assert fetcher.pulled_blocks > 0
     assert fetcher.fallbacks == 0
     assert fetcher.migrated_in == 1
+    # ISSUE 16 satellite: the drain handoff rode the DEVICE plane —
+    # every worker now starts a KvTransferPlane (local fabric when pjrt
+    # is absent), so the carried KV moved device-direct, not host-staged.
+    assert fetcher.device_pulled_blocks > 0
     # The survivor prefix-matched the carried KV at admission: it
     # prefilled only the unsealed tail, not the whole stream.
     assert sched_b.prefix_hit_tokens >= 4 * BS

@@ -265,6 +265,58 @@ def test_dynamo_top_once_json_covers_every_process():
     _run(main())
 
 
+def test_mesh_column_from_published_slice_spec():
+    """ISSUE 16 satellite: a worker registering with its SliceSpec in
+    the status extra gets a MESH cell rendered straight from the
+    registration (`describe()` + role marker); a pre-topology worker
+    (no extra) renders the no-data dash in the same table."""
+    async def main():
+        from dynamo_tpu.fleet.topology import parse_slice
+        from dynamo_tpu.runtime.control_plane_tcp import (
+            ControlPlaneClient, ControlPlaneServer)
+        from dynamo_tpu.runtime.metrics import MetricsRegistry
+        from dynamo_tpu.runtime.status import (
+            StatusServer, register_status_endpoint)
+
+        srv = ControlPlaneServer()
+        cp_port = await srv.start()
+        cp = ControlPlaneClient("127.0.0.1", cp_port)
+        await cp.start()
+        spec = parse_slice("sp2xtp2,int8,role=prefill")
+        sliced = StatusServer(registry=MetricsRegistry())
+        plain = StatusServer(registry=MetricsRegistry())
+        sport = await sliced.start()
+        pport = await plain.start()
+        await register_status_endpoint(
+            cp, "worker-prefill", sport,
+            extra={"mesh": spec.describe(), "slice": spec.to_dict()})
+        await register_status_endpoint(cp, "worker-old", pport)
+        try:
+            snapshot = await dynamo_top.collect(
+                f"127.0.0.1:{cp_port}", timeout=2.0)
+        finally:
+            await sliced.stop()
+            await plain.stop()
+            await cp.close()
+            await srv.stop()
+
+        rows = {p["component"]: p for p in snapshot["processes"]}
+        assert rows["worker-prefill"]["mesh"] == "sp2xtp2"
+        assert rows["worker-prefill"]["slice_role"] == "prefill"
+        assert rows["worker-old"]["mesh"] is None
+        table = dynamo_top.render_table(snapshot)
+        assert "MESH" in table
+        assert "sp2xtp2:P" in table
+        # The dash, not a crash, for the spec-less row.
+        assert dynamo_top._fmt_mesh(rows["worker-old"]) == "—"
+        assert dynamo_top._fmt_mesh(
+            {"mesh": "tp2", "slice_role": "decode"}) == "tp2:D"
+        assert dynamo_top._fmt_mesh(
+            {"mesh": "single", "slice_role": "both"}) == "single"
+
+    _run(main())
+
+
 def test_collect_marks_dead_process_unreachable():
     """A registration owned by a LIVE pid (ours) that stops answering
     renders unreachable — and is NOT reaped (the process may be wedged,

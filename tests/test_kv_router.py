@@ -307,6 +307,139 @@ def test_sharded_indexer_matches_flat():
         flat.find_matches([301, 302]).scores
 
 
+class TestQosRouting:
+    """ISSUE 16 satellite: interactive requests avoid deep-queued
+    workers; best-effort fills them; the all-busy fleet routes unbiased."""
+
+    @staticmethod
+    def _waiting(n):
+        from dynamo_tpu.llm.kv_router.protocols import (
+            ForwardPassMetrics, KvStats, WorkerStats)
+
+        return ForwardPassMetrics(
+            worker_stats=WorkerStats(num_requests_waiting=n),
+            kv_stats=KvStats())
+
+    def _candidates(self, busy_waiting=5, idle_waiting=0):
+        # "busy" holds the request's whole prefix (cost 8*waiting=40);
+        # "idle" must prefill 100 blocks from scratch (cost 100) — so
+        # without the QoS penalty busy wins DESPITE its queue.
+        return [
+            WorkerLoadSnapshot("busy", overlap_blocks=100,
+                               metrics=self._waiting(busy_waiting)),
+            WorkerLoadSnapshot("idle", overlap_blocks=0,
+                               metrics=self._waiting(idle_waiting)),
+        ]
+
+    def test_interactive_avoids_deep_queue(self):
+        from dynamo_tpu.llm.kv_router.scheduler import INTERACTIVE_PRIORITY
+
+        sel = DefaultWorkerSelector()
+        picked = sel.select(self._candidates(), request_blocks=100,
+                            priority=INTERACTIVE_PRIORITY)
+        assert picked.worker_id == "idle"
+
+    def test_best_effort_and_standard_unchanged(self):
+        sel = DefaultWorkerSelector()
+        for prio in (None, 0, 1):
+            picked = sel.select(self._candidates(), request_blocks=100,
+                                priority=prio)
+            assert picked.worker_id == "busy", prio
+
+    def test_all_busy_degenerate_routes_unbiased(self):
+        # EVERY candidate over the threshold: the bias cancels and the
+        # interactive request routes exactly like best-effort instead of
+        # herding onto an arbitrary penalized pick.
+        from dynamo_tpu.llm.kv_router.scheduler import INTERACTIVE_PRIORITY
+
+        sel = DefaultWorkerSelector()
+        c = self._candidates(busy_waiting=10, idle_waiting=10)
+        picked = sel.select(c, request_blocks=100,
+                            priority=INTERACTIVE_PRIORITY)
+        assert picked.worker_id == "busy"
+
+
+class TestTopologyAwareSelection:
+    def test_small_slice_decode_load_weighs_heavier(self):
+        # Equal decode blocks, but one candidate is a quarter-size
+        # slice: its load is scaled up and the big slice wins.
+        from dynamo_tpu.fleet.topology import SliceSpec
+
+        sel = DefaultWorkerSelector()
+        c = [
+            WorkerLoadSnapshot(
+                "small", decode_blocks=10,
+                slice=SliceSpec(hbm_per_chip_bytes=1 << 30)),
+            WorkerLoadSnapshot(
+                "big", decode_blocks=10,
+                slice=SliceSpec(mesh=(1, 1, 1, 1, 4),
+                                hbm_per_chip_bytes=1 << 30)),
+        ]
+        assert sel.select(c, request_blocks=0).worker_id == "big"
+
+    def test_sliceless_candidates_keep_plain_cost(self):
+        sel = DefaultWorkerSelector()
+        c = [
+            WorkerLoadSnapshot("a", decode_blocks=10),
+            WorkerLoadSnapshot("b", decode_blocks=20),
+        ]
+        assert sel.select(c, request_blocks=0).worker_id == "a"
+
+
+class TestPickDonor:
+    def _pick(self, scores, **kw):
+        from dynamo_tpu.llm.kv_router.scheduler import pick_donor
+
+        return pick_donor(scores, chosen="c", chosen_overlap=0,
+                          request_blocks=8, **kw)
+
+    def test_tie_break_is_stable_ascending_id(self):
+        """Equal-overlap donors break on the STABLE id key, independent
+        of dict iteration order (the old inline key ordered every int
+        before every string and flapped between replica routers)."""
+        for scores in ({2: 6, 10: 6}, {10: 6, 2: 6}):
+            assert self._pick(dict(scores)).worker_id == 2
+        for scores in ({"w1": 6, "w0": 6}, {"w0": 6, "w1": 6}):
+            assert self._pick(dict(scores)).worker_id == "w0"
+        # Mixed fleet: int lease ids order before string instance ids.
+        assert self._pick({"w0": 6, 7: 6}).worker_id == 7
+
+    def test_device_reachable_donor_beats_deeper_host_one(self):
+        from dynamo_tpu.fleet.topology import SliceSpec
+
+        slices = {
+            "c": SliceSpec(fabric="local:1"),
+            "near": SliceSpec(fabric="local:1"),
+            "far": SliceSpec(fabric="local:9"),
+        }
+        hint = self._pick({"near": 6, "far": 8}, slices=slices)
+        assert hint.worker_id == "near"
+        # Without topology the deeper donor wins as before.
+        assert self._pick({"near": 6, "far": 8}).worker_id == "far"
+
+    def test_free_hbm_breaks_overlap_ties(self):
+        from dynamo_tpu.fleet.topology import SliceSpec
+        from dynamo_tpu.llm.kv_router.protocols import (
+            ForwardPassMetrics, KvStats)
+
+        slices = {
+            "evicting": SliceSpec(hbm_per_chip_bytes=1000),
+            "roomy": SliceSpec(hbm_per_chip_bytes=1000),
+        }
+        metrics = {"evicting": ForwardPassMetrics(
+            kv_stats=KvStats(gpu_cache_usage_perc=0.95))}
+        hint = self._pick({"evicting": 6, "roomy": 6},
+                          slices=slices, metrics=metrics)
+        assert hint.worker_id == "roomy"
+
+    def test_floor_and_gain_gates_still_hold(self):
+        assert self._pick({"w": 3}) is None  # under 50% floor
+        from dynamo_tpu.llm.kv_router.scheduler import pick_donor
+
+        assert pick_donor({"w": 5}, chosen="c", chosen_overlap=4,
+                          request_blocks=8) is None  # gain < 2
+
+
 def test_router_replica_sync_applies_remote_decisions():
     """A second frontend's published decision raises this router's view of
     that worker's load (reference ACTIVE_SEQUENCES_SUBJECT sync)."""

@@ -96,6 +96,98 @@ def test_plan_step_decisions():
     _run(main())
 
 
+class RoleConnector(FakeConnector):
+    """FakeConnector with per-role replica pools (heterogeneous cell)."""
+
+    def __init__(self, counts):
+        super().__init__(n=sum(counts.values()))
+        self.counts = dict(counts)
+
+    def replicas(self, role=None):
+        if role is None:
+            return sum(self.counts.values())
+        return self.counts.get(role, 0)
+
+
+def test_placement_ok_is_the_slice_spec_consult():
+    """ISSUE 16 acceptance: plan decisions provably consult the published
+    SliceSpec — a mesh-blind assignment (decode role on the dedicated
+    sp-prefill slice) is refused with the slice named, the matching
+    assignment passes, and an unpublished worker stays placeable (mixed
+    fleet, version skew)."""
+    from dynamo_tpu.fleet.topology import parse_slice
+
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        slices = {"w-p": parse_slice("sp2xtp2,int8,role=prefill"),
+                  "w-d": parse_slice("tp2,int8,role=decode"),
+                  "w-old": None}
+        planner = LoadPlanner(cp, RoleConnector(
+            {"prefill": 1, "decode": 1}), PlannerConfig(
+                min_replicas=1, max_replicas=4, predictor="constant",
+                roles=("prefill", "decode")),
+            slices_fn=lambda: slices)
+        try:
+            ok, reason = planner.placement_ok("decode", worker_id="w-p")
+            assert not ok and "prefill" in reason
+            assert planner.placement_ok("prefill", worker_id="w-p")[0]
+            assert planner.placement_ok("decode", worker_id="w-d")[0]
+            assert planner.placement_ok("decode", worker_id="w-old")[0]
+            # topology() decodes wire dicts too (discovery hands the
+            # planner the published metadata, not live objects).
+            planner._slices_fn = lambda: {
+                "w-p": parse_slice("sp2xtp2,role=prefill").to_dict()}
+            spec = planner.topology()["w-p"]
+            assert spec is not None and spec.role == "prefill"
+            # A failing topology source degrades to topology-blind, not
+            # a crashed planning loop.
+            planner._slices_fn = lambda: 1 / 0
+            assert planner.topology() == {}
+        finally:
+            await cp.close()
+
+    _run(main())
+
+
+def test_plan_step_down_vetoed_when_role_coverage_would_break():
+    """Scale-down in heterogeneous-cell mode consults the topology: a
+    "down" whose victim role's LAST placeable slice would leave that
+    role unservable is vetoed; with a second slice of the role published
+    the same pressure scales down normally."""
+    from dynamo_tpu.fleet.topology import parse_slice
+
+    async def main():
+        cp = InProcessControlPlane()
+        await cp.start()
+        conn = RoleConnector({"prefill": 1, "decode": 2})
+        slices = {"w-p": parse_slice("sp2xtp2,role=prefill"),
+                  "w-d1": parse_slice("tp2,role=decode"),
+                  "w-d2": parse_slice("tp2,role=decode")}
+        planner = LoadPlanner(cp, conn, PlannerConfig(
+            min_replicas=1, max_replicas=4, kv_high=0.8, kv_low=0.3,
+            predictor="constant", roles=("prefill", "decode")),
+            slices_fn=lambda: slices)
+        idle = ForwardPassMetrics.from_dict(_metrics(usage=0.05))
+        planner._watcher._metrics[1] = (idle, time.monotonic())
+        planner._watcher._metrics[2] = (idle, time.monotonic())
+        try:
+            # Two decode slices: thinning the decode pool keeps every
+            # role placeable → the down decision stands.
+            assert planner.plan_step() == "down"
+            # Only ONE decode slice still published: the load signal
+            # still says "down" and plan_role targets decode (the
+            # fattest pool), but dropping decode's last published slice
+            # would leave the role unservable → vetoed.
+            slices.pop("w-d2")
+            assert planner.plan_role("down") == "decode"
+            assert planner.plan_step() is None
+        finally:
+            await cp.close()
+
+    _run(main())
+
+
 @pytest.mark.e2e
 def test_planner_e2e_scales_mocker_fleet():
     """Real control-plane server + LocalConnector spawning real mocker

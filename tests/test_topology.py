@@ -1,0 +1,138 @@
+"""Slice topology plane unit tests (ISSUE 16): the declarative SliceSpec
+codec, --slice parsing, reachability, and the fleet-brain reads
+(placement validation, role placement, donor preference ordering)."""
+
+import pytest
+
+from dynamo_tpu.fleet.topology import (
+    SliceSpec,
+    donor_preference_key,
+    free_hbm_bytes,
+    parse_slice,
+    place_role,
+    stable_id_key,
+    validate_placement,
+)
+
+
+class TestSliceSpec:
+    def test_parse_full_spec(self):
+        s = parse_slice("sp2xtp2,int8,packed,role=prefill")
+        assert s.mesh == (1, 1, 2, 1, 2)
+        assert s.role == "prefill"
+        assert s.kv_quant == "int8"
+        assert "packed_prefill" in s.features
+        assert s.describe() == "sp2xtp2"
+        assert s.chips == 4
+
+    def test_parse_single_and_defaults(self):
+        s = parse_slice("single")
+        assert s.mesh == (1, 1, 1, 1, 1)
+        assert s.role == "both" and s.kv_quant == "none"
+        assert s.describe() == "single"
+
+    def test_parse_rejects_unknown_token(self):
+        with pytest.raises(ValueError):
+            parse_slice("tp2,warp9")
+        with pytest.raises(ValueError):
+            parse_slice("role=sidecar")
+
+    def test_wire_roundtrip(self):
+        s = parse_slice("tp2,int8,role=decode,window4")
+        back = SliceSpec.from_dict(s.to_dict())
+        assert back == s
+
+    def test_from_dict_tolerates_garbage(self):
+        # Older workers publish nothing; version skew publishes junk —
+        # the fleet brain must degrade to None/defaults, never raise.
+        assert SliceSpec.from_dict(None) is None
+        assert SliceSpec.from_dict("tp2") is None
+        assert SliceSpec.from_dict({"mesh": [2]}) is None
+        assert SliceSpec.from_dict({"mesh": ["x"] * 5}) is None
+        s = SliceSpec.from_dict({"role": "sidecar"})
+        assert s is not None and s.role == "both"
+
+    def test_mesh_config_matches_describe(self):
+        s = parse_slice("sp2xtp2")
+        mc = s.mesh_config()
+        assert mc.describe() == s.describe() == "sp2xtp2"
+
+    def test_reachability(self):
+        pj = SliceSpec(fabric="pjrt")
+        loc_a = SliceSpec(fabric="local:1")
+        loc_b = SliceSpec(fabric="local:2")
+        none = SliceSpec(fabric="")
+        assert pj.reachable(SliceSpec(fabric="pjrt"))
+        assert loc_a.reachable(SliceSpec(fabric="local:1"))
+        assert not loc_a.reachable(loc_b)
+        assert not pj.reachable(loc_a)
+        assert not none.reachable(none)  # host-wire-only builds
+
+
+class TestPlacement:
+    def test_decode_on_prefill_slice_refused(self):
+        prefill = parse_slice("sp2xtp2,role=prefill")
+        ok, reason = validate_placement("decode", prefill)
+        assert not ok and "prefill" in reason
+
+    def test_matching_and_unconstrained_placements(self):
+        assert validate_placement("prefill",
+                                  parse_slice("sp2,role=prefill"))[0]
+        assert validate_placement("decode", parse_slice("tp2"))[0]
+        assert validate_placement("decode", None)[0]  # mixed fleet
+        assert not validate_placement("both",
+                                      parse_slice("tp2,role=decode"))[0]
+        assert not validate_placement("sidecar", None)[0]
+
+    def test_place_role_picks_valid_slice_with_headroom(self):
+        slices = {
+            "p": parse_slice("sp2xtp2,role=prefill"),
+            "d_small": SliceSpec(role="decode", hbm_per_chip_bytes=100),
+            "d_big": SliceSpec(role="decode", hbm_per_chip_bytes=1000),
+        }
+        assert place_role("decode", slices) == "d_big"
+        assert place_role("prefill", slices) == "p"
+        # No slice serves "both" in a dedicated cell: spawn cue.
+        assert place_role("both", slices) is None
+
+
+class TestDonorPreference:
+    def test_stable_id_total_order(self):
+        # ints numeric (2 beats 10 — the old string compare bug class),
+        # ints before strings, strings lexical.
+        assert stable_id_key(2) < stable_id_key(10)
+        assert stable_id_key(10) < stable_id_key("w0")
+        assert stable_id_key("w0") < stable_id_key("w1")
+
+    def test_reachability_dominates_overlap(self):
+        far = donor_preference_key("far", 8, reachable=False)
+        near = donor_preference_key("near", 6, reachable=True)
+        assert near > far
+
+    def test_free_hbm_breaks_equal_overlap(self):
+        poor = donor_preference_key("a", 6, reachable=True, free_hbm=10)
+        rich = donor_preference_key("b", 6, reachable=True, free_hbm=99)
+        assert rich > poor
+
+    def test_ascending_id_breaks_exact_ties(self):
+        # max() over keys must prefer the LOWER id when all else ties.
+        assert donor_preference_key(2, 6) > donor_preference_key(10, 6)
+        assert donor_preference_key("w0", 6) > donor_preference_key("w1", 6)
+
+
+class TestFreeHbm:
+    def test_scaled_by_published_occupancy(self):
+        class KvStats:
+            gpu_cache_usage_perc = 0.75
+
+        class Metrics:
+            kv_stats = KvStats()
+
+        spec = SliceSpec(mesh=(1, 1, 1, 1, 2), hbm_per_chip_bytes=1000)
+        assert spec.total_hbm_bytes == 2000
+        assert free_hbm_bytes(spec, Metrics()) == 500
+        assert free_hbm_bytes(spec, None) == 2000
+
+    def test_unknown_capacity_reports_zero(self):
+        assert free_hbm_bytes(None, None) == 0
+        assert free_hbm_bytes(SliceSpec(), None) == 0

@@ -596,6 +596,35 @@ def sla_profiler_checks() -> dict:
     }
 
 
+def disagg_topology_checks() -> dict:
+    """ISSUE 16 smoke: the slice topology plane measured end to end — a
+    heterogeneous disagg cell (ring-SP int8 prefill slice → head-sharded
+    tp int8 decode slice) serves byte-identical greedy output vs the
+    meshless oracle with the KV crossing the DEVICE plane and landing
+    resharded on the decode mesh (reshard_pulls pinned), and the
+    fabricated mesh-blind planner decision — decode role deployed onto
+    the prefill-only slice — must be REFUSED by `validate_placement`."""
+    import asyncio
+
+    from dynamo_tpu.bench.disagg_topology import run_disagg_topology
+
+    out = asyncio.run(asyncio.wait_for(run_disagg_topology(), 300))
+    return {
+        "disagg_topology_prefill_slice": out["prefill_slice"],
+        "disagg_topology_decode_slice": out["decode_slice"],
+        "disagg_topology_token_parity": out["token_parity"],
+        "disagg_topology_remote_prefills": out["remote_prefills"],
+        "disagg_topology_no_fallbacks": out["local_fallbacks"] == 0,
+        "disagg_topology_device_plane_used": (
+            out["device_pulls"] > 0 and out["pulled_blocks"] > 0),
+        "disagg_topology_reshard_pulls": out["reshard_pulls"],
+        "disagg_topology_kv_resharded": out["reshard_pulls"] > 0,
+        "disagg_topology_onboarded_blocks": out["onboarded_blocks"],
+        "disagg_topology_mesh_blind_placement_refused":
+            out["placement_guard_refuses_mesh_blind"],
+    }
+
+
 def run_smoke(args) -> int:
     """Mocker-backed smoke of the whole measurement loop — CPU-only, no
     JAX device work, fast enough for tier-1.
@@ -646,7 +675,13 @@ def run_smoke(args) -> int:
     12. drain migration (ISSUE 15): the KV-carrying drain resume (real
         PrefixFetcher over the modeled wire) beats cold re-prefill
         (blip_ratio < 1, blocks carried, zero fallbacks), and the
-        fabricated drop-the-KV donor must FAIL the same claim.
+        fabricated drop-the-KV donor must FAIL the same claim;
+    13. slice topology (ISSUE 16): a heterogeneous disagg cell
+        (sp-prefill slice → tp+int8 decode slice) serves byte-identical
+        greedy output vs the meshless oracle with the KV resharded on
+        the device plane (reshard_pulls > 0), and the fabricated
+        mesh-blind placement (decode role on the prefill-only slice)
+        must be refused by the topology guard.
     """
     # The sharded checks need a multi-device rig: force the 8-way
     # virtual-CPU platform BEFORE anything imports jax (this smoke is
@@ -814,6 +849,7 @@ def run_smoke(args) -> int:
         **sharded_decode_checks(),
         **sla_profiler_checks(),
         **drain_migration_checks(),
+        **disagg_topology_checks(),
     }
     ok = all(v is not False for v in checks.values())
     print(json.dumps({"smoke": "pass" if ok else "fail", **checks},

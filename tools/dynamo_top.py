@@ -309,9 +309,17 @@ async def collect(cp_addr: str, timeout: float = 3.0,
                 processes.append({"component": component, "address": addr,
                                   "unreachable": True})
                 continue
-            processes.append(summarize(component, addr,
-                                       parse_prom(text or ""), slo,
-                                       knee_concurrency=knee_concurrency))
+            row = summarize(component, addr, parse_prom(text or ""), slo,
+                            knee_concurrency=knee_concurrency)
+            # Slice topology (ISSUE 16): the worker publishes its
+            # declarative SliceSpec in the status registration — the
+            # MESH column renders the mesh shape + role straight from
+            # it (no scrape needed; pre-topology workers show a dash).
+            row["mesh"] = entry.get("mesh")
+            sl = entry.get("slice")
+            row["slice_role"] = (sl.get("role")
+                                 if isinstance(sl, dict) else None)
+            processes.append(row)
     finally:
         await cp.close()
     return {"generated_at": time.time(), "control_plane": cp_addr,
@@ -368,9 +376,25 @@ def _fmt_qos_drain(r: dict) -> str:
     return f"{q}/{m}{mark}"
 
 
+def _fmt_mesh(r: dict) -> str:
+    """MESH cell from the worker's published SliceSpec: the mesh shape
+    (`describe()` string), suffixed :P / :D for a dedicated
+    prefill/decode slice.  Pre-topology registrations render the
+    no-data dash."""
+    mesh = r.get("mesh")
+    if not mesh:
+        return "—"
+    role = r.get("slice_role")
+    mark = {"prefill": ":P", "decode": ":D"}.get(role, "")
+    return f"{mesh}{mark}"
+
+
 COLUMNS = (
     ("ROLE", 16, lambda r: r["component"]),
     ("ADDRESS", 21, lambda r: r["address"]),
+    # Slice topology plane: mesh shape + role from the published
+    # SliceSpec (status registration, not a scrape).
+    ("MESH", 11, _fmt_mesh),
     ("INFL", 5, lambda r: _fmt(r.get("inflight"), "int")),
     ("KV%", 6, lambda r: _fmt(r.get("kv_usage"), "pct")),
     ("HIT%", 6, lambda r: _fmt(r.get("prefix_hit_rate"), "pct")),
