@@ -533,6 +533,16 @@ def main():
         # and re-timing the baseline a second time.
         meshless_window_step_s=win_step_s,
         meshless_single_step_s=step_s)
+    # MoE fast-decode plane (ISSUE 17): grouped expert kernel vs the
+    # dense all-experts oracle at decode shape — tok/s ratio (gate floor
+    # moe_decode.grouped_vs_dense >= 1.5 on TPU; zeroed on parity
+    # failure), per-expert load histogram, and the int8-weight variant.
+    # The bench model is the 8-expert top-2 MoE at this bench's dims on
+    # TPU, tiny-moe in interpret mode off-TPU (same rig as --smoke).
+    from dynamo_tpu.bench.moe_decode import run_moe_decode
+
+    moe_decode = run_moe_decode(batch=BATCH if on_tpu else 4)
+
     serving_tok_s = sorted(serving_runs)[len(serving_runs) // 2]
     prefill_cold = prefill_runs[0]
     prefill_steady = max(prefill_runs[1:])
@@ -600,6 +610,7 @@ def main():
         "prefix_fleet": prefix_fleet,
         "drain_migration": drain_migration,
         "sharded_decode": sharded_decode,
+        "moe_decode": moe_decode,
         "transfer": transfer,
         "peak_flops_nominal": round(peak / 1e12, 1),
         "peak_flops_measured": round(peak_measured / 1e12, 1),

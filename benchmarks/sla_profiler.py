@@ -102,6 +102,12 @@ INT8_TRAFFIC_RATIO = 0.53      # PR 6: int8 KV HBM traffic vs bf16 (≤0.55 gate
 SPEC_DECODE_SPEEDUP = 1.3      # PR 6: modeled decode speedup floor (≥1.3 gated)
 PACKED_PREFILL_SPEEDUP = 1.3   # PR 10: packed vs padded prefill (≥1.2 gated)
 TP_PER_CHIP_RATIO = 0.91       # PR 9: sharded tok/s/chip vs meshless (r5 gate)
+# MoE decode (PR 17): the dense oracle streams all E experts' weights
+# per step — E/k = 4x the active-weight bytes at the default 8-expert
+# top-2 geometry; the grouped kernel claws back the gate-proven ratio
+# (moe_decode.grouped_vs_dense >= 1.5 in dynamo_tpu/bench/gate.py).
+MOE_DENSE_WEIGHT_FACTOR = 4.0
+MOE_GROUPED_SPEEDUP = 1.5
 # Disaggregated P/D: eager KV streaming hides the transfer behind
 # prefill (overlap ≥ 0.5 gated), so decode-side TTFT pays only the
 # residual tail — modeled as a fixed hop plus a per-token tail rate.
@@ -137,22 +143,38 @@ class CellConfig:
     """One sweep configuration over the serving feature axes.
 
     A cell is the unit deployment the capacity model replicates:
-    `workers` engines, each on a `tp`-chip mesh; `disagg` adds an equal
-    pool of prefill workers (the PAPER.md "prefill slice + decode
-    slice" shape)."""
+    `workers` engines, each on a `tp×ep`-chip mesh; `disagg` adds an
+    equal pool of prefill workers (the PAPER.md "prefill slice + decode
+    slice" shape).  `moe` selects the model family AND the serving
+    mode: "off" (dense model), "dense" (MoE via the every-expert
+    oracle) or "grouped" (MoE via the grouped fast path, PR 17); `ep`
+    shards the expert weights across chips and is only meaningful on
+    MoE cells."""
 
     name: str
     tp: int = 1
+    ep: int = 1                    # expert-parallel degree (MoE cells)
     workers: int = 1
     duty: float = 1.0              # mixed-prefill duty fraction (0-1]
     packed_prefill: bool = False
     kv_quant: str = "none"         # "none" | "int8"
     spec_decode: int = 0           # draft length; 0 = off
     disagg: bool = False
+    moe: str = "off"               # "off" | "dense" | "grouped"
+
+    def __post_init__(self):
+        if self.moe not in ("off", "dense", "grouped"):
+            raise ValueError(
+                f"cell {self.name!r}: moe={self.moe!r} not in "
+                f"('off', 'dense', 'grouped')")
+        if self.ep > 1 and self.moe == "off":
+            raise ValueError(
+                f"cell {self.name!r}: ep={self.ep} shards expert "
+                f"weights — meaningless on a dense (moe='off') cell")
 
     @property
     def chips(self) -> int:
-        return self.tp * self.workers * (2 if self.disagg else 1)
+        return self.tp * self.ep * self.workers * (2 if self.disagg else 1)
 
     def to_dict(self) -> Dict:
         d = asdict(self)
@@ -198,7 +220,15 @@ def cell_timing(cell: CellConfig, *, block_size: int = 32,
       part) by the traffic ratio — the base term models launch +
       weight-read cost quantization doesn't touch;
     - spec decode divides both decode terms by the modeled speedup
-      (more tokens per verified dispatch).
+      (more tokens per verified dispatch);
+    - MoE multiplies the weight-read terms (prefill per-token + decode
+      base — the terms expert weights live in, not the KV per-seq term)
+      by the expert-traffic factor: the dense oracle pays the full
+      E/k = 4x blowup, the grouped path claws back the gate-proven
+      1.5x, and ep shards the expert stream across chips on the same
+      per-chip efficiency curve as tp.  The factor is floored at 1.0 —
+      ep shards only the expert weights, so no MoE cell beats the
+      equivalent dense-model cell.
     """
     s_tp = _tp_speedup(cell.tp)
     ppt = _BASE_PREFILL_MS_PER_TOKEN / s_tp
@@ -206,6 +236,13 @@ def cell_timing(cell: CellConfig, *, block_size: int = 32,
         ppt /= PACKED_PREFILL_SPEEDUP
     base = _BASE_DECODE_BASE_MS / s_tp
     per_seq = _BASE_DECODE_MS_PER_SEQ / s_tp
+    if cell.moe != "off":
+        f = MOE_DENSE_WEIGHT_FACTOR
+        if cell.moe == "grouped":
+            f /= MOE_GROUPED_SPEEDUP
+        f = max(1.0, f / _tp_speedup(cell.ep))
+        ppt *= f
+        base *= f
     if cell.kv_quant == "int8":
         per_seq *= INT8_TRAFFIC_RATIO
     if cell.spec_decode > 0:
@@ -259,10 +296,29 @@ def default_cells() -> List[CellConfig]:
     ]
 
 
+def moe_cells() -> List[CellConfig]:
+    """The MoE sweep grid (PR 17): the dense oracle as the honesty
+    baseline, the grouped fast path alone and composed with the PR 6/10
+    serving planes, and ep-sharded expert variants.  Swept under the
+    `moe_agentic` mix so `plan_capacity` names a cheapest MoE fleet
+    WITHOUT competing in (or perturbing) the dense-model plan the smoke
+    fixture pins."""
+    return [
+        CellConfig("moe-dense", moe="dense"),
+        CellConfig("moe-grouped", moe="grouped"),
+        CellConfig("moe-grouped+int8+spec+packed", moe="grouped",
+                   kv_quant="int8", spec_decode=4, packed_prefill=True),
+        CellConfig("moe-grouped-ep2", moe="grouped", ep=2),
+        CellConfig("moe-grouped-ep2+int8+spec+packed", moe="grouped",
+                   ep=2, kv_quant="int8", spec_decode=4,
+                   packed_prefill=True),
+    ]
+
+
 # -- traffic mixes -------------------------------------------------------
 
 
-TRAFFIC_MIXES = ("agentic", "long_context", "diurnal")
+TRAFFIC_MIXES = ("agentic", "long_context", "diurnal", "moe_agentic")
 
 
 def make_traffic(mix: str, num_requests: int, *, block_size: int = 32,
@@ -277,11 +333,17 @@ def make_traffic(mix: str, num_requests: int, *, block_size: int = 32,
     - `diurnal`: the agentic shape with sinusoidally-modulated arrival
       intervals (AR(p)-predictable bursty load, planner/predictor.py) —
       peak rate ~3x trough.
+    - `moe_agentic`: the agentic ARRIVAL shape served by an MoE model —
+      the regime PR 17's fast-decode plane targets.  Same trace records
+      (traffic shape is a property of the workload, not the model); the
+      mix name keys the planner to the `moe_cells()` grid so the MoE
+      capacity plan is answered per-mix, beside — never inside — the
+      dense-model plan.
 
     Timestamps are a base pacing; `scale_to_rate` rescales them to an
     offered load before simulation/replay.
     """
-    if mix == "agentic":
+    if mix in ("agentic", "moe_agentic"):
         return synthesize_prefix_heavy(
             num_requests, num_roots=max(2, num_requests // 16),
             context_blocks=6, suffix_tokens=24, output_tokens=16,
@@ -1275,6 +1337,7 @@ SMOKE_LOADS = (4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 SMOKE_SLO = SloTarget(ttft_p99_s=0.25, tpot_p99_s=0.012)
 SMOKE_RPS = 40.0
 SMOKE_MIX = "agentic"
+SMOKE_MOE_MIX = "moe_agentic"
 
 
 def run_smoke(out_path: Optional[str] = None, *,
@@ -1282,7 +1345,12 @@ def run_smoke(out_path: Optional[str] = None, *,
     """The deterministic CPU smoke: tiny grids over the mocker cells,
     the pinned capacity fixture (SMOKE_SLO at SMOKE_RPS on the agentic
     mix), and a profile `SlaPlanner` loads unchanged.  Pure virtual
-    clock — byte-stable across runs, so tests pin the answer."""
+    clock — byte-stable across runs, so tests pin the answer.
+
+    The MoE grid is swept SEPARATELY under the moe_agentic mix and
+    answered as its own plan (`moe_plan`): MoE cells never enter the
+    dense-model plan, so the original pinned fixture cannot drift from
+    this PR — the MoE answer gets its own pin in the gate instead."""
     cells = list(cells or default_cells())
     frontiers = sweep(cells, [SMOKE_MIX], SMOKE_LOADS,
                       num_requests=96)[SMOKE_MIX]
@@ -1291,11 +1359,15 @@ def run_smoke(out_path: Optional[str] = None, *,
                             micro_kw={"isl_grid": (128, 256, 512),
                                       "context_grid": (256, 512),
                                       "kv_grid": (0.2, 0.5)})
+    moe_frontiers = sweep(moe_cells(), [SMOKE_MOE_MIX], SMOKE_LOADS,
+                          num_requests=96)[SMOKE_MOE_MIX]
+    moe_plan = plan_capacity(moe_frontiers, SMOKE_SLO, SMOKE_RPS)
     if out_path:
         from dynamo_tpu.planner.interpolation import save_profile
 
         save_profile(profile, out_path)
-    return {"profile": profile, "plan": plan, "frontiers": frontiers}
+    return {"profile": profile, "plan": plan, "frontiers": frontiers,
+            "moe_plan": moe_plan, "moe_frontiers": moe_frontiers}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1350,10 +1422,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.smoke:
         res = run_smoke(args.out)
         plan: CapacityPlan = res["plan"]
+        moe_plan: CapacityPlan = res["moe_plan"]
         print(json.dumps({"profile_written": args.out,
                           "cells": len(res["frontiers"]),
-                          "plan": plan.to_dict()}, indent=2))
-        return 0 if plan.feasible else 1
+                          "plan": plan.to_dict(),
+                          "moe_plan": moe_plan.to_dict()}, indent=2))
+        return 0 if plan.feasible and moe_plan.feasible else 1
 
     if args.fleet > 0:
         res = validate_fleet_model(

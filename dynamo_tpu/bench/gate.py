@@ -119,6 +119,17 @@ class FloorSpec:
 #   inject, the pre-ISSUE-13 sharded bug).  The bench ZEROES the ratio
 #   when byte parity fails, so this floor also trips on a
 #   fast-but-corrupting plane.
+# - moe_decode.grouped_vs_dense >= 1.5 — ISSUE 17: the grouped expert
+#   kernel (sort-by-expert + ragged grouped GEMM streaming only ACTIVE
+#   experts' weights) must beat the dense all-experts path by >= 1.5x at
+#   decode shape.  The theoretical edge is E/k (4x at the 8-expert top-2
+#   bench geometry — dense streams and multiplies every expert's weights
+#   per token, grouped only the selected ones), so 1.5 leaves room for
+#   the sort/scatter overhead while still failing a kernel that fell
+#   back to dense-ish streaming.  The bench ZEROES the ratio when token
+#   parity vs the moe_dense oracle fails, so this floor also trips on a
+#   fast-but-wrong kernel.  Absent (skipped, not passed) on dense-model
+#   rounds or grouped-ineligible geometries.
 # - sharded_decode.pp_fused_vs_single >= 1.2 — ISSUE 12: the all-in-one
 #   pp stage program (schedule + fused argmax, [B] tokens out) must beat
 #   the unfused loop it replaced (schedule dispatch returning [B, V] f32
@@ -137,6 +148,7 @@ TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("prefix_fleet.remote_hit_rate", minimum=0.2),
     FloorSpec("sharded_decode.tok_s_per_chip_ratio", minimum=0.8),
     FloorSpec("sharded_decode.pp_fused_vs_single", minimum=1.2),
+    FloorSpec("moe_decode.grouped_vs_dense", minimum=1.5),
     FloorSpec("prefill_plane.packed_vs_padded_tok_s_ratio", minimum=1.2),
     FloorSpec("transfer.device_vs_host_ratio", minimum=2.0),
 )

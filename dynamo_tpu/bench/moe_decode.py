@@ -1,0 +1,151 @@
+"""MoE fast-decode benchmark (ISSUE 17): grouped kernel vs dense oracle.
+
+The dense MoE path runs EVERY expert over EVERY token and zero-gates the
+non-selected ones — E/k× the minimal FLOPs *and weight bytes* (for the
+default 8-expert top-2 geometry, 4× on both axes).  The grouped path
+(ops/pallas/moe_grouped.py) sorts assignments by expert on device and
+runs one ragged grouped GEMM that streams each ACTIVE expert's weights
+HBM→VMEM once — in the decode regime the waste removed is mostly weight
+bytes, exactly the axis the decode roofline binds on.
+
+The section reports:
+
+- `dense_step_ms` / `grouped_step_ms` — slope-timed single MoE block at
+  decode shape ([batch, 1, H] tokens), forced completion, trimmed-median
+  slope (the bench.py honesty rules);
+- `grouped_vs_dense` — the headline ratio (dense ms / grouped ms).
+  TPU gate floor >= 1.5 (dynamo_tpu/bench/gate.py TPU_FLOORS): the
+  theoretical weight-traffic edge is E/k = 4×, so 1.5 leaves room for
+  sort/scatter overhead while still failing a kernel that regressed to
+  dense-ish streaming.  The ratio is ZEROED when token parity fails —
+  a fast-but-wrong kernel trips the same floor;
+- `token_parity` — grouped output bitwise equal to `moe_dense` on the
+  same tokens (the byte-identity the compose-matrix tests pin at tiny
+  geometry, re-checked at bench geometry);
+- `expert_load` / `dropped_tokens` — the per-expert assignment histogram
+  from the [E+1] stats vector (the telemetry workers publish as
+  `dynamo_moe_expert_load`), plus `expert_load_imbalance` = max/mean —
+  how skewed this (random-weight) routing landed;
+- `grouped_int8_step_ms` / `int8_parity` — the int8-weight variant
+  (dequant-in-VMEM) timed at the same shape, parity-checked against the
+  dense oracle on the host-dequantized weights.
+
+Off-TPU the grouped kernel runs in interpret mode: the ratio is
+meaningless (and usually < 1) but the plumbing + parity are identical,
+which is what `bench_gate --smoke` asserts; the 1.5 floor binds on TPU
+rounds only.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _slope(fn, n1: int, n2: int) -> float:
+    from dynamo_tpu.bench import harness
+
+    fn(1)  # warm / compile
+    return harness.measure_slope(fn, n1, n2, repeats=3).per_call_s
+
+
+def _time_block(step, p, x, n1: int = 4, n2: int = 12) -> float:
+    def run(n):
+        y = x
+        t0 = time.perf_counter()
+        for _ in range(n):
+            y, _ = step(p, y)
+        jax.device_get(y.ravel()[0])  # force completion
+        return time.perf_counter() - t0
+
+    return _slope(run, n1, n2)
+
+
+def run_moe_decode(cfg=None, *, batch: int = 64, seed: int = 0,
+                   with_int8: bool = True,
+                   block_rows: Optional[int] = None) -> Dict:
+    """The `moe_decode` BENCH section (see module docstring).
+
+    `cfg` defaults to an 8-expert top-2 MoE at llama-3-1b dims on TPU
+    and tiny-moe off-TPU (interpret-mode kernels at 1B geometry would
+    burn smoke wall-clock for nothing)."""
+    from dynamo_tpu.models import config as mcfg
+    from dynamo_tpu.models.llama import init_params
+    from dynamo_tpu.ops import moe as moe_ops
+    from dynamo_tpu.ops.pallas import (
+        dequantize_moe_params,
+        moe_grouped_geometry_ok,
+        quantize_moe_params,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    if cfg is None:
+        cfg = (mcfg.get_config("llama-3-1b").replace(
+                   name="llama-3-1b-moe8", num_experts=8,
+                   num_experts_per_token=2)
+               if on_tpu else mcfg.get_config("tiny-moe"))
+    interpret = not on_tpu
+    out: Dict = {"model": cfg.name, "batch": batch,
+                 "num_experts": cfg.num_experts,
+                 "experts_per_token": cfg.num_experts_per_token,
+                 "backend": jax.default_backend()}
+    if on_tpu and not moe_grouped_geometry_ok(
+            cfg.hidden_size, cfg.intermediate_size,
+            jnp.dtype(cfg.dtype).itemsize):
+        # A skipped section (never a silent pass): the floor is absent
+        # from the doc, so bench_gate skips it rather than passing it.
+        out["skipped"] = (f"geometry not grouped-eligible: H="
+                         f"{cfg.hidden_size} F={cfg.intermediate_size}")
+        return out
+
+    p = init_params(cfg, jax.random.key(seed))["layers"][0]["moe"]
+    x = jax.random.normal(jax.random.key(seed + 1),
+                          (batch, 1, cfg.hidden_size), jnp.float32
+                          ).astype(jnp.dtype(cfg.dtype))
+
+    dense = jax.jit(lambda pp, xx: moe_ops.moe_dense(cfg, pp, xx))
+    grouped = jax.jit(lambda pp, xx: moe_ops.moe_grouped(
+        cfg, pp, xx, block_rows=block_rows, interpret=interpret))
+
+    want, _ = dense(p, x)
+    got, stats = grouped(p, x)
+    parity = bool((np.asarray(want) == np.asarray(got)).all())
+    stats = np.asarray(stats)
+    load = stats[:-1]
+    out["token_parity"] = parity
+    out["expert_load"] = [int(v) for v in load]
+    out["dropped_tokens"] = int(stats[-1])
+    out["expert_load_imbalance"] = round(
+        float(load.max() / max(load.mean(), 1e-9)), 3)
+
+    dense_s = _time_block(dense, p, x)
+    grouped_s = _time_block(grouped, p, x)
+    out["dense_step_ms"] = round(dense_s * 1e3, 4)
+    out["grouped_step_ms"] = round(grouped_s * 1e3, 4)
+    # Parity gates the ratio: a fast-but-wrong kernel reports 0.0 and
+    # trips the >= 1.5 TPU floor instead of sailing through.
+    out["grouped_vs_dense"] = (round(dense_s / grouped_s, 3)
+                               if parity and grouped_s > 0 else 0.0)
+    # Modeled per-step expert-weight traffic: dense streams all E
+    # experts' weights; grouped streams only experts with assignments.
+    w_bytes_per_expert = (3 * cfg.hidden_size * cfg.intermediate_size
+                          * jnp.dtype(cfg.dtype).itemsize)
+    out["dense_expert_weight_bytes"] = cfg.num_experts * w_bytes_per_expert
+    out["grouped_expert_weight_bytes"] = (
+        int((load > 0).sum()) * w_bytes_per_expert)
+
+    if with_int8:
+        q = quantize_moe_params(p)
+        grouped8 = jax.jit(lambda pp, xx: moe_ops.moe_grouped(
+            cfg, pp, xx, block_rows=block_rows, interpret=interpret))
+        want8, _ = dense(dequantize_moe_params(q, jnp.dtype(cfg.dtype)), x)
+        got8, _ = grouped8(q, x)
+        out["int8_parity"] = bool(
+            (np.asarray(want8) == np.asarray(got8)).all())
+        out["grouped_int8_step_ms"] = round(
+            _time_block(grouped8, q, x) * 1e3, 4)
+    return out

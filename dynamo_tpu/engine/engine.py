@@ -119,6 +119,12 @@ class EngineConfig:
     # and multi-process lockstep meshes; any future impossible combo is
     # declared in parallel.sharding.plane_capability, not here.
     kv_quant: str = "none"
+    # MoE compute mode (parallel/sharding.resolve_moe_mode): "auto" picks
+    # the grouped Pallas fast path on meshless TPU engines (eligible
+    # geometry), all-to-all dispatch on ep > 1 meshes, dense otherwise.
+    # Explicit "dense" | "grouped" | "dispatch" pin a rung; invalid
+    # combos (grouped × mesh, dispatch × meshless) raise pointedly.
+    moe_mode: str = "auto"
     mesh: Optional[object] = None          # jax.sharding.Mesh for tp/ep
     # Batch-sharded attention with slot-sharded KV (tp beyond the kv-head
     # count; reference sglang --enable-dp-attention).
@@ -150,10 +156,11 @@ class EngineConfig:
     # kernel (ops/pallas/paged_prefill.py) — no [R, T] bucket padding,
     # no gather_kv materialisation, and a shape lattice small enough to
     # prewarm (the cold-prefill cliff).  None = auto: on for TPU,
-    # meshless, non-MoE engines whose geometry passes
-    # mosaic_geometry_ok (the decode kernel's shared eligibility rule);
-    # everything else keeps the padded gather plane.  Explicit True off
-    # TPU runs the kernel in interpret mode (tests).
+    # meshless engines whose geometry passes mosaic_geometry_ok (the
+    # decode kernel's shared eligibility rule); everything else keeps
+    # the padded gather plane.  MoE composes (ISSUE 17): the packed
+    # hidden rides _moe_block with the engine's meshless moe_mode.
+    # Explicit True off TPU runs the kernel in interpret mode (tests).
     packed_prefill: Optional[bool] = None
     # Fused decode window: K tokens per device dispatch with on-device
     # token feedback, host syncs lagging `pipeline_depth` windows behind.
@@ -256,7 +263,8 @@ class EngineCore:
                           spec=config.speculative_tokens > 0,
                           use_pallas=config.use_pallas_decode is True,
                           dp_attention=config.dp_attention,
-                          dp_local=config.dp_attention),
+                          dp_local=config.dp_attention,
+                          moe=cfg.is_moe),
                 multihost=self._mh)
         # Host-side staging for device inputs: single-process uploads
         # eagerly (device-resident caching matters on a tunneled chip);
@@ -391,7 +399,7 @@ class EngineCore:
         elif self.mesh is not None:
             from dynamo_tpu.parallel.sharding import resolve_moe_mode
 
-            moe_mode = resolve_moe_mode(cfg, self.mesh)
+            moe_mode = resolve_moe_mode(cfg, self.mesh, config.moe_mode)
             self._moe_mode = moe_mode
             params = shard_pytree(
                 params,
@@ -411,19 +419,31 @@ class EngineCore:
                              dp_local=self._dp_local,
                              kv_quant=self.cache_cfg.quantized),
                 self.mesh)
-            if (self.mesh.shape.get("sp", 1) > 1 and not cfg.is_moe
-                    and not config.dp_attention):
-                # (dp_attention × ring-SP is declared impossible in the
-                # capability table — the sp step's cache specs conflict
-                # with slot sharding.)
+            if (self.mesh.shape.get("sp", 1) > 1
+                    and plane_capability(
+                        self.mesh,
+                        PlaneSpec(role="sp_prefill", moe=cfg.is_moe,
+                                  dp_attention=config.dp_attention),
+                        multihost=self._mh).ok):
+                # Eligibility comes from the capability table (moe ×
+                # ring-SP and dp_attention × ring-SP are both declared
+                # impossible there) instead of a hand-coded combo list.
                 from dynamo_tpu.parallel.sharding import make_sp_prefill_step
 
                 self._sp_step = make_sp_prefill_step(
                     cfg, self.block_size, self.mesh,
                     kv_quant=self.cache_cfg.quantized)
         else:
+            from dynamo_tpu.parallel.sharding import resolve_moe_mode
+
+            # Meshless MoE mode: "auto" picks the grouped Pallas fast
+            # path on TPU (eligible geometry) and the dense oracle
+            # elsewhere — the same one-resolver discipline as meshes.
+            moe_mode = resolve_moe_mode(cfg, None, config.moe_mode)
+            self._moe_mode = moe_mode
             fwd = make_forward_step(cfg, self.block_size,
                                     use_pallas_decode=pallas,
+                                    moe_mode=moe_mode,
                                     with_expert_load=self._moe)
             self._step = jax.jit(fwd, donate_argnums=(1,))
             self._fwd_raw = fwd
@@ -459,10 +479,14 @@ class EngineCore:
             self.cache_cfg.bytes_per_context_token
             / self.kv_traffic_shards)
         # Cumulative per-expert assignment counts (MoE telemetry the
-        # worker publishes; reference `base_handlers.py:40-62`).
+        # worker publishes; reference `base_handlers.py:40-62`) and the
+        # capacity-honesty counter: every step's stats vector is [E+1]
+        # (ops/moe.py), whose tail counts assignments a bounded
+        # `moe_capacity` dropped — 0 forever at the exact default.
         self.expert_load = (np.zeros((cfg.num_experts,), np.int64)
                             if self._moe else None)
-        self._load_dev = None  # device-side accumulator (lazy sync)
+        self.moe_dropped_tokens = 0
+        self._load_dev = None  # device-side [E+1] accumulator (lazy sync)
         self._embed_step = None  # lazily compiled (embeddings route)
         self._mm_step = None     # lazily compiled (multimodal prefill)
         # Fused greedy single step (forward + on-device argmax in ONE
@@ -486,7 +510,7 @@ class EngineCore:
 
             packed = (jax.default_backend() == "tpu"
                       and self.mesh is None and not self._mh
-                      and not cfg.is_moe and not _bad_buckets
+                      and not _bad_buckets
                       and _mgo(cfg.num_kv_heads * cfg.head_dim,
                                self.block_size))
         elif packed:
@@ -502,10 +526,6 @@ class EngineCore:
                     "packed_prefill is meshless v1 (the packed step has "
                     "no sharded variant yet); drop packed_prefill or the "
                     "mesh — sharded engines keep the padded plane")
-            if cfg.is_moe:
-                raise ValueError(
-                    "packed_prefill has no MoE variant; MoE models serve "
-                    "prefill through the padded plane")
             if jax.default_backend() == "tpu":
                 from dynamo_tpu.ops.pallas import (
                     mosaic_geometry_ok as _mgo)
@@ -1272,10 +1292,18 @@ class EngineCore:
         matched = self.scheduler.prefix_hit_tokens
         total = matched + self.scheduler.prefix_miss_tokens
         ks.gpu_prefix_cache_hit_rate = matched / total if total else 0.0
-        if self._moe and self.step_count % 32 == 0:
-            # Periodic (not per-step: each snapshot syncs the device).
+        if self._moe and (
+                self.step_count % 32 == 0
+                or (self._load_dev is not None
+                    and not self.scheduler.running
+                    and not self.scheduler.waiting)):
+            # Periodic (not per-step: each snapshot syncs the device) —
+            # plus a drain-edge sync, else a worker whose requests all
+            # finish in < 32 steps never publishes its expert load and
+            # /metrics stays dark until the next burst.
             self.metrics.expert_load = [
                 int(x) for x in self.snapshot_expert_load()]
+            self.metrics.moe_dropped_tokens = self.moe_dropped_tokens
 
     # -- internals --------------------------------------------------------
 
@@ -1299,13 +1327,17 @@ class EngineCore:
 
     def snapshot_expert_load(self) -> Optional[np.ndarray]:
         """Cumulative per-expert assignment counts (None for dense
-        models).  Syncs the device accumulator once per call."""
+        models).  Syncs the device [E+1] stats accumulator once per
+        call, splitting it into the per-expert load vector and the
+        dropped-assignments counter (`moe_dropped_tokens`)."""
         if not self._moe:
             return None
         if self._load_dev is not None:
             self.counters.host_syncs += 1
-            self.expert_load += np.asarray(self._fetch_host(self._load_dev),
-                                           dtype=np.int64)
+            stats = np.asarray(self._fetch_host(self._load_dev),
+                               dtype=np.int64)
+            self.expert_load += stats[:-1]
+            self.moe_dropped_tokens += int(stats[-1])
             self._load_dev = None
         return self.expert_load
 
@@ -1470,13 +1502,17 @@ class EngineCore:
     # -- packed ragged prefill (ISSUE 10) ----------------------------------
 
     def _packed_prefill_fn(self):
-        """Lazily-jitted packed ragged prefill step (donated cache)."""
+        """Lazily-jitted packed ragged prefill step (donated cache).
+        MoE models thread the engine's resolved meshless moe_mode (the
+        packed plane is meshless v1) and return a third output, the
+        [E+1] expert-load stats vector."""
         if self._packed_step is None:
             from dynamo_tpu.models.llama import make_packed_prefill_step
 
             self._packed_step = jax.jit(
-                make_packed_prefill_step(self.config.model,
-                                         self.block_size),
+                make_packed_prefill_step(
+                    self.config.model, self.block_size,
+                    moe_mode=getattr(self, "_moe_mode", "dense")),
                 donate_argnums=(1,))
         return self._packed_step
 
@@ -1542,11 +1578,19 @@ class EngineCore:
         if fl.enabled:
             fl.record("prefill_packed", tokens=T, segs=R, pages=P)
         self._prefill_cost_tokens += sum(w.length for w in items)
-        logits, self.cache = self._packed_prefill_fn()(
+        res = self._packed_prefill_fn()(
             self.params, self.cache, self._dev(tokens),
             self._dev(positions), self._dev(seg_ids), self._dev(bts),
             self._dev(q_starts), self._dev(q_lens), self._dev(seq_lens),
             self._dev(sample_pos))
+        if self._moe:
+            logits, self.cache, load = res
+            # Same lazy-sync discipline as _run_step: accumulate the
+            # [E+1] stats on device, snapshot on the metrics cadence.
+            self._load_dev = (load if self._load_dev is None
+                              else self._load_dev + load)
+        else:
+            logits, self.cache = res
         return self._finish_prefill_items(items, logits, async_first)
 
     @engine_thread_only
@@ -1784,7 +1828,8 @@ class EngineCore:
                     use_pallas_decode=self._use_pallas,
                     dp_attention=self.config.dp_attention,
                     dp_local=self._dp_local,
-                    kv_quant=self.cache_cfg.quantized)
+                    kv_quant=self.cache_cfg.quantized,
+                    moe_mode=getattr(self, "_moe_mode", "auto"))
             else:
                 from dynamo_tpu.models.llama import make_decode_window
 
@@ -1794,6 +1839,7 @@ class EngineCore:
                         self.config.decode_window,
                         use_pallas_decode=self._use_pallas,
                         greedy_only=greedy_only,
+                        moe_mode=getattr(self, "_moe_mode", "dense"),
                         with_expert_load=self._moe),
                     donate_argnums=(1,))
             self._window_fns[greedy_only] = fn

@@ -131,12 +131,17 @@ class ForwardPassMetrics:
     (reference `publisher.rs` ForwardPassMetrics).  `expert_load` carries
     the cumulative per-expert token-assignment counts for MoE engines
     (the expert-distribution surface of reference
-    `sglang/common/base_handlers.py:40-62`); None for dense models."""
+    `sglang/common/base_handlers.py:40-62`); None for dense models.
+    `moe_dropped_tokens` is the capacity-honesty counter: assignments a
+    bounded `ModelConfig.moe_capacity` dropped (0 forever at the exact
+    serving default — a nonzero value means the deployment explicitly
+    traded exactness for dispatch-buffer size)."""
 
     worker_stats: WorkerStats = field(default_factory=WorkerStats)
     kv_stats: KvStats = field(default_factory=KvStats)
     spec_decode_stats: Optional[SpecDecodeStats] = None
     expert_load: Optional[List[int]] = None
+    moe_dropped_tokens: int = 0
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -149,4 +154,5 @@ class ForwardPassMetrics:
             kv_stats=KvStats(**d.get("kv_stats", {})),
             spec_decode_stats=SpecDecodeStats(**spec) if spec else None,
             expert_load=d.get("expert_load"),
+            moe_dropped_tokens=d.get("moe_dropped_tokens", 0),
         )

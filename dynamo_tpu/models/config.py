@@ -41,6 +41,13 @@ class ModelConfig:
     # MoE (Mixtral-style). num_experts == 0 means dense MLP.
     num_experts: int = 0
     num_experts_per_token: int = 2
+    # Per-expert dispatch capacity (tokens per expert per source shard)
+    # for moe_mode="dispatch".  None = exact (nothing can overflow —
+    # serving default).  A bounded capacity trades exactness for a
+    # smaller all-to-all buffer; overflow assignments are DROPPED and
+    # counted in the stats vector's tail slot
+    # (dynamo_moe_dropped_tokens_total), never silent.
+    moe_capacity: Optional[int] = None
     # Tie input embedding and LM head (small models).
     tie_embeddings: bool = False
     # Gemma-family knobs (all default to the Llama conventions):
@@ -72,6 +79,8 @@ class ModelConfig:
             raise ValueError("num_heads must be a multiple of num_kv_heads (GQA)")
         if self.is_moe and self.num_experts_per_token > self.num_experts:
             raise ValueError("num_experts_per_token > num_experts")
+        if self.moe_capacity is not None and self.moe_capacity <= 0:
+            raise ValueError("moe_capacity must be positive (None = exact)")
         if self.activation not in ("silu", "gelu_tanh"):
             raise ValueError(f"unknown activation {self.activation!r}")
 

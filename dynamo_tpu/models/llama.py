@@ -23,9 +23,10 @@ Forward contract (unified prefill/decode, see dynamo_tpu/ops/attention.py):
   attends to all cached context with an absolute-position causal mask, so
   the same compiled function serves prefill, chunked prefill and decode.
 
-MoE layers run either exact dense compute (oracle / single chip) or
-all-to-all token dispatch over the `ep` mesh axis (ops/moe.py) — see
-`_moe_block`.
+MoE layers run the dense | grouped | dispatch ladder (ops/moe.py): the
+exact dense oracle, the meshless grouped-GEMM fast path, or all-to-all
+token dispatch over the `ep` mesh axis (tp-sharding each expert's MLP
+under ep × tp meshes) — see `_moe_block`.
 """
 
 from __future__ import annotations
@@ -428,29 +429,48 @@ def _dense_mlp(p: Params, x: jax.Array,
 
 def _moe_block(cfg: ModelConfig, p: Params, x: jax.Array,
                moe_mode: str, mesh) -> Tuple[jax.Array, jax.Array]:
-    """One MoE layer → (out, expert_load [E]).
+    """One MoE layer → (out, stats [E+1]: per-expert assignment counts
+    plus the dropped-assignments tail slot — ops/moe.py contract).
 
-    moe_mode "dense": exact dense-compute (oracle; expert einsums carry an
-    explicit E axis so an `ep` mesh axis can shard them under GSPMD).
-    moe_mode "dispatch": all-to-all token dispatch under shard_map over the
-    mesh's dp/ep axes (ops/moe.py) — the E/k FLOP waste of dense compute
-    goes away; requires tp == 1 (validated in parallel/sharding.py)."""
+    The mode ladder (parallel/sharding.resolve_moe_mode):
+    - "dense": exact dense-compute (oracle; expert einsums carry an
+      explicit E axis so an `ep` mesh axis can shard them under GSPMD).
+    - "grouped": meshless fast path — ragged grouped GEMM over
+      expert-sorted assignments (ops/pallas/moe_grouped.py), exact and
+      byte-identical to the dense oracle.
+    - "dispatch": all-to-all token dispatch under shard_map over the
+      mesh's dp/ep axes; under ep × tp meshes each expert's MLP is
+      additionally tp-sharded on the intermediate dim (partial down
+      projection + psum inside the body).  Capacity comes from
+      `cfg.moe_capacity` (None = exact, the serving default; bounded
+      capacities drop overflow assignments into the counted tail)."""
     from dynamo_tpu.ops import moe as moe_ops
 
-    if moe_mode == "dense" or mesh is None:
+    if mesh is None:
+        if moe_mode == "grouped":
+            return moe_ops.moe_grouped(
+                cfg, p, x, interpret=jax.default_backend() != "tpu")
+        return moe_ops.moe_dense(cfg, p, x)
+    if moe_mode == "dense":
         return moe_ops.moe_dense(cfg, p, x)
 
     from jax.sharding import PartitionSpec as P
 
+    # tp > 1: expert weight slices arrive F-sharded ([E_local, H, F/tp] /
+    # [E_local, F/tp, H]) and the body psums the partial down projection.
+    # tp == 1 keeps the exact pre-ISSUE-17 program (specs with a size-1
+    # "tp" axis partition nothing and tp_axis=None adds no collective).
+    tp_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
     wrapped = shard_map(
         lambda xs, ps: moe_ops.moe_dispatch(
-            cfg, ps, xs, ep_axis="ep", load_psum_axes=("dp", "ep")),
+            cfg, ps, xs, capacity=cfg.moe_capacity, ep_axis="ep",
+            load_psum_axes=("dp", "ep"), tp_axis=tp_axis),
         mesh=mesh,
         in_specs=(P(("dp", "ep"), None, None),
                   {"router": P(None, None),
-                   "w_gate": P("ep", None, None),
-                   "w_up": P("ep", None, None),
-                   "w_down": P("ep", None, None)}),
+                   "w_gate": P("ep", None, "tp"),
+                   "w_up": P("ep", None, "tp"),
+                   "w_down": P("ep", "tp", None)}),
         out_specs=(P(("dp", "ep"), None, None), P(None)),
         check_vma=False,
     )
@@ -541,7 +561,8 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
             return cache, nxt, out.at[i].set(nxt), load
 
         out0 = jnp.zeros((window, B), jnp.int32)
-        load0 = jnp.zeros((cfg.num_experts,), jnp.int32) \
+        # [E+1]: per-expert counts + dropped tail (ops/moe.py contract).
+        load0 = jnp.zeros((cfg.num_experts + 1,), jnp.int32) \
             if with_expert_load else jnp.zeros((), jnp.int32)
         cache, _, out, load = jax.lax.fori_loop(
             0, window, body, (cache, last_tokens, out0, load0))
@@ -557,7 +578,8 @@ def make_decode_window(cfg: ModelConfig, block_size: int, window: int,
 # Packed ragged prefill
 
 
-def make_packed_prefill_step(cfg: ModelConfig, block_size: int):
+def make_packed_prefill_step(cfg: ModelConfig, block_size: int,
+                             moe_mode: str = "dense"):
     """Build the packed ragged prefill step (ISSUE 10 tentpole leg 2).
 
     Several sequences' prefill chunks ride ONE flat `[T]` token axis
@@ -591,14 +613,14 @@ def make_packed_prefill_step(cfg: ModelConfig, block_size: int):
 
     int8 pools route through the kernel's dequant-in-VMEM variant
     (static branch on the cache pytree, like the padded step).  MoE
-    models keep the padded plane (no packed MoE variant); the engine
-    enforces that.  The kernel runs in interpret mode off-TPU, so the
-    packed plane is CPU-testable like the decode kernel.
+    models compose (ISSUE 17 killed the old exclusion): the packed
+    [1, T, H] hidden rides `_moe_block` with the meshless `moe_mode`
+    ("dense" oracle or "grouped" fast path — packed prefill is a
+    meshless-engine plane) and the step returns a THIRD output, the
+    [E+1] expert-load stats vector.  The kernel runs in interpret mode
+    off-TPU, so the packed plane is CPU-testable like the decode kernel.
     """
     cfg.validate()
-    if cfg.is_moe:
-        raise ValueError("packed prefill has no MoE variant; MoE models "
-                         "serve prefill through the padded plane")
     from dynamo_tpu.ops.pallas import paged_prefill_attention
 
     def step(params, cache, tokens, positions, seg_ids, block_tables,
@@ -621,6 +643,8 @@ def make_packed_prefill_step(cfg: ModelConfig, block_size: int):
                      else [None] * cfg.num_layers)
         vs_layers = (list(cache["v_scale"]) if quant
                      else [None] * cfg.num_layers)
+        expert_load = jnp.zeros(
+            (cfg.num_experts + 1 if cfg.is_moe else 1,), jnp.int32)
         off = cfg.rms_offset
         for i, layer in enumerate(params["layers"]):
             p_attn = layer["attn"]
@@ -658,11 +682,17 @@ def make_packed_prefill_step(cfg: ModelConfig, block_size: int):
                                 cfg.rms_norm_eps, off)
             x = x + attn
             h = rms_norm(x, layer["mlp_norm"], cfg.rms_norm_eps, off)
-            mlp_out = _dense_mlp(layer["mlp"], h, cfg.activation)
-            if cfg.post_norms:
-                mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"],
-                                   cfg.rms_norm_eps, off)
-            x = x + mlp_out
+            if cfg.is_moe:
+                moe_out, load = _moe_block(cfg, layer["moe"], h,
+                                           moe_mode, None)
+                x = x + moe_out
+                expert_load = expert_load + load
+            else:
+                mlp_out = _dense_mlp(layer["mlp"], h, cfg.activation)
+                if cfg.post_norms:
+                    mlp_out = rms_norm(mlp_out, layer["post_mlp_norm"],
+                                       cfg.rms_norm_eps, off)
+                x = x + mlp_out
 
         x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, off)
         # LM head on one packed row per segment ([R, H] @ [H, V]).
@@ -678,6 +708,8 @@ def make_packed_prefill_step(cfg: ModelConfig, block_size: int):
         if quant:
             new_cache["k_scale"] = ks_layers
             new_cache["v_scale"] = vs_layers
+        if cfg.is_moe:
+            return logits, new_cache, expert_load
         return logits, new_cache
 
     return step
@@ -705,11 +737,13 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
     gathered-context XLA path (chunk length is static at trace time, so
     the same factory serves both prefill and decode compilations).
 
-    MoE: `moe_mode` "dense" (exact oracle) or "dispatch" (all-to-all over
-    the mesh's ep axis — needs `mesh`).  `with_expert_load=True` makes the
-    step return (logits, cache, expert_load[E]) — the telemetry the
-    reference exposes per worker (`base_handlers.py:40-62`); the default
-    2-tuple return keeps every non-MoE call site unchanged.
+    MoE: `moe_mode` "dense" (exact oracle), "grouped" (meshless ragged
+    grouped GEMM) or "dispatch" (all-to-all over the mesh's ep axis —
+    needs `mesh`).  `with_expert_load=True` makes the step return
+    (logits, cache, stats[E+1]) — per-expert assignment counts plus the
+    dropped-assignments tail, the telemetry the reference exposes per
+    worker (`base_handlers.py:40-62`); the default 2-tuple return keeps
+    every non-MoE call site unchanged.
 
     `sp_ring`: sequence-parallel FULL-PROMPT prefill — the T axis shards
     over the mesh's sp axis and attention runs on the ICI ring
@@ -768,7 +802,8 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                      else [None] * cfg.num_layers)
         vs_layers = (list(cache["v_scale"]) if quant
                      else [None] * cfg.num_layers)
-        expert_load = jnp.zeros((max(cfg.num_experts, 1),), jnp.int32)
+        expert_load = jnp.zeros(
+            (cfg.num_experts + 1 if cfg.is_moe else 1,), jnp.int32)
         off = cfg.rms_offset
         for i, layer in enumerate(params["layers"]):
             (attn_out, k_layers[i], v_layers[i],

@@ -1,4 +1,4 @@
-"""Mixture-of-Experts compute paths: dense oracle + all-to-all dispatch.
+"""Mixture-of-Experts compute paths: the dense | grouped | dispatch ladder.
 
 The reference orchestrates wide-EP engines (SGLang wide-EP container,
 `container/Dockerfile.sglang-wideep`; expert-distribution telemetry
@@ -7,24 +7,39 @@ The reference orchestrates wide-EP engines (SGLang wide-EP container,
 first-class compute path (SURVEY §2.5 row "EP / MoE"):
 
 - `moe_dense` — every device runs ALL tokens through its local experts and
-  zero-gates the non-selected ones.  Always exact; the CPU-test oracle and
-  the single-chip path.  Costs E/k× the minimal FLOPs (VERDICT r2 weak #4)
-  — that waste is precisely what dispatch removes.
+  zero-gates the non-selected ones.  Always exact; the CPU-test oracle.
+  Costs E/k× the minimal FLOPs *and weight bytes* (VERDICT r2 weak #4) —
+  that waste is precisely what the other two rungs remove.
+- `moe_grouped` — the single-chip/per-shard fast path: assignments are
+  sorted by expert on device, each expert's group padded to a row-tile
+  multiple, and ONE ragged grouped GEMM (ops/pallas/moe_grouped.py)
+  runs only the selected (token, expert) work, streaming each active
+  expert's weights HBM→VMEM once in the decode regime.  bf16/f32
+  weights or the int8-weight pytree (`quantize_moe_params` — static
+  structure branch, same discipline as kv_quant).
 - `moe_dispatch` — Switch-Transformer-style token dispatch with a STATIC
   per-expert capacity (XLA needs fixed shapes): tokens are scattered into
   per-expert buffers, `jax.lax.all_to_all` moves buffers to the shard
   owning each expert over the `ep` mesh axis, local experts run one
   batched einsum, and a second all_to_all brings outputs home for the
-  gate-weighted combine.
+  gate-weighted combine.  Under ep × tp meshes each expert's MLP is
+  additionally tp-sharded on the intermediate dim (`tp_axis`): gate/up
+  project into a local F/tp slice, the down projection partial-sums, and
+  one psum over tp completes it — tokens and routing stay replicated
+  across tp, the all_to_all stays an ep-only collective.
 - Capacity semantics: `capacity` = tokens per expert per source shard.
   With `capacity >= tokens_per_shard` routing is EXACT (an expert can
   receive at most every local token once — top-k choices are distinct
   experts).  Smaller capacities drop overflow assignments (their gate
   mass is lost, Switch convention): the throughput/exactness knob is the
-  deployment's, not the kernel's — serving defaults to exact.
+  deployment's (`ModelConfig.moe_capacity`), not the kernel's — serving
+  defaults to exact, and drops are COUNTED, never silent.
 
-Expert-load telemetry: both paths return per-expert assignment counts so
-the worker can publish the expert-distribution the reference exposes.
+Expert-load telemetry: every path returns an int32 stats vector of
+length E+1 — per-expert assignment counts plus a dropped-assignments
+tail slot (always 0 for the exact paths) — so the worker can publish
+the expert distribution the reference exposes AND an honest drop
+counter when a bounded capacity is configured.
 """
 
 from __future__ import annotations
@@ -35,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.runtime import jax_compat
+from dynamo_tpu.runtime.contracts import hot_path
 
 from dynamo_tpu.models.config import ModelConfig
 
@@ -59,33 +75,142 @@ def expert_ffn(p_moe: Params, h: jax.Array) -> jax.Array:
     return jnp.einsum("ecf,efh->ech", up, p_moe["w_down"])
 
 
+def _with_drop_tail(load: jax.Array, dropped=None) -> jax.Array:
+    """[E] per-expert counts → [E+1] stats vector with the dropped-
+    assignments tail slot (0 for exact paths)."""
+    tail = (jnp.zeros((1,), jnp.int32) if dropped is None
+            else jnp.reshape(dropped.astype(jnp.int32), (1,)))
+    return jnp.concatenate([load.astype(jnp.int32), tail])
+
+
 def moe_dense(cfg: ModelConfig, p_moe: Params, x: jax.Array
               ) -> Tuple[jax.Array, jax.Array]:
-    """Exact dense-compute MoE.  x: [B, T, H] → (out, expert_load [E])."""
+    """Exact dense-compute MoE.  x: [B, T, H] → (out, stats [E+1]).
+
+    Routing/gating go through the SAME `router_topk` the grouped and
+    dispatch paths use (not a masked full-E softmax, whose tie handling
+    at the k-th logit differs — bf16 actually produces such ties), and
+    the combine reduces over the k selected experts in EXPERT-INDEX
+    order — the one combine structure every path in this module shares,
+    which is what lets the grouped output be byte-identical to this
+    oracle instead of 1 ulp away."""
     B, T, H = x.shape
-    logits = (x @ p_moe["router"]).astype(jnp.float32)       # [B, T, E]
-    k = cfg.num_experts_per_token
-    top_vals, top_idx = jax.lax.top_k(logits, k)
-    kth = top_vals[..., -1:]
-    masked = jnp.where(logits >= kth, logits, -jnp.inf)
-    gates = jax.nn.softmax(masked, axis=-1).astype(x.dtype)  # [B, T, E]
+    top_idx, gates = router_topk(cfg, p_moe, x.reshape(B * T, H))
+    top_idx = top_idx.reshape(B, T, -1)                      # [B, T, k]
+    gates = gates.reshape(B, T, -1)
 
     hidden = jax.nn.silu(jnp.einsum("bth,ehf->betf", x, p_moe["w_gate"]))
     hidden = hidden * jnp.einsum("bth,ehf->betf", x, p_moe["w_up"])
     expert_out = jnp.einsum("betf,efh->beth", hidden, p_moe["w_down"])
-    out = jnp.einsum("beth,bte->bth", expert_out, gates)
+    kord = jnp.argsort(top_idx, axis=-1, stable=True)        # [B, T, k]
+    idx_sorted = jnp.take_along_axis(top_idx, kord, axis=-1)
+    picked = jnp.take_along_axis(
+        expert_out.transpose(0, 2, 1, 3),                    # [B, T, E, H]
+        idx_sorted[..., None], axis=2)                       # [B, T, k, H]
+    g_sel = jnp.take_along_axis(gates, kord, axis=-1)        # [B, T, k]
+    out = jnp.einsum("btkh,btk->bth", picked, g_sel)
     load = jnp.sum(
         jax.nn.one_hot(top_idx, cfg.num_experts, dtype=jnp.int32),
         axis=(0, 1, 2))
-    return out, load
+    return out, _with_drop_tail(load)
 
 
+@hot_path
+def moe_grouped(cfg: ModelConfig, p_moe: Params, x: jax.Array,
+                *, block_rows: Optional[int] = None,
+                interpret: bool = False
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Grouped-GEMM MoE (the single-chip fast path).  x: [B, T, H] →
+    (out, stats [E+1]).  Exact — no capacity, nothing dropped.
+
+    Device-side plumbing around ops/pallas/moe_grouped.py:
+    sort the N*k (token, expert) assignments by expert (stable argsort),
+    pad each expert's group to a `block_rows` multiple (padding rows are
+    zero and compute harmless zeros), hand the packed buffer plus a
+    tile→expert map to the ragged kernel, then gather each assignment's
+    output row back and combine with the top-k gates — the same
+    f32-free, x-dtype combine `moe_dense`'s gate einsum performs, which
+    is what keeps the two paths byte-comparable."""
+    from dynamo_tpu.ops.pallas.moe_grouped import (
+        DEFAULT_BLOCK_ROWS, grouped_expert_ffn, moe_params_quantized)
+
+    B, T, H = x.shape
+    N = B * T
+    E = cfg.num_experts
+    k = cfg.num_experts_per_token
+    bm = block_rows or DEFAULT_BLOCK_ROWS
+    S = N * k
+
+    x2 = x.reshape(N, H)
+    expert_ids, gates = router_topk(cfg, p_moe, x2)          # [N, k]
+    flat_e = expert_ids.reshape(-1)                          # [S]
+    counts = jnp.sum(
+        jax.nn.one_hot(flat_e, E, dtype=jnp.int32), axis=0)  # [E]
+
+    # Static padded buffer: each expert's group rounds up to bm rows, so
+    # the total is at most S + E*(bm-1), itself rounded to a bm multiple.
+    padded = -(-counts // bm) * bm                           # [E]
+    S_pad = max(bm, (S + E * (bm - 1)) // bm * bm)
+    n_tiles = S_pad // bm
+    pend = jnp.cumsum(padded)                                # [E]
+    offs = pend - padded                                     # exclusive
+
+    # Destination row of each assignment: its expert's group offset plus
+    # its rank within the expert (ranks read off the stable sort).
+    order = jnp.argsort(flat_e, stable=True)                 # [S]
+    es = flat_e[order]
+    rank = (jnp.arange(S, dtype=jnp.int32)
+            - (jnp.cumsum(counts) - counts)[es])
+    dest_sorted = offs[es] + rank                            # [S]
+    token_of = jnp.repeat(jnp.arange(N, dtype=jnp.int32), k)
+    x_pad = jnp.zeros((S_pad, H), x.dtype).at[dest_sorted].set(
+        x2[token_of[order]])
+
+    # tile→expert map (scalar prefetch): the expert whose padded span
+    # covers the tile's first row.  Tiles past the last span clamp to
+    # E-1 and chew zeros nobody gathers.
+    tile_expert = jnp.clip(
+        jnp.searchsorted(pend, jnp.arange(n_tiles, dtype=jnp.int32) * bm,
+                         side="right"),
+        0, E - 1).astype(jnp.int32)
+
+    kw = {}
+    if moe_params_quantized(p_moe):
+        kw = {"w_gate_scale": p_moe["w_gate_scale"],
+              "w_up_scale": p_moe["w_up_scale"],
+              "w_down_scale": p_moe["w_down_scale"]}
+    y_pad = grouped_expert_ffn(
+        x_pad, tile_expert, p_moe["w_gate"], p_moe["w_up"],
+        p_moe["w_down"], block_rows=bm, interpret=interpret, **kw)
+
+    # Gather each assignment's output back and gate-combine.  The k
+    # choices are re-sorted by EXPERT INDEX first: the dense oracle's
+    # combine einsum reduces over the expert axis in index order (an FMA
+    # chain where the zero-gated terms are exact no-ops), and matching
+    # that accumulation order is what makes the two paths byte-identical
+    # rather than 1-ulp apart.
+    dest = jnp.zeros((S,), jnp.int32).at[order].set(dest_sorted)
+    kord = jnp.argsort(expert_ids, axis=1, stable=True)      # [N, k]
+    picked = jnp.take_along_axis(
+        y_pad[dest].reshape(N, k, H), kord[:, :, None], axis=1)
+    g_ord = jnp.take_along_axis(gates.reshape(N, k), kord, axis=1)
+    out = jnp.einsum("nkh,nk->nh", picked, g_ord)
+    return out.reshape(B, T, H).astype(x.dtype), _with_drop_tail(counts)
+
+
+@hot_path
 def _dispatch_one_shard(cfg: ModelConfig, p_moe: Params, x: jax.Array,
-                        capacity: int, ep_axis: Optional[str]
+                        capacity: int, ep_axis: Optional[str],
+                        tp_axis: Optional[str] = None
                         ) -> Tuple[jax.Array, jax.Array]:
     """Per-shard dispatch body.  x: [N, H] local tokens; expert weights
     local slices [E_local, ...].  Runs standalone (ep_axis None → E_local
-    == E, no collective) or inside shard_map over `ep_axis`."""
+    == E, no collective) or inside shard_map over `ep_axis`.  With
+    `tp_axis`, each expert's MLP is additionally tp-sharded on the
+    intermediate dim: the weight slices are [E_local, H, F/tp] /
+    [E_local, F/tp, H], the down projection produces a partial sum, and
+    ONE psum over tp completes it — tokens, routing and the all_to_all
+    are tp-replicated, so the collective stays ep-only."""
     N, H = x.shape
     E = cfg.num_experts
     k = cfg.num_experts_per_token
@@ -103,6 +228,7 @@ def _dispatch_one_shard(cfg: ModelConfig, p_moe: Params, x: jax.Array,
     pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1) - 1  # [N*k]
     keep = pos < C
     load = onehot.sum(0)                                     # [E] pre-drop
+    dropped = jnp.sum(~keep).astype(jnp.int32)               # capacity honesty
 
     token_of = jnp.repeat(jnp.arange(N, dtype=jnp.int32), k)
     # Scatter kept tokens into per-destination-expert buffers.  Dropped
@@ -124,6 +250,10 @@ def _dispatch_one_shard(cfg: ModelConfig, p_moe: Params, x: jax.Array,
         h_in = send                                          # [E, C, H]
 
     h_out = expert_ffn(p_moe, h_in)                          # [E_l, ep*C, H]
+    if tp_axis is not None:
+        # F-sharded expert MLPs: each tp member computed a partial down
+        # projection over its F/tp slice.
+        h_out = jax.lax.psum(h_out, tp_axis)
 
     if ep_axis is not None and ep > 1:
         back = h_out.reshape(E_local, ep, C, H).transpose(1, 0, 2, 3)
@@ -140,27 +270,32 @@ def _dispatch_one_shard(cfg: ModelConfig, p_moe: Params, x: jax.Array,
     picked = jnp.where(keep[:, None], picked, 0).reshape(N, k, H)
     out = jnp.einsum("nkh,nk->nh", picked.astype(jnp.float32),
                      gates.reshape(N, k).astype(jnp.float32))
-    return out.astype(x.dtype), load
+    return out.astype(x.dtype), _with_drop_tail(load, dropped)
 
 
 def moe_dispatch(cfg: ModelConfig, p_moe: Params, x: jax.Array,
                  capacity: Optional[int] = None,
                  ep_axis: Optional[str] = None,
-                 load_psum_axes: Tuple[str, ...] = ()
+                 load_psum_axes: Tuple[str, ...] = (),
+                 tp_axis: Optional[str] = None
                  ) -> Tuple[jax.Array, jax.Array]:
-    """All-to-all MoE.  x: [B, T, H] → (out [B, T, H], expert_load [E]).
+    """All-to-all MoE.  x: [B, T, H] → (out [B, T, H], stats [E+1]).
 
     Call either outside any mesh (single shard, `ep_axis=None`) or inside
     `shard_map` with the token batch sharded over `ep_axis` (and possibly
-    dp) and expert weights' E axis sharded over `ep_axis`.
-    `load_psum_axes`: mesh axes to sum the per-shard expert counts over so
-    the returned load is the global distribution (replicated)."""
+    dp) and expert weights' E axis sharded over `ep_axis`.  `tp_axis`:
+    the mesh axis each expert MLP's intermediate dim is sharded over
+    (ep × tp meshes) — see _dispatch_one_shard.
+    `load_psum_axes`: mesh axes to sum the per-shard stats over so the
+    returned load/dropped counts are the global distribution
+    (replicated).  NEVER include tp_axis here — routing is tp-replicated
+    and summing over tp would multiply every count by tp."""
     B, T, H = x.shape
     N = B * T
     if capacity is None:
         capacity = N  # exact: no assignment can overflow
-    out, load = _dispatch_one_shard(
-        cfg, p_moe, x.reshape(N, H), capacity, ep_axis)
+    out, stats = _dispatch_one_shard(
+        cfg, p_moe, x.reshape(N, H), capacity, ep_axis, tp_axis)
     if load_psum_axes:
-        load = jax.lax.psum(load, load_psum_axes)
-    return out.reshape(B, T, H), load
+        stats = jax.lax.psum(stats, load_psum_axes)
+    return out.reshape(B, T, H), stats

@@ -7,6 +7,13 @@ budget goes where it pays: paged-attention decode, which would otherwise
 materialise a full gathered context per step.
 """
 
+from dynamo_tpu.ops.pallas.moe_grouped import (
+    dequantize_moe_params,
+    grouped_expert_ffn,
+    moe_grouped_geometry_ok,
+    moe_params_quantized,
+    quantize_moe_params,
+)
 from dynamo_tpu.ops.pallas.paged_attention import (
     mosaic_geometry_ok,
     paged_decode_attention,
@@ -17,4 +24,7 @@ from dynamo_tpu.ops.pallas.paged_prefill import (
 )
 
 __all__ = ["paged_decode_attention", "paged_prefill_attention",
-           "mosaic_geometry_ok", "PACK_ALIGN"]
+           "mosaic_geometry_ok", "PACK_ALIGN",
+           "grouped_expert_ffn", "moe_grouped_geometry_ok",
+           "quantize_moe_params", "dequantize_moe_params",
+           "moe_params_quantized"]

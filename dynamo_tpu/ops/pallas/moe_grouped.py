@@ -1,0 +1,252 @@
+"""Grouped MoE expert-FFN Pallas kernel (the MoE fast-decode compute).
+
+`moe_dense` (ops/moe.py) runs EVERY expert over EVERY token and
+zero-gates the non-selected ones — E/k× the minimal FLOPs and, worse for
+decode, E/k× the minimal HBM weight traffic (decode MoE is weight-
+bandwidth-bound exactly like decode attention is KV-bandwidth-bound).
+This kernel computes only the selected (token, expert) assignments:
+
+- the caller sorts assignments by expert on device and pads each expert's
+  group to a `block_rows` multiple (ops/moe.py moe_grouped — sort /
+  scatter / combine live there; this module is just the ragged GEMM);
+- the grid walks row tiles; a scalar-prefetch `tile_expert` map drives
+  the weight BlockSpec index_maps, so consecutive tiles of the same
+  expert REUSE the VMEM-resident weight block (Pallas skips the DMA when
+  the block index repeats) — in the decode regime (≤ block_rows
+  assignments per expert) each active expert's weights stream HBM→VMEM
+  exactly once, and experts with no assigned tokens are never read;
+- the intermediate dim F is blocked (`block_f`) with an f32 VMEM
+  accumulator so serving-size experts (H×F ≫ VMEM) still fit: per grid
+  step the kernel holds one [H, bf] gate/up slice, one [bf, H] down
+  slice, and the [bm, H] accumulator.
+
+int8-weight variant (mirrors the PR 6 KV-cache discipline): expert
+weights quantize per-expert-per-output-column (`quantize_moe_params`),
+the int8 blocks and their f32 scale slivers DMA together, and
+dequantization happens on the VMEM-resident block — HBM weight traffic
+halves vs bf16.  Dequant reproduces `dequantize_moe_params` numerics
+element-for-element, so the grouped int8 output is byte-identical to
+`moe_dense` run on the host-dequantized weights.
+
+Numerics contract: each matmul accumulates in f32 and casts back to the
+activation dtype (`preferred_element_type` then `.astype`), mirroring
+what XLA's einsum does inside `moe_dense` — with a single F block (the
+tiny CPU test geometry) the grouped output is byte-identical to the
+dense oracle's per-expert outputs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Row-tile default: big enough that one MXU pass amortises the weight
+# DMA, small enough that a decode batch (N*k assignments over E experts)
+# doesn't drown in per-expert padding.
+DEFAULT_BLOCK_ROWS = 64
+# VMEM budget for the weight working set (gate + up [H, bf] + down
+# [bf, H], double-buffered by the pipeline) — leave most of ~16 MB for
+# the accumulator and the compiler's own staging.
+_WEIGHT_BUDGET = 8 * 1024 * 1024
+_TARGET_BLOCK_F = 2048
+
+
+def moe_grouped_geometry_ok(hidden: int, intermediate: int,
+                            itemsize: int = 2,
+                            block_rows: int = DEFAULT_BLOCK_ROWS) -> bool:
+    """THE Mosaic eligibility rule for the grouped kernel, shared by
+    every auto-selection site (engine moe_mode auto, profile_decode
+    --moe, bench/moe_decode) — same discipline as
+    `mosaic_geometry_ok` for the attention kernels.  Lane dims (H for
+    the row tiles and the down-projection, F for gate/up) must be
+    128-aligned and the row tile 8-aligned; the smallest F block must
+    fit the weight budget."""
+    return (hidden % 128 == 0 and intermediate % 128 == 0
+            and block_rows % 8 == 0
+            and 2 * 3 * hidden * min(intermediate, 128) * itemsize
+            <= _WEIGHT_BUDGET)
+
+
+def auto_block_f(hidden: int, intermediate: int, itemsize: int = 2) -> int:
+    """F-block sizing: grow toward `_TARGET_BLOCK_F` (fewer accumulator
+    passes), halve while the double-buffered gate+up+down working set
+    would exceed the weight budget, floor at the 128 lane quantum."""
+    bf = min(intermediate, _TARGET_BLOCK_F)
+    while bf > 128 and 2 * 3 * hidden * bf * itemsize > _WEIGHT_BUDGET:
+        bf //= 2
+    return bf
+
+
+def _ffn_kernel(n_blocks_f: int, quant: bool,
+                # scalar prefetch
+                te_ref,
+                # inputs
+                x_ref, wg_ref, wu_ref, wd_ref, *rest):
+    if quant:
+        sg_ref, su_ref, sd_ref, o_ref, acc = rest
+    else:
+        o_ref, acc = rest
+        sg_ref = su_ref = sd_ref = None
+    f = pl.program_id(1)
+    x = x_ref[...]                                   # [bm, H]
+
+    def load_w(ref, s_ref):
+        w = ref[0]                                   # [H, bf] / [bf, H]
+        if not quant:
+            return w
+        # Dequant on the VMEM-resident block, reproducing
+        # dequantize_moe_params element-for-element: f32 multiply by the
+        # per-output-column scale, then cast to the activation dtype.
+        return (w.astype(jnp.float32) * s_ref[...]).astype(x.dtype)
+
+    wg = load_w(wg_ref, sg_ref)                      # [H, bf]
+    wu = load_w(wu_ref, su_ref)                      # [H, bf]
+    wd = load_w(wd_ref, sd_ref)                      # [bf, H]
+    # f32 MXU accumulation then cast back to the activation dtype —
+    # exactly what XLA does inside moe_dense's einsums, which is what
+    # makes the grouped output byte-comparable to the oracle.
+    h = jnp.dot(x, wg, preferred_element_type=jnp.float32).astype(x.dtype)
+    u = jnp.dot(x, wu, preferred_element_type=jnp.float32).astype(x.dtype)
+    act = jax.nn.silu(h) * u                         # [bm, bf]
+    # Pin the activation's cast-to-x-dtype rounding: fused end-to-end,
+    # XLA would elide the bf16 round-trip into the next matmul's f32
+    # upcast, putting the kernel 1 ulp off the oracle (whose einsums
+    # materialise each intermediate).
+    act = jax.lax.optimization_barrier(act)
+    part = jax.lax.dot_general(
+        act, wd, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [bm, H] f32
+
+    @pl.when(f == 0)
+    def _():
+        acc[...] = part
+
+    @pl.when(f > 0)
+    def _():
+        acc[...] += part
+
+    @pl.when(f == n_blocks_f - 1)
+    def _():
+        o_ref[...] = acc[...].astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_rows", "block_f", "interpret"))
+def grouped_expert_ffn(
+    x_pad: jax.Array,        # [S_pad, H] expert-sorted, group-padded rows
+    tile_expert: jax.Array,  # [S_pad // block_rows] int32 tile→expert map
+    w_gate: jax.Array,       # [E, H, F] (bf16/f32, or int8 with scales)
+    w_up: jax.Array,         # [E, H, F]
+    w_down: jax.Array,       # [E, F, H]
+    *,
+    w_gate_scale: Optional[jax.Array] = None,  # [E, F] f32 (int8 weights)
+    w_up_scale: Optional[jax.Array] = None,    # [E, F] f32
+    w_down_scale: Optional[jax.Array] = None,  # [E, H] f32
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    block_f: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ragged grouped expert FFN: row tile t runs expert
+    `tile_expert[t]`'s SwiGLU MLP.  Returns [S_pad, H] in x's dtype.
+    Padding rows are all-zero by construction (ops/moe.py) and compute
+    harmless zeros that the caller never gathers."""
+    S_pad, H = x_pad.shape
+    E, _, F = w_gate.shape
+    quant = w_gate_scale is not None
+    if quant != (w_up_scale is not None) or quant != (
+            w_down_scale is not None):
+        raise ValueError("pass all three weight scales or none")
+    if quant and w_gate.dtype != jnp.int8:
+        raise ValueError(f"scales imply int8 weights; got {w_gate.dtype}")
+    if S_pad % block_rows:
+        raise ValueError(
+            f"S_pad={S_pad} must be a block_rows={block_rows} multiple")
+    itemsize = jnp.dtype(w_gate.dtype).itemsize
+    if not interpret and not moe_grouped_geometry_ok(
+            H, F, itemsize, block_rows):
+        raise ValueError(
+            f"grouped MoE kernel needs H % 128 == 0, F % 128 == 0 and "
+            f"block_rows % 8 == 0; got H={H}, F={F}, "
+            f"block_rows={block_rows} (use moe_mode='dense' for this "
+            "geometry)")
+    if block_f is None:
+        block_f = min(F, auto_block_f(H, F, itemsize)) if not interpret \
+            else F
+    if F % block_f:
+        raise ValueError(f"F={F} must divide by block_f={block_f}")
+    nf = F // block_f
+    T = S_pad // block_rows
+
+    # Index maps see the scalar-prefetch tile_expert array: consecutive
+    # tiles of one expert map to the SAME weight block, so the pipeline
+    # skips the refetch — the "stream each expert's weights exactly
+    # once" property in the decode regime.
+    in_specs = [
+        pl.BlockSpec((block_rows, H), lambda t, f, te: (t, 0)),
+        pl.BlockSpec((1, H, block_f), lambda t, f, te: (te[t], 0, f)),
+        pl.BlockSpec((1, H, block_f), lambda t, f, te: (te[t], 0, f)),
+        pl.BlockSpec((1, block_f, H), lambda t, f, te: (te[t], f, 0)),
+    ]
+    inputs = [tile_expert, x_pad, w_gate, w_up, w_down]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, block_f), lambda t, f, te: (te[t], f)),
+            pl.BlockSpec((1, block_f), lambda t, f, te: (te[t], f)),
+            pl.BlockSpec((1, H), lambda t, f, te: (te[t], 0)),
+        ]
+        inputs += [w_gate_scale, w_up_scale, w_down_scale]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(T, nf),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_rows, H), lambda t, f, te: (t, 0)),
+        scratch_shapes=[pltpu.VMEM((block_rows, H), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_ffn_kernel, nf, quant),
+        out_shape=jax.ShapeDtypeStruct((S_pad, H), x_pad.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(*inputs)
+
+
+# -- int8 expert weights (static params-pytree branch, like kv_quant) ----
+
+def moe_params_quantized(p_moe: dict) -> bool:
+    """Static branch predicate: quantized expert params carry sibling
+    `*_scale` entries (the same pytree-shape discipline the int8 KV
+    cache uses — the compiled program branches on structure, never on
+    values)."""
+    return "w_gate_scale" in p_moe
+
+
+def quantize_moe_params(p_moe: dict) -> dict:
+    """int8-quantize the expert weights per-expert-per-output-column
+    (absmax over the contraction dim), keeping the router full-precision
+    — routing decides token placement and is tiny.  Returns a new pytree
+    with int8 `w_gate`/`w_up`/`w_down` plus f32 `*_scale` siblings."""
+    out = {"router": p_moe["router"]}
+    for name in ("w_gate", "w_up", "w_down"):
+        w = p_moe[name].astype(jnp.float32)          # [E, in, out]
+        scale = jnp.maximum(jnp.max(jnp.abs(w), axis=1) / 127.0, 1e-8)
+        out[name] = jnp.round(w / scale[:, None, :]).astype(jnp.int8)
+        out[name + "_scale"] = scale                 # [E, out]
+    return out
+
+
+def dequantize_moe_params(p_moe: dict, dtype) -> dict:
+    """Host-side inverse (the oracle path): reproduces the kernel's
+    in-VMEM dequant element-for-element, so `moe_dense` on the result is
+    the byte-exact reference for the grouped int8 output."""
+    out = {"router": p_moe["router"]}
+    for name in ("w_gate", "w_up", "w_down"):
+        out[name] = (p_moe[name].astype(jnp.float32)
+                     * p_moe[name + "_scale"][:, None, :]).astype(dtype)
+    return out

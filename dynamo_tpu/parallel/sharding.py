@@ -20,7 +20,7 @@ rather than silently replicated.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _dc_replace
 from typing import Dict, Optional
 
 import jax
@@ -59,6 +59,11 @@ class PlaneSpec:
       kernel inside shard_map.
     - `dp_attention` / `dp_local`: batch-sharded attention with
       slot-sharded KV, optionally with page locality.
+    - `moe`: the model has expert layers.  MoE composes with the decode
+      window, the fused greedy step, int8 KV and packed prefill (ISSUE
+      17 killed those exclusions); the genuinely-impossible combos
+      (moe × pp stacked layout, moe × ring-SP) are declared in
+      `plane_capability`, not hand-gated in the engine.
     - `role`: "decode" (the unified step family), "embed"
       (return_hidden), "mm" (input-embeds prefill), "sp_prefill"
       (ring-SP whole-prompt prefill).
@@ -72,6 +77,7 @@ class PlaneSpec:
     use_pallas: bool = False
     dp_attention: bool = False
     dp_local: bool = False
+    moe: bool = False
     role: str = "decode"
 
 
@@ -126,6 +132,21 @@ def plane_capability(mesh: Optional[Mesh], plane: PlaneSpec,
         return no("pipeline parallelism under a multi-process mesh is "
                   "not wired yet (multihost v2 covers tp/dp/dp-attention "
                   "with int8 and fused steps)")
+    if plane.moe:
+        if pp:
+            return no(
+                "MoE on the pp engine is declared impossible: the stage "
+                "scan stacks per-stage layer weights into one batched "
+                "pytree and its body has no expert branch (router / "
+                "grouped / dispatch all need per-layer expert weights); "
+                "serve MoE models on a tp/ep/dp mesh or drop --pp")
+        if plane.role == "sp_prefill":
+            return no(
+                "ring-SP prefill is declared impossible for MoE: the sp "
+                "step shards the TOKEN axis around the ICI ring while "
+                "expert dispatch shards tokens over dp×ep — the two "
+                "chunkings conflict; MoE prefill rides the padded or "
+                "packed plane")
     if plane.spec:
         if pp:
             return no(
@@ -174,9 +195,11 @@ def param_pspecs(cfg: ModelConfig, moe_mode: str = "dense",
     """PartitionSpec pytree matching `llama.init_params` structure.
 
     MoE weights: dense mode shards each expert's MLP over tp too (the
-    dense einsums partition fine under GSPMD); dispatch mode keeps expert
-    shards tp-unsharded (the shard_map body owns them whole) and
-    replicates the router (every shard routes its own tokens).
+    dense einsums partition fine under GSPMD); dispatch mode shards the
+    expert dim over ep AND each expert's intermediate dim over tp (the
+    shard_map body computes a partial down projection per tp member and
+    psums — ops/moe.py `_dispatch_one_shard` tp_axis) and replicates the
+    router (every shard routes its own tokens).
 
     `dp_attention` (reference: sglang --enable-dp-attention,
     `disagg_dp_attn.sh:33-37`): attention runs data-parallel over the
@@ -209,9 +232,9 @@ def param_pspecs(cfg: ModelConfig, moe_mode: str = "dense",
         if moe_mode == "dispatch":
             layer["moe"] = {
                 "router": P(None, None),
-                "w_gate": P("ep", None, None),
-                "w_up": P("ep", None, None),
-                "w_down": P("ep", None, None),
+                "w_gate": P("ep", None, "tp"),
+                "w_up": P("ep", None, "tp"),
+                "w_down": P("ep", "tp", None),
             }
         else:
             layer["moe"] = {
@@ -344,19 +367,52 @@ def _finalize(fn, in_shardings, mesh: Mesh):
     return fn
 
 
-def resolve_moe_mode(cfg: ModelConfig, mesh: Mesh,
+def resolve_moe_mode(cfg: ModelConfig, mesh: Optional[Mesh],
                      moe_mode: str = "auto") -> str:
-    """'auto' → all-to-all dispatch when an ep axis exists and tp == 1
-    (the shard_map body owns whole expert MLPs), else dense."""
+    """The MoE mode ladder: dense | grouped | dispatch.
+
+    - "dense": exact dense compute, every expert over every token with
+      zero gates — the oracle, and the GSPMD fallback (tp shards the
+      expert einsums fine).  E/k× the minimal FLOPs and weight bytes.
+    - "grouped": the MESHLESS fast path — tokens sorted by expert on
+      device, one ragged grouped GEMM streams each active expert's
+      weights HBM→VMEM once (ops/pallas/moe_grouped.py).
+    - "dispatch": all-to-all token dispatch over the mesh's ep axis;
+      ep × tp meshes additionally tp-shard each expert's MLP on the
+      intermediate dim (psum on exit — ops/moe.py tp_axis), so tp > 1
+      no longer blocks dispatch.
+
+    'auto': meshless → "grouped" when the backend is TPU and the expert
+    geometry passes `moe_grouped_geometry_ok`, else "dense"; sharded →
+    "dispatch" when an ep axis > 1 exists, else "dense"."""
     if not cfg.is_moe:
         return "dense"
+    valid = ("auto", "dense", "grouped", "dispatch")
+    if moe_mode not in valid:
+        raise ValueError(f"moe_mode={moe_mode!r} not in {valid}")
+    if mesh is None:
+        if moe_mode == "dispatch":
+            raise ValueError(
+                "moe_mode='dispatch' needs a mesh with an ep axis (the "
+                "all-to-all is an ep collective); meshless engines use "
+                "'grouped' (TPU fast path) or 'dense'")
+        if moe_mode == "auto":
+            from dynamo_tpu.ops.pallas import moe_grouped_geometry_ok
+
+            ok = (jax.default_backend() == "tpu"
+                  and moe_grouped_geometry_ok(
+                      cfg.hidden_size, cfg.intermediate_size,
+                      jax.numpy.dtype(cfg.dtype).itemsize))
+            return "grouped" if ok else "dense"
+        return moe_mode
+    if moe_mode == "grouped":
+        raise ValueError(
+            "moe_mode='grouped' is the meshless fast path (the Pallas "
+            "grouped GEMM runs whole experts per chip); sharded meshes "
+            "use 'dispatch' (ep all-to-all, tp-sharded expert MLPs) or "
+            "'dense' (GSPMD einsums)")
     if moe_mode == "auto":
-        return ("dispatch"
-                if mesh.shape["ep"] > 1 and mesh.shape["tp"] == 1
-                else "dense")
-    if moe_mode == "dispatch" and mesh.shape["tp"] != 1:
-        raise ValueError("moe_mode='dispatch' requires tp == 1 "
-                         "(expert MLPs are whole per ep shard)")
+        return "dispatch" if mesh.shape["ep"] > 1 else "dense"
     return moe_mode
 
 
@@ -410,11 +466,17 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         plane = PlaneSpec(quant=kv_quant, dp_attention=dp_attention,
                           use_pallas=use_pallas_decode, dp_local=dp_local,
                           window=window, greedy_only=greedy_only)
+    # The model decides the moe plane dimension — fold it in here so
+    # every caller (engine gates, wrappers, the grid test) queries the
+    # capability table with the true spec.
+    if plane.moe != cfg.is_moe:
+        plane = _dc_replace(plane, moe=cfg.is_moe)
     validate(cfg, mesh, plane.dp_attention)
     check_plane(mesh, plane)
     mh = mesh_spans_processes(mesh)
-    moe_mode = resolve_moe_mode(
-        cfg, mesh, "dense" if plane.role == "sp_prefill" else moe_mode)
+    # moe × sp_prefill already raised in check_plane, so no dense-forcing
+    # special case survives here.
+    moe_mode = resolve_moe_mode(cfg, mesh, moe_mode)
     batch_axes = ("dp", "tp") if plane.dp_attention else "dp"
 
     def nsh(spec):
@@ -438,10 +500,11 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         # over sp and attention runs on the ICI ring
         # (ops/ring_attention.py).  Contract: the chunk is the WHOLE
         # prompt (positions 0..T-1, no prior cached context); T must
-        # divide by sp.  MoE stays dense (the dispatch shard_map shards
-        # tokens over dp×ep, conflicting with sp chunk sharding).
-        # Quantized caches ride the ring as int8 chunks + scales
-        # (llama._attention_block sp branch — ISSUE 12 leg 1).
+        # divide by sp.  MoE never reaches here (moe × sp_prefill is a
+        # capability-table pointed error: token-axis ring sharding
+        # conflicts with dp×ep token dispatch).  Quantized caches ride
+        # the ring as int8 chunks + scales (llama._attention_block sp
+        # branch — ISSUE 12 leg 1).
         step = make_forward_step(cfg, block_size, moe_mode="dense",
                                  mesh=mesh, sp_ring=True)
         seq = nsh(P("dp", "sp"))
@@ -595,14 +658,16 @@ def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,
                         use_pallas_decode: bool = False,
                         dp_attention: bool = False,
                         dp_local: bool = False,
-                        kv_quant: bool = False):
+                        kv_quant: bool = False,
+                        moe_mode: str = "auto"):
     """Fused K-token decode window (`plane.window=K`); see
     llama.make_decode_window for the run() contract."""
     return make_sharded_step(
         cfg, block_size, mesh,
         PlaneSpec(window=window, greedy_only=greedy_only,
                   use_pallas=use_pallas_decode, dp_attention=dp_attention,
-                  dp_local=dp_local, quant=kv_quant))
+                  dp_local=dp_local, quant=kv_quant),
+        moe_mode=moe_mode)
 
 
 def make_sharded_greedy_step(cfg: ModelConfig, block_size: int, mesh: Mesh,

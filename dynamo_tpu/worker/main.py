@@ -150,6 +150,23 @@ def parse_args(argv=None):
                         "prefill and decode workers of one disagg pair "
                         "must match (mismatched peers refuse block "
                         "transfer loudly)")
+    p.add_argument("--moe-mode",
+                   choices=("auto", "dense", "grouped", "dispatch"),
+                   default="auto",
+                   help="MoE compute mode (dense models ignore it): "
+                        "'auto' picks the grouped Pallas kernel on "
+                        "meshless TPU engines and ep all-to-all dispatch "
+                        "on ep>1 meshes; explicit rungs pin one — "
+                        "'grouped' is meshless-only, 'dispatch' needs an "
+                        "ep mesh (tp>1 composes: expert MLPs tp-shard "
+                        "inside the dispatch body)")
+    p.add_argument("--moe-capacity", type=int, default=None, metavar="C",
+                   help="bounded per-expert dispatch capacity (tokens "
+                        "per expert per source shard).  Default None = "
+                        "EXACT routing, nothing dropped.  A bound "
+                        "shrinks the all-to-all buffers; overflow "
+                        "assignments are DROPPED and counted in "
+                        "dynamo_moe_dropped_tokens_total, never silent")
     p.add_argument("--spec-decode", type=int, default=0, metavar="K",
                    help="self-speculative decoding: draft K tokens per "
                         "decode step (prompt-lookup n-gram drafter) and "
@@ -165,10 +182,11 @@ def parse_args(argv=None):
                         "one flat token axis with per-segment block "
                         "tables and attention streams pages from the "
                         "pool via the Pallas flash-prefill kernel.  "
-                        "'auto' = on for TPU meshless non-MoE engines "
-                        "whose geometry passes the Mosaic eligibility "
-                        "rule; 'on' forces it (interpret mode off-TPU); "
-                        "'off' keeps the padded gather plane")
+                        "'auto' = on for TPU meshless engines (MoE "
+                        "included) whose geometry passes the Mosaic "
+                        "eligibility rule; 'on' forces it (interpret "
+                        "mode off-TPU); 'off' keeps the padded gather "
+                        "plane")
     p.add_argument("--prewarm-prefill", action="store_true",
                    help="compile the packed prefill shape set at "
                         "startup (through the persistent XLA compile "
@@ -353,6 +371,8 @@ def run_follower_rank(args) -> None:
     if not args.lockstep:
         raise SystemExit("follower ranks need --lockstep HOST:PORT")
     cfg, params, _tok, _tpl = resolve_model(args.model or "llama-3-1b")
+    if getattr(args, "moe_capacity", None) is not None:
+        cfg = cfg.replace(moe_capacity=args.moe_capacity)
     core = EngineCore(
         EngineConfig(model=cfg,
                      num_blocks=args.num_blocks,
@@ -364,6 +384,10 @@ def run_follower_rank(args) -> None:
                      # count are part of that identity (ISSUE 12 leg 4 —
                      # a follower without kv_quant would build a bf16
                      # cache and diverge on the first quantized step).
+                     # MoE mode and capacity too (ISSUE 17): a follower
+                     # resolving a different dispatch ladder rung would
+                     # shadow a different compiled step.
+                     moe_mode=getattr(args, "moe_mode", "auto"),
                      kv_quant=getattr(args, "kv_quant", "none"),
                      pp_microbatches=getattr(args, "pp_microbatches", 2),
                      scheduler=SchedulerConfig(
@@ -399,6 +423,11 @@ async def build_engine(args, kv_event_sink):
 
     cfg, params, tok_spec, template = resolve_model(
         args.model or "llama-3-1b")
+    if getattr(args, "moe_capacity", None) is not None:
+        # Capacity is a model-level dispatch knob (ModelConfig) so every
+        # compiled step sees it; the flag is the deployment's explicit
+        # exactness/buffer-size trade (drops are counted, never silent).
+        cfg = cfg.replace(moe_capacity=args.moe_capacity)
     mesh = build_mesh(args)
     core = EngineCore(
         EngineConfig(model=cfg,
@@ -406,6 +435,7 @@ async def build_engine(args, kv_event_sink):
                      mesh=mesh,
                      dp_attention=args.dp_attention,
                      decode_window=args.decode_window,
+                     moe_mode=getattr(args, "moe_mode", "auto"),
                      kv_quant=getattr(args, "kv_quant", "none"),
                      pp_microbatches=getattr(args, "pp_microbatches", 2),
                      speculative_tokens=getattr(args, "spec_decode", 0),
@@ -719,9 +749,14 @@ async def run(args) -> None:
                 f"{ks.gpu_prefix_cache_hit_rate}",
             ]
             if m.expert_load:
+                # MoE telemetry (ISSUE 17): per-expert assignment
+                # distribution plus the capacity-honesty drop counter
+                # (0 forever at the exact-capacity serving default).
                 for e, n in enumerate(m.expert_load):
                     lines.append(
-                        f'dynamo_worker_expert_load{{expert="{e}"}} {n}')
+                        f'dynamo_moe_expert_load{{expert="{e}"}} {n}')
+                lines.append("dynamo_moe_dropped_tokens_total "
+                             f"{m.moe_dropped_tokens}")
             # Serving-loop overhead counters (EngineStepCounters) —
             # host syncs / compiled-shape cache misses per dispatch
             # class; mocker-backed workers have no core and skip this.

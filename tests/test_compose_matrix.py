@@ -38,11 +38,13 @@ MESHES = {
     "dp_local": (MeshConfig(tp=2, dp=2), dict(dp_attention=True)),
     "sp2": (MeshConfig(sp=2, tp=2), dict(sp_prefill_threshold=8)),
     "pp2": (MeshConfig(pp=2), {}),
+    "ep2": (MeshConfig(dp=2, ep=2), {}),
+    "ep2tp2": (MeshConfig(dp=2, ep=2, tp=2), {}),
 }
 
 
 def _run_cell(mesh_name=None, kv_quant="none", spec=0, decode_window=1,
-              **extra):
+              model="tiny-test", **extra):
     kwargs = dict(enable_prefix_cache=False)
     mesh = None
     if mesh_name is not None:
@@ -51,7 +53,7 @@ def _run_cell(mesh_name=None, kv_quant="none", spec=0, decode_window=1,
         kwargs.update(mesh_kwargs)
     kwargs.update(extra)
     core = EngineCore(EngineConfig(
-        model=mcfg.get_config("tiny-test"), num_blocks=64, mesh=mesh,
+        model=mcfg.get_config(model), num_blocks=64, mesh=mesh,
         kv_quant=kv_quant, speculative_tokens=spec,
         decode_window=decode_window, window_pipeline_depth=2,
         scheduler=SchedulerConfig(**SCHED), **kwargs))
@@ -110,6 +112,37 @@ SLOW_CELLS = {
                             decode_window=4),
 }
 
+# MoE row of the matrix (ISSUE 17): every exclusion this PR killed
+# becomes an exercised cell against the tiny-moe meshless dense oracle.
+MOE_CELLS = {
+    # moe × decode window (meshless dense).
+    "moe+window": dict(model="tiny-moe", decode_window=4),
+    # moe × fused greedy through the GROUPED fast path (interpret on
+    # CPU) — the ops-level byte-identity surviving the fused program.
+    "moe+grouped": dict(model="tiny-moe", moe_mode="grouped"),
+    # grouped × decode window.
+    "moe+grouped+window": dict(model="tiny-moe", moe_mode="grouped",
+                               decode_window=4),
+    # moe × int8 KV × window (vs the int8 meshless oracle: int8 KV is
+    # lossy and the router's top-k amplifies it, so the honest parity
+    # reference shares the quantizer and pins the PLANE composition).
+    "moe+int8": dict(model="tiny-moe", kv_quant="int8", decode_window=4),
+    # moe × packed ragged prefill (the exclusion killed in the engine).
+    "moe+packed": dict(model="tiny-moe", packed_prefill=True),
+    # moe × head-sharded tp (dense GSPMD expert einsums).
+    "moe+tp2": dict(model="tiny-moe", mesh_name="tp2"),
+    # moe × ep dispatch (all-to-all over the ep axis).
+    "moe+ep2": dict(model="tiny-moe", mesh_name="ep2"),
+}
+
+MOE_SLOW_CELLS = {
+    # ep × tp dispatch: tp-sharded expert MLPs under the all-to-all.
+    "moe+ep2+tp2": dict(model="tiny-moe", mesh_name="ep2tp2"),
+    # dispatch × decode window × int8 KV — the heaviest MoE cell.
+    "moe+ep2+int8+window": dict(model="tiny-moe", mesh_name="ep2",
+                                kv_quant="int8", decode_window=4),
+}
+
 
 def _assert_cell(name, kwargs, oracle):
     core, out = _run_cell(**kwargs)
@@ -128,6 +161,15 @@ def _assert_cell(name, kwargs, oracle):
     elif not kwargs.get("spec"):
         assert core._greedy_fused is not None, \
             f"cell {name} single-step decode did not take the fused path"
+    if kwargs.get("packed_prefill"):
+        assert core.counters.packed_prefill_dispatches > 0, \
+            f"cell {name} never dispatched a packed prefill"
+    if kwargs.get("model") == "tiny-moe":
+        load = core.snapshot_expert_load()
+        assert load is not None and int(load.sum()) > 0, \
+            f"cell {name} lost the expert-load telemetry"
+        assert core.moe_dropped_tokens == 0, \
+            f"cell {name} dropped tokens at exact capacity"
 
 
 @pytest.mark.parametrize("name", sorted(CELLS))
@@ -139,6 +181,40 @@ def test_composition_cell(name, oracle):
 @pytest.mark.parametrize("name", sorted(SLOW_CELLS))
 def test_composition_cell_slow(name, oracle):
     _assert_cell(name, SLOW_CELLS[name], oracle)
+
+
+@pytest.fixture(scope="module")
+def moe_oracle():
+    """tiny-moe meshless single-step dense output — the MoE row's parity
+    reference (moe_dense is exact; grouped is byte-identical to it)."""
+    _, out = _run_cell(model="tiny-moe")
+    return out
+
+
+@pytest.fixture(scope="module")
+def moe_int8_oracle():
+    """The int8-KV MoE reference: int8 cells share the quantizer with
+    their oracle so the cell pins the plane composition, not the
+    quantizer's (real, router-amplified) loss."""
+    _, out = _run_cell(model="tiny-moe", kv_quant="int8")
+    return out
+
+
+def _moe_ref(kw, moe_oracle, moe_int8_oracle):
+    return moe_int8_oracle if kw.get("kv_quant") == "int8" else moe_oracle
+
+
+@pytest.mark.parametrize("name", sorted(MOE_CELLS))
+def test_moe_composition_cell(name, moe_oracle, moe_int8_oracle):
+    kw = MOE_CELLS[name]
+    _assert_cell(name, kw, _moe_ref(kw, moe_oracle, moe_int8_oracle))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(MOE_SLOW_CELLS))
+def test_moe_composition_cell_slow(name, moe_oracle, moe_int8_oracle):
+    kw = MOE_SLOW_CELLS[name]
+    _assert_cell(name, kw, _moe_ref(kw, moe_oracle, moe_int8_oracle))
 
 
 def test_pp_fused_step_counters():
@@ -288,10 +364,32 @@ def test_declared_impossible_cells_are_pointed():
     # pp × multihost: declared.
     assert not plane_capability(pp2, PlaneSpec(), multihost=True).ok
 
+    # moe × pp: declared (the stage scan stacks per-stage weights into
+    # one batched pytree; its body has no expert branch) — and the
+    # engine raises the table's reason verbatim at construction.
+    cap = plane_capability(pp2, PlaneSpec(moe=True))
+    assert not cap.ok and "expert" in cap.reason
+    with pytest.raises(ValueError) as ei:
+        EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-moe"), num_blocks=64, mesh=pp2,
+            enable_prefix_cache=False, scheduler=SchedulerConfig(**SCHED)))
+    assert str(ei.value) == cap.reason
+
+    # moe × ring-SP prefill: the sp token chunking conflicts with the
+    # dp×ep token dispatch — declared; the engine consults the table
+    # and keeps MoE prefill on the padded plane (no error, no ring).
+    sp2 = make_mesh(MeshConfig(sp=2, tp=2), jax.devices()[:4])
+    cap = plane_capability(sp2, PlaneSpec(role="sp_prefill", moe=True))
+    assert not cap.ok and "ring" in cap.reason
+
     # Every EXERCISED cell above must be capability-table-OK — a cell
     # that runs here but is declared impossible (or vice versa) means
-    # the table and the grid drifted.
-    for name, kw in {**CELLS, **SLOW_CELLS}.items():
+    # the table and the grid drifted.  The MoE cells fold their `moe`
+    # bit into the plane exactly the way the engine does.
+    for name, kw in {**CELLS, **SLOW_CELLS, **MOE_CELLS,
+                     **MOE_SLOW_CELLS}.items():
+        if kw.get("mesh_name") is None:
+            continue  # meshless cells never consult the table
         mesh_cfg, mesh_kwargs = MESHES[kw["mesh_name"]]
         mesh = make_mesh(mesh_cfg, jax.devices()[:mesh_cfg.size])
         plane = PlaneSpec(
@@ -300,7 +398,8 @@ def test_declared_impossible_cells_are_pointed():
             window=kw.get("decode_window", 1),
             fused=kw.get("decode_window", 1) <= 1,
             dp_attention=bool(mesh_kwargs.get("dp_attention")),
-            dp_local=bool(mesh_kwargs.get("dp_attention")))
+            dp_local=bool(mesh_kwargs.get("dp_attention")),
+            moe=kw.get("model") == "tiny-moe")
         cap = plane_capability(mesh, plane)
         assert cap.ok, f"grid cell {name} is declared impossible: " \
                        f"{cap.reason}"

@@ -256,6 +256,43 @@ def test_profile_decode_emits_phase_breakdown_json():
     assert phases["scheduler_ms"] > 0
 
 
+def test_profile_decode_moe_emits_moe_phase():
+    """ISSUE 17 satellite: `--moe` profiles the MoE fast-decode plane
+    via the gated bench section (one methodology) — dense vs grouped
+    step slopes, bitwise parity, the [E+1] load histogram, and modeled
+    expert-weight bytes (grouped streams only active experts)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "profile_decode.py"),
+         "--model", "tiny-moe", "--batch", "4", "--ctx", "16",
+         "--block", "8", "--width", "4", "--window", "2", "--moe",
+         "--no-probes", "--no-kernel", "--json"],
+        capture_output=True, text=True, timeout=280,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 JAX_COMPILATION_CACHE_DIR=os.environ.get(
+                     "JAX_COMPILATION_CACHE_DIR",
+                     "/tmp/dynamo_tpu_test_xla_cache")),
+        cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    moe = out["moe"]
+    assert moe["model"] == "tiny-moe"
+    assert moe["token_parity"] is True
+    assert moe["int8_parity"] is True
+    assert moe["dropped_tokens"] == 0
+    assert sum(moe["expert_load"]) == 4 * 2   # batch x top-k, no drops
+    assert moe["dense_step_ms"] > 0 and moe["grouped_step_ms"] > 0
+    # Grouped streams only experts with assignments — never more
+    # weight bytes than the every-expert dense oracle.
+    assert (0 < moe["grouped_expert_weight_bytes"]
+            <= moe["dense_expert_weight_bytes"])
+
+
 def test_profile_decode_tp_emits_sharded_phases():
     """ISSUE 9 satellite: `--tp 2` profiles the SHARDED decode phases on
     a CPU host (virtual devices forced pre-jax-init), so the sharded gap

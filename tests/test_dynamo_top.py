@@ -155,6 +155,37 @@ def test_summarize_engine_age_and_stalls():
     assert "12.3s/2!" in table
 
 
+def test_summarize_moe_expert_load():
+    """ISSUE 17: the MoE expert-load series fold into the EXP column —
+    active/total experts, max/mean imbalance, and a `!N` drop marker
+    when capacity honesty counted dropped assignments."""
+    samples = [
+        ("dynamo_moe_expert_load", {"expert": "0"}, 10.0),
+        ("dynamo_moe_expert_load", {"expert": "1"}, 30.0),
+        ("dynamo_moe_expert_load", {"expert": "2"}, 0.0),
+        ("dynamo_moe_expert_load", {"expert": "3"}, 20.0),
+        ("dynamo_moe_dropped_tokens_total", {}, 0.0),
+    ]
+    row = dynamo_top.summarize("worker-both", "a:1", samples, None)
+    assert row["moe_experts_active"] == 3
+    assert row["moe_experts_total"] == 4
+    assert row["moe_load_imbalance"] == pytest.approx(2.0)
+    assert row["moe_dropped_tokens"] == 0.0
+    assert dynamo_top._fmt_exp(row) == "3/4e 2.0x"
+    # A lossy capacity cap must be visible at a glance.
+    dropped = dynamo_top.summarize("worker-both", "a:1", samples[:-1] + [
+        ("dynamo_moe_dropped_tokens_total", {}, 7.0)], None)
+    assert dynamo_top._fmt_exp(dropped) == "3/4e 2.0x!7"
+    # Dense workers publish no series: the no-data dash.
+    dense = dynamo_top.summarize("worker-both", "a:1", [], None)
+    assert dense["moe_experts_active"] is None
+    assert dynamo_top._fmt_exp(dense) == "—"
+    table = dynamo_top.render_table(
+        {"control_plane": "x", "processes": [row]})
+    assert "EXP" in table
+    assert "3/4e 2.0x" in table
+
+
 def test_knee_concurrency_extraction():
     prof = {"prefill": {}, "decode": {},
             "meta": {"capacity": {"knee_concurrency_per_worker": 2.5}}}

@@ -91,10 +91,126 @@ def test_sharded_dispatch_step_matches_dense_reference():
         batch * T * CFG.num_experts_per_token * CFG.num_layers)
 
 
-def test_dispatch_requires_tp1():
+def test_dispatch_ep_tp_mesh_matches_dense_reference():
+    """dp=2 x ep=2 x tp=2: dispatch with tp-sharded expert MLPs (F/tp
+    slices, one psum on exit) matches the meshless dense oracle, with
+    every assignment counted and nothing dropped at exact capacity."""
+    params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    batch, T = 8, 16  # batch divisible by dp*ep
+    tokens = jax.random.randint(jax.random.key(5), (batch, T), 0,
+                                CFG.vocab_size)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (batch, T))
+    bt = np.zeros((batch, 8), np.int32)
+    for i in range(batch):
+        bt[i, :4] = np.arange(1 + 4 * i, 5 + 4 * i)
+    inputs = (tokens, positions, jnp.full((batch,), T, jnp.int32),
+              jnp.asarray(bt))
+    sample_pos = jnp.full((batch,), T - 1, jnp.int32)
+
+    ref_step = make_forward_step(CFG, BLOCK)
+    ref_cache = kvc.init_cache(kvc.KvCacheConfig.for_model(
+        CFG, num_blocks=64, block_size=BLOCK, dtype=jnp.float32))
+    want, _ = ref_step(params, ref_cache, *inputs, sample_pos)
+
     mesh = make_mesh(MeshConfig(dp=2, ep=2, tp=2), jax.devices())
-    with pytest.raises(ValueError, match="tp == 1"):
-        make_sharded_step(CFG, BLOCK, mesh, moe_mode="dispatch")
+    sharded = shard_pytree(params, param_pspecs(CFG, "dispatch"), mesh)
+    cache = shard_pytree(
+        kvc.init_cache(kvc.KvCacheConfig.for_model(
+            CFG, num_blocks=64, block_size=BLOCK, dtype=jnp.float32)),
+        cache_pspecs(CFG.num_layers), mesh)
+    step = make_sharded_step(CFG, BLOCK, mesh, moe_mode="dispatch",
+                             with_expert_load=True)
+    got, _, load = step(sharded, cache, *inputs, sample_pos)
+
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=5e-4, atol=5e-4)
+    load = np.asarray(load)
+    assert load.shape == (CFG.num_experts + 1,)
+    assert int(load[:-1].sum()) == (
+        batch * T * CFG.num_experts_per_token * CFG.num_layers)
+    assert load[:-1].sum() > 0
+    assert int(load[-1]) == 0  # exact capacity: nothing dropped
+
+
+def test_grouped_matches_dense_bitwise():
+    """The grouped-GEMM path is BYTE-identical to the dense oracle in
+    f32 and bf16 (interpret mode on CPU): same routing, same expert
+    math, and crucially the same expert-index-ordered combine."""
+    p = _moe_params()
+    for dt in (jnp.float32, jnp.bfloat16):
+        pd = jax.tree.map(lambda a: a.astype(dt), p)
+        x = jax.random.normal(jax.random.key(3), (2, 16, CFG.hidden_size),
+                              jnp.float32).astype(dt)
+        want, load_d = moe_ops.moe_dense(CFG, pd, x)
+        got, load_g = moe_ops.moe_grouped(CFG, pd, x, interpret=True)
+        assert (np.asarray(want) == np.asarray(got)).all(), (
+            f"grouped diverged from dense oracle in {dt}")
+        np.testing.assert_array_equal(np.asarray(load_g), np.asarray(load_d))
+        assert int(load_g[-1]) == 0  # grouped is exact, never drops
+
+
+def test_grouped_int8_matches_dense_on_dequantized_weights():
+    """int8-weight grouped (dequant-in-VMEM) == dense oracle run on the
+    host-dequantized weights, byte for byte — the same static-structure
+    discipline as kv_quant: quantization changes the weights once, not
+    the compute path's numerics."""
+    from dynamo_tpu.ops.pallas import (
+        dequantize_moe_params,
+        moe_params_quantized,
+        quantize_moe_params,
+    )
+
+    p = jax.tree.map(lambda a: a.astype(jnp.bfloat16), _moe_params())
+    q = quantize_moe_params(p)
+    assert moe_params_quantized(q) and not moe_params_quantized(p)
+    x = jax.random.normal(jax.random.key(4), (2, 16, CFG.hidden_size),
+                          jnp.float32).astype(jnp.bfloat16)
+    want, load_d = moe_ops.moe_dense(
+        CFG, dequantize_moe_params(q, jnp.bfloat16), x)
+    got, load_g = moe_ops.moe_grouped(CFG, q, x, interpret=True)
+    assert (np.asarray(want) == np.asarray(got)).all()
+    np.testing.assert_array_equal(np.asarray(load_g), np.asarray(load_d))
+
+
+def test_dispatch_stats_tail_counts_drops():
+    """[E+1] stats contract: slots [:E] are the PRE-drop routing counts,
+    the tail is the dropped-assignment count — zero at the exact default,
+    honest (nonzero) under a bounding capacity."""
+    p = _moe_params()
+    x = jax.random.normal(jax.random.key(2), (2, 8, CFG.hidden_size),
+                          jnp.float32)
+    N = 2 * 8
+    k = CFG.num_experts_per_token
+    _, exact = moe_ops.moe_dispatch(CFG, p, x)
+    assert exact.shape == (CFG.num_experts + 1,)
+    assert int(exact[-1]) == 0
+    assert int(exact[:-1].sum()) == N * k
+    _, bounded = moe_ops.moe_dispatch(CFG, p, x, capacity=1)
+    assert int(bounded[-1]) > 0
+    # Routing is capacity-independent: same pre-drop counts either way.
+    np.testing.assert_array_equal(np.asarray(bounded[:-1]),
+                                  np.asarray(exact[:-1]))
+
+
+def test_resolve_moe_mode_ladder():
+    """The mode ladder's resolution rules and pointed errors."""
+    from dynamo_tpu.parallel.sharding import resolve_moe_mode
+
+    # Meshless auto on CPU → dense (grouped needs TPU + geometry).
+    assert resolve_moe_mode(CFG, None) == "dense"
+    assert resolve_moe_mode(CFG, None, "grouped") == "grouped"
+    with pytest.raises(ValueError, match="needs a mesh with an ep axis"):
+        resolve_moe_mode(CFG, None, "dispatch")
+    with pytest.raises(ValueError, match="not in"):
+        resolve_moe_mode(CFG, None, "bogus")
+    mesh = make_mesh(MeshConfig(dp=4, ep=2), jax.devices())
+    with pytest.raises(ValueError, match="meshless fast path"):
+        resolve_moe_mode(CFG, mesh, "grouped")
+    assert resolve_moe_mode(CFG, mesh) == "dispatch"
+    mesh_d = make_mesh(MeshConfig(dp=8), jax.devices())
+    assert resolve_moe_mode(CFG, mesh_d) == "dense"
+    # Dense models short-circuit whatever the mesh looks like.
+    assert resolve_moe_mode(mcfg.get_config("tiny-test"), mesh) == "dense"
 
 
 def test_moe_decode_windows_match_single_step():
@@ -215,3 +331,70 @@ def test_moe_dispatch_window_over_ep_mesh():
     load = core.snapshot_expert_load()
     kL = cfg.num_experts_per_token * cfg.num_layers
     assert int(load.sum()) > 0 and int(load.sum()) % kL == 0
+
+
+def _serve_moe_engine(**over):
+    """One meshless tiny-moe engine run with the file's shared geometry
+    (compile-cache reuse): two short greedy requests, returns (tokens,
+    engine)."""
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+
+    cfg = dict(model=CFG, num_blocks=64, enable_prefix_cache=False,
+               scheduler=SchedulerConfig(
+                   max_seqs=4, block_size=8, max_pages_per_seq=8,
+                   max_prefill_chunk=16,
+                   decode_buckets=(1, 2, 4), prefill_buckets=(8, 16)))
+    cfg.update(over)
+    core = EngineCore(EngineConfig(**cfg))
+    core.add_request("a", [5, 6, 7, 8, 9, 10], SamplingParams(max_tokens=8))
+    core.add_request("b", list(range(20, 29)), SamplingParams(max_tokens=8))
+    out = {}
+    for _ in range(300):
+        for d in core.step():
+            out.setdefault(d.request_id, []).extend(d.token_ids)
+        if not core._requests:
+            break
+    assert not core._requests
+    return out, core
+
+
+def test_engine_grouped_mode_matches_dense():
+    """A meshless engine serving with moe_mode='grouped' (interpret mode
+    on CPU) emits the SAME greedy tokens as the dense oracle engine —
+    the ops-level byte-identity surviving the full serving stack — and
+    the expert-load telemetry flows either way."""
+    dense_out, dense_core = _serve_moe_engine(moe_mode="dense")
+    grp_out, grp_core = _serve_moe_engine(moe_mode="grouped")
+    assert grp_out == dense_out, "grouped engine diverged from dense"
+    for core in (dense_core, grp_core):
+        load = core.snapshot_expert_load()
+        assert load is not None and int(load.sum()) > 0
+        assert core.moe_dropped_tokens == 0
+
+
+def test_packed_prefill_serves_moe():
+    """packed_prefill=True on a MoE model (the exclusion this PR kills):
+    token parity with the padded plane, the packed plane actually used,
+    and prefill assignments landing in the expert-load telemetry."""
+    padded_out, _ = _serve_moe_engine(packed_prefill=False)
+    packed_out, core = _serve_moe_engine(packed_prefill=True)
+    assert packed_out == padded_out, "packed MoE prefill diverged"
+    assert core.counters.packed_prefill_dispatches > 0
+    load = core.snapshot_expert_load()
+    assert load is not None and int(load.sum()) > 0
+    assert core.moe_dropped_tokens == 0
+
+
+def test_short_burst_publishes_expert_load_in_metrics():
+    """Drain-edge telemetry publish: a burst that finishes in < 32 steps
+    must still land its expert load in ForwardPassMetrics (what the
+    worker's /metrics route reads).  The periodic step_count % 32 sync
+    alone left short-lived traffic dark — the live worker served a chat
+    completion and exported no dynamo_moe_expert_load series."""
+    _, core = _serve_moe_engine(moe_mode="grouped")
+    assert core.step_count < 32  # the repro precondition: no periodic sync
+    m = core.metrics
+    assert m.expert_load is not None and sum(m.expert_load) > 0
+    assert m.moe_dropped_tokens == 0

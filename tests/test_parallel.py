@@ -21,6 +21,7 @@ from dynamo_tpu.parallel import (
     param_pspecs,
     shard_pytree,
 )
+from dynamo_tpu.parallel.sharding import resolve_moe_mode
 
 BLOCK = 8
 
@@ -62,7 +63,11 @@ def test_sharded_step_matches_unsharded(cfg_name, mesh_cfg):
     want = _reference_logits(cfg, params, inputs, sample_pos)
 
     mesh = make_mesh(mesh_cfg, jax.devices()[: mesh_cfg.size])
-    sharded = shard_pytree(params, param_pspecs(cfg), mesh)
+    # Param layout must match the MoE mode the step resolves on this
+    # mesh (ISSUE 17: auto picks dispatch on ep > 1 — replicated
+    # router), same contract the engine follows.
+    sharded = shard_pytree(
+        params, param_pspecs(cfg, resolve_moe_mode(cfg, mesh)), mesh)
     cache = shard_pytree(
         kvc.init_cache(kvc.KvCacheConfig.for_model(
             cfg, num_blocks=64, block_size=BLOCK, dtype=jnp.float32)),

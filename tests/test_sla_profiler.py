@@ -19,6 +19,8 @@ import pytest
 from benchmarks.sla_profiler import (
     AGREEMENT_ATOL_S,
     AGREEMENT_FACTOR,
+    MOE_DENSE_WEIGHT_FACTOR,
+    MOE_GROUPED_SPEEDUP,
     CellConfig,
     SMOKE_SLO,
     SloTarget,
@@ -141,6 +143,48 @@ def test_feature_axes_change_timing():
     # tp2 speeds everything, sublinearly per chip (0.91 efficiency).
     assert tp2.prefill_ms_per_token > base.prefill_ms_per_token / 2
     assert tp2.prefill_ms_per_token < base.prefill_ms_per_token
+
+
+def test_moe_axis_timing_and_validation():
+    base = cell_timing(CellConfig("base"))
+    dense = cell_timing(CellConfig("md", moe="dense"))
+    grouped = cell_timing(CellConfig("mg", moe="grouped"))
+    ep2 = cell_timing(CellConfig("me", moe="grouped", ep=2))
+    # MoE multiplies the weight-read terms (prefill per-token + decode
+    # base) by the expert-traffic factor; the KV per-seq term carries
+    # no expert weights and must be untouched.
+    assert dense.decode_base_ms == pytest.approx(
+        base.decode_base_ms * MOE_DENSE_WEIGHT_FACTOR)
+    assert grouped.decode_base_ms == pytest.approx(
+        base.decode_base_ms * MOE_DENSE_WEIGHT_FACTOR
+        / MOE_GROUPED_SPEEDUP)
+    assert dense.prefill_ms_per_token == pytest.approx(
+        base.prefill_ms_per_token * MOE_DENSE_WEIGHT_FACTOR)
+    assert dense.decode_ms_per_seq == base.decode_ms_per_seq
+    # ep2 shards the expert stream (same per-chip efficiency curve as
+    # tp) but never beats the equivalent dense-model cell.
+    assert base.decode_base_ms < ep2.decode_base_ms < grouped.decode_base_ms
+    # Axis validation is a construction-time error, not a silent sweep.
+    with pytest.raises(ValueError, match="moe="):
+        CellConfig("bad", moe="fused")
+    with pytest.raises(ValueError, match="ep="):
+        CellConfig("bad", ep=2)
+    # ep doubles the chip bill the capacity plan prices.
+    assert CellConfig("me2", moe="grouped", ep=2, tp=2).chips == 4
+
+
+def test_moe_plan_answered_beside_dense_plan(smoke):
+    # The MoE grid sweeps under its own mix and yields its OWN plan —
+    # the dense pinned fixture cannot drift from this PR.  At the
+    # shared smoke SLO the dense-MoE oracle can't hold TPOT at any
+    # load (the E/k weight wall); the only feasible fleet composes
+    # grouped + ep2 + every serving plane.
+    assert smoke["plan"].cell["name"] == "int8+spec+packed"
+    mp = smoke["moe_plan"]
+    assert mp.feasible
+    assert mp.cell["name"] == "moe-grouped-ep2+int8+spec+packed"
+    assert mp.mix == "moe_agentic"
+    assert any(r["cell"] == "moe-dense" for r in mp.rejected)
 
 
 def test_duty_axis_binds():

@@ -393,6 +393,37 @@ def sharded_decode_checks() -> dict:
     }
 
 
+def moe_decode_checks() -> dict:
+    """ISSUE 17 smoke: the MoE fast-decode plane measured on CPU with
+    tiny-moe — the grouped kernel (interpret mode) must be BITWISE equal
+    to the moe_dense oracle in both plain and int8-weight form, the
+    [E+1] stats must account every assignment with zero drops, and the
+    section must carry the gated ratio.
+
+    The CPU ratio itself is NOT gated (interpret-mode kernel cost swamps
+    it); the 1.5 floor binds on TPU rounds and is fabricated-failure-
+    checked in run_smoke."""
+    from dynamo_tpu.bench.moe_decode import run_moe_decode
+    from dynamo_tpu.models import config as mcfg
+
+    cfg = mcfg.get_config("tiny-moe")
+    out = run_moe_decode(cfg, batch=4)
+    k = cfg.num_experts_per_token
+    return {
+        "moe_decode_ratio": out.get("grouped_vs_dense"),
+        "moe_decode_token_parity": out.get("token_parity"),
+        "moe_decode_int8_parity": out.get("int8_parity"),
+        "moe_decode_load_accounted": (
+            sum(out.get("expert_load", [])) == 4 * k
+            and out.get("dropped_tokens") == 0),
+        "moe_decode_section_ok": all(
+            isinstance(out.get(key), (int, float))
+            for key in ("dense_step_ms", "grouped_step_ms",
+                        "grouped_int8_step_ms", "grouped_vs_dense",
+                        "grouped_expert_weight_bytes")),
+    }
+
+
 def prefill_plane_checks() -> dict:
     """ISSUE 10 smoke: the packed ragged prefill plane measured on CPU
     with the tiny model — both planes serve the same ragged prompt set
@@ -531,6 +562,7 @@ def sla_profiler_checks() -> dict:
 
     res = profiler_smoke(None)
     plan = res["plan"]
+    moe_plan = res["moe_plan"]
     profile = res["profile"]
 
     # The planner consumes the profiler's profile UNCHANGED (meta and
@@ -584,6 +616,20 @@ def sla_profiler_checks() -> dict:
                             == "int8+spec+packed"
                             and plan.replicas == 3
                             and plan.total_chips == 3),
+        # Pinned MoE fixture (ISSUE 17): the MoE grid is swept under
+        # its own mix and answered as its own plan, so the dense pin
+        # above cannot drift.  At the shared smoke SLO the dense-MoE
+        # oracle can't hold TPOT at ANY load (the E/k weight-traffic
+        # wall the grouped kernel exists for) — the only feasible
+        # fleet composes grouped + ep2 + every serving plane.
+        "sla_moe_plan_cell": (moe_plan.cell or {}).get("name"),
+        "sla_moe_plan_pinned": (
+            (moe_plan.cell or {}).get("name")
+            == "moe-grouped-ep2+int8+spec+packed"
+            and moe_plan.replicas == 10
+            and moe_plan.total_chips == 20),
+        "sla_moe_dense_rejected": any(
+            r["cell"] == "moe-dense" for r in moe_plan.rejected),
         "sla_over_slo_refused": (not refused.feasible
                                  and len(refused.rejected) > 0),
         "sla_fleet_ttft_agree": fleet["ttft_p50_agree"],
@@ -657,6 +703,11 @@ def run_smoke(args) -> int:
        rejected cells, and the tok_s_per_chip_ratio /
        pp_fused_vs_single floors plus the rejected-cell check verified
        to fail fabricated bad runs;
+    9b. MoE fast-decode plane (ISSUE 17): the grouped expert kernel
+        bitwise equal to the moe_dense oracle (plain and int8-weight,
+        interpret mode) with every assignment accounted and zero drops,
+        and the grouped_vs_dense floor verified to fail a fabricated
+        slower-than-dense run;
     10. prefill plane (ISSUE 10): packed ragged vs padded prefill on the
         tiny model with byte-identical first tokens, and the
         packed_vs_padded_tok_s_ratio floor verified to fail a
@@ -756,6 +807,8 @@ def run_smoke(args) -> int:
                                 "status": "declared: lockstep"}}},
                     prefill_plane={
                         "packed_vs_padded_tok_s_ratio": 1.45},
+                    moe_decode={"grouped_vs_dense": 2.7,
+                                "token_parity": True},
                     transfer={"device_vs_host_ratio": 3.4})
     tpu_low_mbu = dict(tpu_good, mbu=0.60)
     tpu_interfered = dict(
@@ -793,6 +846,13 @@ def run_smoke(args) -> int:
     # padded one (regressed to the gather path) must fail.
     tpu_slow_prefill = dict(
         tpu_good, prefill_plane={"packed_vs_padded_tok_s_ratio": 0.9})
+    # ISSUE-17 floor: a grouped MoE kernel SLOWER than the dense
+    # all-experts path (regressed to dense-ish weight streaming) must
+    # fail — as must a parity failure, which zeroes the ratio at the
+    # bench.
+    tpu_moe_slow = dict(
+        tpu_good, moe_decode={"grouped_vs_dense": 0.9,
+                              "token_parity": True})
     # ISSUE-13 floor: a device plane slower than the host-staged wire
     # (regressed to host staging under the covers, or double-copying on
     # inject) must fail — as must a parity failure, which zeroes the
@@ -830,6 +890,8 @@ def run_smoke(args) -> int:
                                                 tpu_rejected_cell).ok,
         "slow_prefill_plane_fails": not gate.compare(tpu_slow_prefill,
                                                      tpu_slow_prefill).ok,
+        "slow_moe_grouped_fails": not gate.compare(tpu_moe_slow,
+                                                   tpu_moe_slow).ok,
         "slow_device_transfer_fails": not gate.compare(
             tpu_slow_transfer, tpu_slow_transfer).ok,
         "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),
@@ -843,6 +905,7 @@ def run_smoke(args) -> int:
         **telemetry_overhead_checks(),
         **flight_recorder_overhead_checks(),
         **decode_wall_checks(),
+        **moe_decode_checks(),
         **prefill_plane_checks(),
         **transfer_plane_checks(),
         **prefix_fleet_checks(),

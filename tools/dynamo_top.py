@@ -173,7 +173,7 @@ def summarize(component: str, address: str, samples: List[Sample],
             and worker_inflight is not None
             and frontend_inflight is None):
         headroom = 1.0 - worker_inflight / knee_concurrency
-    return {
+    row = {
         "component": component,
         "address": address,
         "inflight": inflight,
@@ -222,6 +222,26 @@ def summarize(component: str, address: str, samples: List[Sample],
                              "dynamo_requests_migrated_in_total"),
         "draining": total(samples, "dynamo_worker_draining"),
     }
+    # MoE fast-decode plane (ISSUE 17): the per-expert assignment
+    # histogram (`dynamo_moe_expert_load{expert="e"}`) folded into the
+    # EXP column's three numbers — active experts, load imbalance
+    # (max/mean), capacity drops.  Dense workers publish no series and
+    # keep the no-data dash.
+    loads = [v for n, labels, v in samples
+             if n == "dynamo_moe_expert_load" and "expert" in labels]
+    if loads:
+        mean = sum(loads) / len(loads)
+        row["moe_experts_active"] = sum(1 for v in loads if v > 0)
+        row["moe_experts_total"] = len(loads)
+        row["moe_load_imbalance"] = (max(loads) / mean if mean > 0
+                                     else 0.0)
+    else:
+        row["moe_experts_active"] = None
+        row["moe_experts_total"] = None
+        row["moe_load_imbalance"] = None
+    row["moe_dropped_tokens"] = total(
+        samples, "dynamo_moe_dropped_tokens_total")
+    return row
 
 
 # -- collection ----------------------------------------------------------
@@ -376,6 +396,22 @@ def _fmt_qos_drain(r: dict) -> str:
     return f"{q}/{m}{mark}"
 
 
+def _fmt_exp(r: dict) -> str:
+    """EXP cell: active/total experts seeing load, `x`-suffixed
+    imbalance (max/mean), and `!N` when the capacity-honesty drop
+    counter is nonzero — a skewed router or a lossy capacity cap must
+    be visible at a glance.  Dense workers render the no-data dash."""
+    active = r.get("moe_experts_active")
+    if active is None:
+        return "—"
+    cell = (f"{int(active)}/{int(r.get('moe_experts_total') or 0)}e"
+            f" {r.get('moe_load_imbalance') or 0:.1f}x")
+    drops = r.get("moe_dropped_tokens")
+    if drops:
+        cell += f"!{int(drops)}"
+    return cell
+
+
 def _fmt_mesh(r: dict) -> str:
     """MESH cell from the worker's published SliceSpec: the mesh shape
     (`describe()` string), suffixed :P / :D for a dedicated
@@ -419,6 +455,8 @@ COLUMNS = (
     ("AGE/STL", 9, _fmt_age_stall),
     # QoS preemptions / drain-migrated streams, `D` while draining.
     ("QOS/DRN", 8, _fmt_qos_drain),
+    # MoE expert-load plane: active/total experts, imbalance, drops.
+    ("EXP", 11, _fmt_exp),
     # How far from the profiled saturation knee (--profile): 100% idle,
     # 0% at the knee, negative past it.
     ("HEADRM", 7, lambda r: _fmt(r.get("capacity_headroom"), "pct")),

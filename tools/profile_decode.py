@@ -433,6 +433,15 @@ def main(argv=None):
                         "device fabric on builds without "
                         "jax.experimental.transfer), at this model's "
                         "wire-block geometry in both cache modes")
+    p.add_argument("--moe", action="store_true",
+                   help="also profile the MoE fast-decode plane (ISSUE "
+                        "17): dense-oracle vs grouped-kernel slope "
+                        "timing at decode shape plus modeled expert-"
+                        "weight bytes (and their HBM floors when probes "
+                        "run).  A dense --model profiles an 8-expert "
+                        "top-2 variant at its dims; interpret mode "
+                        "off-TPU — times then show plumbing, not "
+                        "silicon")
     p.add_argument("--prefill-attn", action="store_true",
                    help="also slope-time prefill attention: the Pallas "
                         "paged flash-prefill kernel vs the gather_kv "
@@ -553,6 +562,31 @@ def main(argv=None):
             "bf16": transfer_phase(cfg, args.block),
             "int8": transfer_phase(cfg, args.block, kv_quant="int8"),
         }
+
+    if args.moe:
+        # MoE fast-decode phase (ISSUE 17): one measurement methodology
+        # with the gated `moe_decode` bench section — import, don't
+        # fork.  Reports dense/grouped/int8 step slopes, bitwise parity,
+        # the [E+1] expert-load histogram, and modeled per-step expert-
+        # weight bytes (dense streams all E experts; grouped streams
+        # only the active ones).
+        from dynamo_tpu.bench.moe_decode import run_moe_decode
+
+        moe_cfg = cfg if cfg.is_moe else cfg.replace(
+            name=cfg.name + "-moe8", num_experts=8,
+            num_experts_per_token=2)
+        moe = run_moe_decode(moe_cfg, batch=args.batch)
+        # Expert-weight HBM floors against the SAME measured bandwidth
+        # the dense rooflines above use — the grouped kernel's claim
+        # ("decode is weight-bytes-bound; stop streaming inactive
+        # experts") as arithmetic next to the measured slopes.
+        if "hbm_bw_gbs" in out and "dense_expert_weight_bytes" in moe:
+            bw = out["hbm_bw_gbs"] * 1e9
+            moe["dense_expert_weights_floor_ms"] = round(
+                moe["dense_expert_weight_bytes"] / bw * 1e3, 4)
+            moe["grouped_expert_weights_floor_ms"] = round(
+                moe["grouped_expert_weight_bytes"] / bw * 1e3, 4)
+        out["moe"] = moe
 
     if args.prefill_attn:
         # Prefill-plane attention phase (ISSUE 10): one measurement
