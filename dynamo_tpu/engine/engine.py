@@ -64,6 +64,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import Params, init_params, make_forward_step
 from dynamo_tpu.runtime import contracts, flight_recorder
+from dynamo_tpu.runtime import ledger as request_ledger
 from dynamo_tpu.runtime.contracts import (
     engine_thread_only,
     hot_path,
@@ -101,6 +102,13 @@ class TokenDelta:
     # stream on a peer, pulling the resident KV from `address` first.
     # Never reaches end clients; the migration layer consumes it.
     migrate: Optional[dict] = None
+    # Request-ledger return leg (runtime/ledger.py): a worker hop's
+    # completed phase-stamp wire dict, attached by engine_wire_handler
+    # to the final (or migrate) delta and absorbed into the frontend's
+    # live ledger.  Same tolerance contract as `migrate`: old frontends
+    # never read it, old workers never set it, garbage is dropped with a
+    # rate-limited warn and never fails the request.
+    ledger: Optional[dict] = None
 
 
 @dataclass(frozen=True)
@@ -691,6 +699,12 @@ class EngineCore:
         self._requests: Dict[str, Request] = {}
         self._hash_seqs: Dict[str, TokenBlockSequence] = {}
         self._published_blocks: Dict[str, int] = {}  # req -> #blocks published
+        # Request-ledger first-token timings (runtime/ledger.py): host
+        # scalars the scheduler already stamps, parked here at first
+        # token for LocalEngineClient to pop ON ITS event loop — the
+        # engine thread never touches a ledger object.  Bounded; plain
+        # dict set/pop is GIL-atomic.
+        self._ledger_timings: Dict[str, tuple] = {}
         self._kv_event_sink = kv_event_sink
         self._event_id = 0
         self._rng = jax.random.key(config.seed + 1)
@@ -2193,6 +2207,8 @@ class EngineCore:
         if req.first_token_ts is None:
             req.first_token_ts = time.monotonic()
             self._trace_first_token(req)
+            if request_ledger.enabled():
+                self._note_ledger_timings(req)
         req.output_tokens.append(token)
         lp = ([logprob] if (logprob is not None and req.sampling.logprobs)
               else None)
@@ -2234,6 +2250,32 @@ class EngineCore:
                    "prompt_tokens": len(req.prompt_tokens)})
         tracer.record_span("engine.ttft", ctx, req.arrival_ts, first,
                            attrs={"request_id": req.request_id})
+
+    def _note_ledger_timings(self, req: Request) -> None:
+        """Park this request's admission→first-token scalars for the
+        serving layer's ledger stamps (runtime/ledger.py).  Pure host
+        bookkeeping from timestamps the scheduler already stamps — one
+        bounded dict insert per request lifetime, zero device work, and
+        only behind the ledger's enabled guard (steady-decode
+        EngineStepCounters deltas stay byte-identical on vs off)."""
+        t = self._ledger_timings
+        if len(t) >= 1024:
+            t.pop(next(iter(t)))     # oldest never-popped entry out
+        t[req.request_id] = (
+            req.arrival_ts,
+            req.prefill_start_ts or req.arrival_ts,
+            req.prefill_end_ts or req.first_token_ts,
+            req.first_token_ts,
+            len(req.prompt_tokens),
+            req.cached_prompt_tokens,
+            req.preempts)
+
+    def pop_ledger_timings(self, request_id: str):
+        """(arrival, prefill_start, prefill_end, first_token,
+        prompt_tokens, cached_tokens, preempts) or None — popped once by
+        the serving layer when the first token-bearing delta crosses the
+        event loop."""
+        return self._ledger_timings.pop(request_id, None)
 
     def _finish(self, req: Request, reason: FinishReason) -> None:
         # With the managed source, sealed blocks stay resident (inactive,
@@ -2734,6 +2776,12 @@ class InferenceEngine:
             with self._cmd_lock:
                 self._pending_cancels.append(request_id)
             self._wake.set()
+
+    def pop_ledger_timings(self, request_id: str):
+        """Event-loop read of the core's parked first-token timings
+        (request-ledger plane); safe off the engine thread — a bounded
+        dict pop of host scalars."""
+        return self.core.pop_ledger_timings(request_id)
 
     # -- prefill seal-progress stream (disagg eager KV streaming) ---------
 

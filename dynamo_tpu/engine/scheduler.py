@@ -69,6 +69,13 @@ class Request:
     # Tokens emitted before a preemption folded them into the prompt —
     # keeps max_tokens budgeting and seeded-RNG indices monotonic.
     prior_output: int = 0
+    # Request-ledger scalars (runtime/ledger.py): the prompt tokens the
+    # prefix cache served at admission (req.prefilled advances during
+    # prefill, so the admission-time figure needs its own field) and how
+    # many times this request was preempted (QoS or capacity) — both
+    # ride the ledger's prefill stamp at first-token time.
+    cached_prompt_tokens: int = 0
+    preempts: int = 0
     # Memoized chained prompt-block hashes (admission retries must not
     # re-hash a long prompt every engine step); None = not yet computed.
     block_hashes: Optional[tuple] = None
@@ -664,6 +671,7 @@ class Scheduler:
             # Cached prefix skips prefill compute, but at least the last
             # prompt token is always recomputed so admission yields logits.
             req.prefilled = min(cached_tokens, len(req.prompt_tokens) - 1)
+            req.cached_prompt_tokens = req.prefilled
             self.prefix_hit_tokens += req.prefilled
             self.prefix_miss_tokens += len(req.prompt_tokens) - req.prefilled
             req.slot = slot
@@ -781,6 +789,7 @@ class Scheduler:
         prefill rebuilds their KV, and completion of that prefill samples
         the next token exactly as if decode had continued.  (vLLM-style
         recompute preemption; the reference delegates this to its engines.)"""
+        req.preempts += 1
         fl = self.flight
         if fl.enabled:
             fl.record("sched_preempt", rid=req.request_id,

@@ -82,12 +82,14 @@ def parse_args(argv=None):
     from dynamo_tpu.runtime.config import (
         apply_to_parser_defaults, load_layered_config)
     from dynamo_tpu.runtime.flight_recorder import add_flight_args
+    from dynamo_tpu.runtime.ledger import add_ledger_args
     from dynamo_tpu.runtime.slo import add_slo_args
     from dynamo_tpu.runtime.tracing import add_trace_args
 
     add_trace_args(p)
     add_slo_args(p)
     add_flight_args(p)
+    add_ledger_args(p)
     apply_to_parser_defaults(p, load_layered_config(
         {"http_host": "127.0.0.1", "http_port": 8080,
          "control_plane": None, "router_mode": "round_robin",
@@ -374,6 +376,11 @@ async def run(args) -> None:
     # armed like any worker; /debug/flightrecorder serves it.
     flight_recorder.configure_from_args(
         args, service="frontend").install_crash_dump()
+    # Request ledger (ISSUE 18): --request-ledger off disables every
+    # stamp site process-wide.
+    from dynamo_tpu.runtime import ledger as ledger_mod
+
+    ledger_mod.configure_from_args(args)
     await native.warmup()  # build the C++ hasher off the event loop
     models = ModelManager()
     shutdowns = []
@@ -463,11 +470,18 @@ async def run(args) -> None:
             from dynamo_tpu.runtime.slo import monitor_from_args
 
             svc = HttpService(models, registry=registry)
+            # Goodput attribution: the sink judges each request against
+            # the same TTFT/TPOT thresholds the SLO objectives use, and
+            # its dominant-phase window is the monitor's burn
+            # attribution (PAGEs name the hop burning budget).
+            svc.ledger_sink.slo_ttft = args.slo_ttft_p99
+            svc.ledger_sink.slo_tpot = args.slo_tpot_p99
             # SLO burn-rate monitor over this frontend's request
             # histograms (--slo-* flags; /debug/slo + dynamo_slo_*
             # gauges on /metrics).
-            slo_monitor = monitor_from_args(args, svc.request_metrics,
-                                            registry=svc.registry)
+            slo_monitor = monitor_from_args(
+                args, svc.request_metrics, registry=svc.registry,
+                attribution_fn=svc.ledger_sink.dominant_phase)
             if slo_monitor is not None:
                 svc.slo_monitor = slo_monitor
                 slo_monitor.start(interval=args.slo_tick)

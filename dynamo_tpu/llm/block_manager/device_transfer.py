@@ -310,6 +310,11 @@ class KvTransferPlane:
         self.refused_offers = 0
         self.expired_offers = 0
         self.pulled_blocks = 0
+        # Device bytes landed by pulls (array nbytes, post-reshard
+        # layout): the ledger's kv_transfer stamps and `dynamo top`'s
+        # plane split read deltas of this to report how much actually
+        # crossed the device fabric.
+        self.pulled_bytes = 0
         # Cross-mesh landings: pulls whose target sharding spanned >1
         # device, i.e. the block was resharded source→dest layout on
         # the wire (the bench gate's disagg_topology section pins this
@@ -533,6 +538,8 @@ class KvTransferPlane:
         if len(target.device_set) > 1:
             self.reshard_pulls += len(arrays)
         self.pulled_blocks += len(arrays)
+        for a in arrays:
+            self.pulled_bytes += int(getattr(a, "nbytes", 0))
         return dict(zip(meta["hashes"], arrays))
 
 

@@ -418,8 +418,12 @@ class PrefixShareClient:
 
     @never_engine_thread
     async def generate(self, request):
-        from dynamo_tpu.runtime import flight_recorder
+        import time as _time
 
+        from dynamo_tpu.runtime import flight_recorder
+        from dynamo_tpu.runtime.ledger import ledger_of
+
+        led = ledger_of(request)
         # KV-carrying migration first (ISSUE 15): the migrate hint covers
         # prompt + already-generated tokens of a handed-off stream, so it
         # supersedes any router donor hint for the same blocks (the
@@ -430,19 +434,41 @@ class PrefixShareClient:
             # cumulative counters: concurrent router-hint pulls by other
             # requests would be misattributed to this migration.
             pull_stats: dict = {}
+            t0 = _time.monotonic()
+            dev0 = self.fetcher.device_pulled_blocks
             covered = await self.fetcher.pull(
                 request.token_ids, mig["address"], mig["covered_tokens"],
                 stats=pull_stats)
             gained = pull_stats.get("gained_blocks", 0)
             if gained > 0:
                 self.fetcher.migrated_in += 1
+            if led is not None and gained > 0:
+                led.stamp(
+                    "kv_transfer", dur=_time.monotonic() - t0,
+                    reason="migrate",
+                    plane=("device" if self.fetcher.device_pulled_blocks
+                           > dev0 else "host"),
+                    blocks=gained, tokens=covered)
             fl = flight_recorder.get_recorder()
             if fl.enabled:
                 fl.record("migrate_in", rid=request.request_id,
                           covered=covered, pulled=gained)
         hint = decode_hint(request.annotations.get(HINT_ANNOTATION))
         if hint is not None:
-            await self.fetcher.pull(request.token_ids, hint["address"],
-                                    hint["covered_tokens"])
+            pull_stats = {}
+            t0 = _time.monotonic()
+            dev0 = self.fetcher.device_pulled_blocks
+            covered = await self.fetcher.pull(
+                request.token_ids, hint["address"],
+                hint["covered_tokens"], stats=pull_stats)
+            gained = pull_stats.get("gained_blocks", 0)
+            if led is not None and gained > 0:
+                led.stamp(
+                    "kv_transfer", dur=_time.monotonic() - t0,
+                    reason="prefix",
+                    plane=("device" if self.fetcher.device_pulled_blocks
+                           > dev0 else "host"),
+                    blocks=gained, tokens=covered,
+                    donor=str(hint.get("worker") or hint["address"]))
         async for delta in self.inner.generate(request):
             yield delta

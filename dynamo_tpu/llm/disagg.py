@@ -352,7 +352,10 @@ class DisaggDecodeClient:
 
     async def _remote_prefill_traced(self, request, rid, fut, span) -> None:
         from dynamo_tpu.llm.block_manager.device_transfer import note_plane
+        from dynamo_tpu.runtime.ledger import ledger_of
 
+        led = ledger_of(request)
+        t_wait = time.monotonic()
         puller = None
         if self.eager:
             from dynamo_tpu.llm.block_manager.eager import EagerPuller
@@ -378,6 +381,14 @@ class DisaggDecodeClient:
             span.set_attr(prefill_s=round(done.get("prefill_s", 0.0), 4),
                           prefill_worker=done.get("address"))
             t_pull = time.monotonic()
+            bytes0 = (self.transfer_plane.pulled_bytes
+                      if self.transfer_plane is not None else 0)
+            if led is not None:
+                # Decode-side wait for the remote prefill worker: queue
+                # push → done announcement (the eager stream overlaps it).
+                led.stamp("prefill_remote", dur=t_pull - t_wait,
+                          prefill_s=round(done.get("prefill_s", 0.0), 4),
+                          worker=str(done.get("address", "")))
             onboarded = 0
             path = "host-staged"
             if puller is not None:
@@ -460,6 +471,15 @@ class DisaggDecodeClient:
                     transfer_s, labels={"path": path})
             span.set_attr(tokens_onboarded=onboarded, path=path,
                           kv_transfer_s=round(transfer_s, 4))
+            if led is not None:
+                dev_bytes = (self.transfer_plane.pulled_bytes - bytes0
+                             if self.transfer_plane is not None else 0)
+                led.stamp(
+                    "kv_transfer", dur=transfer_s, reason="disagg",
+                    plane=("device" if path.startswith("device")
+                           else "host"),
+                    path=path, blocks=onboarded // self.block_size,
+                    tokens=onboarded, device_bytes=dev_bytes)
             logger.info("remote prefill %s: %d tokens onboarded from %s "
                         "(%s)", rid, onboarded, done["address"], path)
         except (asyncio.TimeoutError, ConnectionError, OSError,
@@ -482,6 +502,10 @@ class DisaggDecodeClient:
                 self.tokens_onboarded += landed
             settled = True
             span.set_attr(fallback="local", error=type(e).__name__,
+                          landed_tokens=landed)
+            if led is not None:
+                led.stamp("prefill_remote", dur=time.monotonic() - t_wait,
+                          fallback="local", error=type(e).__name__,
                           landed_tokens=landed)
             logger.warning(
                 "remote prefill %s failed (%s); prefilling locally"

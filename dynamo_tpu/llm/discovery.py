@@ -103,6 +103,12 @@ def delta_to_wire(delta: TokenDelta) -> dict:
         # Drain handoff marker (llm/drain.py): old frontends simply
         # never see it set; old workers never set it.
         d["migrate"] = dict(delta.migrate)
+    if getattr(delta, "ledger", None) is not None:
+        # Request-ledger return leg (runtime/ledger.py): the worker
+        # hop's phase stamps ride the final/migrate delta.  Same
+        # old-peer contract as `migrate`; garbage on the receiving side
+        # is dropped, never the request.
+        d["ledger"] = delta.ledger
     return d
 
 
@@ -115,7 +121,11 @@ def delta_from_wire(d: dict) -> TokenDelta:
         finished=bool(d.get("finished")),
         finish_reason=FinishReason(fr) if fr else None,
         logprobs=list(lp) if lp is not None else None,
-        migrate=dict(mig) if mig is not None else None)
+        migrate=dict(mig) if mig is not None else None,
+        # Carried raw: runtime/ledger.decode_wire validates (and warns,
+        # rate-limited) at the merge point so a malformed payload drops
+        # the LEDGER, never the delta.
+        ledger=d.get("ledger"))
 
 
 EMBED_ENDPOINT = "embed"
@@ -135,9 +145,16 @@ def engine_wire_handler(engine_client, request_metrics=None) -> Callable:
     async def handler(payload: dict) -> AsyncIterator[dict]:
         import time as _time
 
+        from dynamo_tpu.runtime import ledger as ledger_mod
         from dynamo_tpu.runtime import tracing
 
         req = request_from_wire(payload)
+        # Per-hop request ledger (runtime/ledger.py): created when this
+        # worker has the plane enabled AND the request opted in via its
+        # annotation marker.  Inner serving stages (disagg, prefix-share,
+        # LocalEngineClient) stamp it; the completed hop rides back on
+        # the final — or migrate — delta's `ledger` key.
+        hop_ledger = ledger_mod.begin_hop(req)
         # Trace context: the frontend's request id arrives in the RPC
         # frame; logging it here gives one grep-able id across frontend
         # and worker logs (reference `logging.rs:73-79`).  The RPC server
@@ -173,6 +190,14 @@ def engine_wire_handler(engine_client, request_metrics=None) -> Callable:
                 if delta.finished:
                     finished_ok = delta.finish_reason is not FinishReason.ERROR
                 n_out += len(delta.token_ids)
+                if hop_ledger is not None and (
+                        delta.finished
+                        or getattr(delta, "migrate", None) is not None):
+                    # Hop ledger return leg: the stream's last delta out
+                    # of this worker carries every stamp the hop made —
+                    # a drain migrate delta too, so hop-1 stamps survive
+                    # the handoff to the resuming peer.
+                    delta.ledger = hop_ledger.to_wire()
                 yield delta_to_wire(delta)
         except (GeneratorExit, asyncio.CancelledError):
             raise  # client disconnect / teardown: not an engine failure
@@ -234,9 +259,15 @@ class RemoteEngineClient:
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:
+        from dynamo_tpu.runtime import ledger as ledger_mod
+
         async for d in self.client.generate(request_to_wire(request)):
             delta = delta_from_wire(d)
             delta.request_id = request.request_id
+            # Fold a returned worker-hop ledger (final/migrate delta)
+            # into the frontend's live one; malformed payloads drop the
+            # ledger with a rate-limited warn, never the delta.
+            ledger_mod.absorb_delta(request, delta, where="remote_client")
             yield delta
 
     async def clear_kv_blocks(self) -> int:

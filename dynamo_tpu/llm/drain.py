@@ -158,6 +158,14 @@ class DrainableService:
                 if fl.enabled:
                     fl.record("migrate_out", rid=rid, emitted=emitted,
                               covered=covered)
+                from dynamo_tpu.runtime.ledger import ledger_of
+
+                led = ledger_of(request)
+                if led is not None:
+                    # Rides home on this very migrate delta (the wire
+                    # handler attaches the hop ledger to it).
+                    led.stamp("drain_handoff", covered_tokens=int(covered),
+                              emitted=emitted)
                 logger.info("drain: handing off %s (%d tokens emitted, "
                             "%d KV tokens offered)", rid, emitted, covered)
                 yield TokenDelta(request_id=rid, token_ids=[],

@@ -24,6 +24,7 @@ from dynamo_tpu.engine.scheduler import FinishReason
 from dynamo_tpu.llm.backend import StreamDetokenizer, wire_finish_reason
 from dynamo_tpu.llm.protocols import openai as oai
 from dynamo_tpu.llm.service import ModelHandle, ModelManager
+from dynamo_tpu.runtime import ledger as ledger_mod
 from dynamo_tpu.runtime import tracing
 from dynamo_tpu.runtime.metrics import (
     FrontendMetrics, MetricsRegistry, RequestMetrics)
@@ -49,6 +50,12 @@ class HttpService:
         # embedding process (frontend main) when --slo-* flags configure
         # objectives; None → /debug/slo reports enabled=false.
         self.slo_monitor = None
+        # Request-ledger fold point (ISSUE 18): completed per-request
+        # phase ledgers land here — dynamo_request_phase_seconds{phase=},
+        # the goodput counter pair, /debug/requests, and the dominant-
+        # phase attribution SloMonitor and `dynamo top` read.  Frontend
+        # main sets slo_ttft/slo_tpot from the --slo-* flags.
+        self.ledger_sink = ledger_mod.LedgerSink(self.registry)
         self.tracer = tracer or tracing.get_tracer()
         self.app = web.Application()
         self.app.router.add_post("/v1/chat/completions", self.chat_completions)
@@ -59,6 +66,7 @@ class HttpService:
         self.app.router.add_get("/v1/models", self.list_models)
         self.app.router.add_get("/metrics", self.prometheus)
         self.app.router.add_get("/debug/traces", self.debug_traces)
+        self.app.router.add_get("/debug/requests", self.debug_requests)
         self.app.router.add_get("/debug/slo", self.debug_slo)
         self.app.router.add_get("/debug/flightrecorder",
                                 self.debug_flightrecorder)
@@ -173,6 +181,17 @@ class HttpService:
             return self._error(400, "n must be an integer")
         return web.json_response(
             tracing.debug_traces_payload(n, self.tracer))
+
+    async def debug_requests(self, req: web.Request) -> web.Response:
+        """Slowest-N completed request ledgers (`?n=K`, default 10) with
+        full phase stamps, plus the window's dominant phase and the
+        goodput ratio — "which hop ate this request's latency", served
+        straight from the LedgerSink ring."""
+        try:
+            n = int(req.query.get("n", "10"))
+        except ValueError:
+            return self._error(400, "n must be an integer")
+        return web.json_response(self.ledger_sink.debug_payload(n))
 
     async def debug_flightrecorder(self, req: web.Request) -> web.Response:
         """The frontend's flight-recorder ring (`?n=K`, default 256):
@@ -662,7 +681,13 @@ class HttpService:
         labels = {"model": model}
         tracer = self.tracer
         parent = tracing.current_span() if tracer.enabled else None
+        led = None
         if observe_queue_wait:
+            # Request ledger (ISSUE 18): begin BEFORE the client pipeline
+            # so route/queue/prefill/kv_transfer stamps land on it; n>1
+            # siblings (observe_queue_wait=False) stay ledger-less — one
+            # ledger per HTTP request, choice 0's path.
+            led = ledger_mod.begin(pre)
             # Queue wait, frontend view: request arrival → the
             # generation stream starting (preprocess, image encode,
             # routing, admission to the client pipeline).  The
@@ -670,19 +695,29 @@ class HttpService:
             t_entry = time.monotonic()
             self.request_metrics.queue_wait.observe(t_entry - start_ts,
                                                     labels=labels)
+            if led is not None:
+                led.stamp("receive", dur=t_entry - start_ts, t=t_entry)
             if parent is not None:
                 tracer.record_span("frontend.queue_wait", parent,
                                    start_ts, t_entry)
         first = True
         last_t = None
         n_intervals = 0
+        ttft_s = None
+        itl_sum = 0.0
+
+        def tpot_mean():
+            return itl_sum / n_intervals if n_intervals else None
+
         async for delta in handle.client.generate(pre):
             now = time.monotonic()
+            ledger_mod.absorb_delta(pre, delta, where="frontend")
             if (lp_sink is not None and delta.logprobs
                     and len(delta.logprobs) == len(delta.token_ids)):
                 lp_sink.extend(zip(delta.token_ids, delta.logprobs))
             if delta.token_ids:
                 if first:
+                    ttft_s = now - start_ts
                     self.metrics.ttft.observe(now - start_ts,
                                               labels={"model": model})
                     self.request_metrics.ttft.observe(now - start_ts,
@@ -697,6 +732,7 @@ class HttpService:
                     self.request_metrics.tpot.observe(now - last_t,
                                                       labels=labels)
                     n_intervals += 1
+                    itl_sum += now - last_t
                     if (parent is not None
                             and n_intervals <= self.MAX_TPOT_SPANS):
                         tracer.record_span(
@@ -707,6 +743,8 @@ class HttpService:
                 out = det.push_tokens(delta.token_ids)
                 if out.finished:      # stop string hit mid-stream
                     self.request_metrics.observe_outcome(ok=True)
+                    self.ledger_sink.fold(led, ttft_s, tpot_mean(),
+                                          det.completion_tokens, ok=True)
                     yield out
                     return
                 if out.text:
@@ -715,12 +753,16 @@ class HttpService:
                 # Terminal outcome feeds the SLO error-rate objective:
                 # engine ERROR finishes are budget burn, everything else
                 # (stop/length/cancel) is a served request.
-                self.request_metrics.observe_outcome(
-                    ok=delta.finish_reason is not FinishReason.ERROR)
+                ok = delta.finish_reason is not FinishReason.ERROR
+                self.request_metrics.observe_outcome(ok=ok)
+                self.ledger_sink.fold(led, ttft_s, tpot_mean(),
+                                      det.completion_tokens, ok=ok)
                 yield det.finish(delta.finish_reason)
                 return
         # Engine stream ended without a finished marker (worker died):
         self.request_metrics.observe_outcome(ok=False)
+        self.ledger_sink.fold(led, ttft_s, tpot_mean(),
+                              det.completion_tokens, ok=False)
         yield det.finish(FinishReason.ERROR)
 
     async def _unary_chat(self, handle, body, pre, rid):

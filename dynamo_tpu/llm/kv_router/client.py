@@ -278,7 +278,13 @@ class KvRoutedEngineClient:
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:
+        import time as _time
+
+        from dynamo_tpu.runtime import ledger as ledger_mod
+
         workers = self._sync_workers()
+        led = ledger_mod.ledger_of(request)
+        route_t0 = _time.monotonic()
         # Routing-decision span: which worker won, the prefix overlap it
         # won on, and the selector's cost/candidate count — the
         # "why was this request placed here" record in the merged trace.
@@ -329,6 +335,12 @@ class KvRoutedEngineClient:
             candidates=(ev.candidates if ev is not None else len(workers)),
             cost=(round(ev.cost, 3) if ev is not None else None),
             remote_prefix_donor=donor_id)
+        if led is not None:
+            attrs = {"worker": int(worker_id),
+                     "overlap_blocks": int(overlap)}
+            if donor_id is not None:
+                attrs["donor"] = int(donor_id)
+            led.stamp("route", dur=_time.monotonic() - route_t0, **attrs)
         logger.debug("kv-routed %s → worker %s (overlap %d blocks)",
                      request.request_id, worker_id, overlap)
         self._publish_seq("add", request.request_id, worker=worker_id,
@@ -340,6 +352,7 @@ class KvRoutedEngineClient:
                                               worker_id):
                 delta = self._from_wire(d)
                 delta.request_id = request.request_id
+                ledger_mod.absorb_delta(request, delta, where="kv_router")
                 if delta.token_ids:
                     if first:
                         self.router.mark_prefill_complete(request.request_id)

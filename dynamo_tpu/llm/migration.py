@@ -109,16 +109,21 @@ class MigrationClient:
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:
+        import time as _time
+
         from dynamo_tpu.llm.block_manager.prefix_share import (
             MIGRATE_ANNOTATION, encode_hint)
+        from dynamo_tpu.runtime.ledger import ledger_of
 
         generated: list = []
         attempts_left = self.migration_limit
         attempt = 0
         req = request
+        led = ledger_of(request)
         while True:
             migrate_info: Optional[dict] = None
             reason = None
+            t_break = None
             gen = self.inner.generate(req)
             try:
                 async for delta in gen:
@@ -150,6 +155,7 @@ class MigrationClient:
                 # NOW so the wire layer sends its cancel frame and
                 # worker-side wrappers run their cleanup before the
                 # retry, not at GC time.
+                t_break = _time.monotonic()
                 try:
                     await gen.aclose()
                 except Exception:
@@ -201,6 +207,11 @@ class MigrationClient:
                     seed_offset=(request.sampling.seed_offset
                                  + len(generated))),
             )
+            if led is not None:
+                # The live ledger rides as a PLAIN attribute, not a
+                # dataclass field — dataclasses.replace drops it, so the
+                # resumed incarnation must carry it explicitly.
+                req.ledger = led
             # One warning per stream per reason, rate-limited across the
             # retry storm a dead fleet produces (was one line per
             # attempt per request).
@@ -215,3 +226,9 @@ class MigrationClient:
                 # worker already left the instance set); failures back
                 # off with jitter.
                 await asyncio.sleep(self._backoff(attempt - 1))
+            if led is not None and t_break is not None:
+                # Client-visible stall: stream break → re-issue
+                # (includes the backoff for unplanned deaths).
+                led.stamp("migration", dur=_time.monotonic() - t_break,
+                          reason=reason, attempt=attempt,
+                          carried_tokens=carry)

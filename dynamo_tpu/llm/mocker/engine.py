@@ -62,6 +62,10 @@ class _MockSeq:
     output: List[int] = field(default_factory=list)
     acquired_blocks: List[int] = field(default_factory=list)
     decoding: bool = False
+    # Request-ledger timings (runtime/ledger.py): arrival → admission →
+    # first token, stamped when the first token emits.
+    arrival_ts: float = 0.0
+    admit_ts: float = 0.0
 
     @property
     def prompt(self) -> List[int]:
@@ -126,7 +130,8 @@ class MockEngine:
         seq = _MockSeq(
             request=request,
             queue=asyncio.Queue(),
-            hash_seq=TokenBlockSequence(block_size=self.args.block_size))
+            hash_seq=TokenBlockSequence(block_size=self.args.block_size),
+            arrival_ts=time.monotonic())
         self._waiting.append(seq)
         self._wake.set()
         try:
@@ -204,6 +209,7 @@ class MockEngine:
             except RuntimeError:
                 break  # capacity exhausted; retry after something finishes
             self._waiting.pop(0)
+            seq.admit_ts = time.monotonic()
             seq.acquired_blocks = hashes
             seq.cached_tokens = reused * self.args.block_size
             # Prefix-cached tokens skip prefill work entirely.
@@ -213,6 +219,8 @@ class MockEngine:
 
     def _emit_token(self, seq: _MockSeq) -> None:
         idx = len(seq.output)
+        if idx == 0:
+            self._stamp_ledger(seq)
         token = _synthetic_token(seq.request.request_id, idx)
         seq.output.append(token)
         # Decode growth: register newly-sealed blocks.
@@ -235,6 +243,24 @@ class MockEngine:
         seq.queue.put_nowait(delta)
         if finished:
             self._retire(seq)
+
+    def _stamp_ledger(self, seq: _MockSeq) -> None:
+        """Mock timing is real wall-clock (the loop sleeps the simulated
+        step latency), so the same queue/prefill/first_token phases real
+        engines stamp hold here — bench_gate's mocker-fleet coverage
+        check reads them against measured TTFT."""
+        from dynamo_tpu.runtime.ledger import enabled, ledger_of
+
+        led = ledger_of(seq.request)
+        if led is None or not enabled():
+            return
+        now = time.monotonic()
+        admit = seq.admit_ts or seq.arrival_ts
+        led.stamp("queue", dur=admit - seq.arrival_ts, t=admit)
+        led.stamp("prefill", dur=now - admit, t=now,
+                  prompt_tokens=len(seq.prompt),
+                  cached_tokens=seq.cached_tokens)
+        led.stamp("first_token", dur=0.0, t=now)
 
     def _retire(self, seq: _MockSeq) -> None:
         if seq in self._running:

@@ -64,7 +64,10 @@ class LocalEngineClient:
     async def generate(
         self, request: PreprocessedRequest
     ) -> AsyncIterator[TokenDelta]:
+        import time as _time
+
         from dynamo_tpu.runtime import tracing
+        from dynamo_tpu.runtime.ledger import ledger_of
 
         # Bind the serving task's span to the request id so engine-thread
         # spans (admission→first-token) parent under it — the in-process
@@ -73,14 +76,53 @@ class LocalEngineClient:
         span = tracing.current_span()
         if span is not None:
             tracer.bind(request.request_id, span.ctx)
+        # Request-ledger stamps (runtime/ledger.py), all ON THIS event
+        # loop: engine queue/prefill/first_token phases from the scalars
+        # the core parked at first-token time, plus a per-token decode
+        # interval summary accumulated here — the engine thread and its
+        # EngineStepCounters never see any of it.
+        led = ledger_of(request)
+        n_intervals = 0
+        interval_sum = 0.0
+        interval_max = 0.0
+        last_t: Optional[float] = None
         try:
             async for delta in self._engine.generate(
                     request.request_id, request.token_ids, request.sampling,
                     prompt_embeds=request.prompt_embeds,
                     priority=priority_of(request)):
+                if led is not None and delta.token_ids:
+                    now = _time.monotonic()
+                    if last_t is None:
+                        self._stamp_first_token(led, request.request_id)
+                    else:
+                        gap = now - last_t
+                        n_intervals += 1
+                        interval_sum += gap
+                        interval_max = max(interval_max, gap)
+                    last_t = now
+                if led is not None and delta.finished and n_intervals:
+                    led.stamp("decode", dur=interval_sum, n=n_intervals,
+                              max_s=round(interval_max, 6))
                 yield delta
         finally:
             tracer.unbind(request.request_id)
+
+    def _stamp_first_token(self, led, request_id: str) -> None:
+        """Engine-phase stamps from the core's parked first-token
+        timings: queue (arrival→prefill start), prefill (start→end,
+        with cached-token and preemption attrs) and first_token
+        (prefill end→first token emit) tile the engine's share of
+        TTFT."""
+        timings = self._engine.pop_ledger_timings(request_id)
+        if timings is None:
+            return
+        arrival, pf_start, pf_end, first, prompt, cached, preempts = timings
+        led.stamp("queue", dur=pf_start - arrival, t=pf_start)
+        led.stamp("prefill", dur=pf_end - pf_start, t=pf_end,
+                  prompt_tokens=prompt, cached_tokens=cached,
+                  preempts=preempts)
+        led.stamp("first_token", dur=first - pf_end, t=first)
 
     async def embed(self, token_lists):
         """Last-token hidden-state embeddings: [n, hidden] (the

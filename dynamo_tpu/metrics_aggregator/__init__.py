@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import re
 import time
 from typing import Dict, Optional
 
@@ -38,6 +39,12 @@ SCRAPE_TIMEOUT = 2.0
 # A target that keeps failing is carried (marked stale) this long after
 # its last success, then dropped entirely.
 STALE_DROP_SECS = 60.0
+
+# Request-ledger series scraped from frontends (ISSUE 18):
+# per-phase histogram components and the goodput token counters.
+_PHASE_RE = re.compile(
+    r'^dynamo_request_phase_seconds_(sum|count)'
+    r'\{[^}]*phase="([^"]+)"[^}]*\}\s+([0-9.eE+-]+)')
 
 
 class MetricsAggregator:
@@ -82,6 +89,31 @@ class MetricsAggregator:
             "kv_active_blocks", "active KV blocks across workers")
         self._g_usage = self.registry.gauge(
             "kv_usage_mean", "mean device cache usage across workers")
+        # Fleet goodput attribution (ISSUE 18): every frontend folds its
+        # completed request ledgers into
+        # dynamo_request_phase_seconds{phase=} + the goodput counter
+        # pair; the aggregator re-exposes them pre-summed.  Merge
+        # semantics are SUM: a phase's fleet mean is
+        # sum(_sum)/sum(_count) across frontends, and goodput is the
+        # summed token counters' ratio — both hold because every
+        # underlying series is a monotone per-instance total.
+        self._g_phase_sum = self.registry.gauge(
+            "request_phase_seconds_sum",
+            "summed ledger phase seconds across frontends (label phase=)")
+        self._g_phase_count = self.registry.gauge(
+            "request_phase_seconds_count",
+            "summed ledger phase observations across frontends "
+            "(label phase=)")
+        self._g_goodput_good = self.registry.gauge(
+            "goodput_good_tokens",
+            "output tokens from SLO-good requests across frontends")
+        self._g_goodput_total = self.registry.gauge(
+            "goodput_tokens",
+            "output tokens from all finished requests across frontends")
+        self._g_goodput = self.registry.gauge(
+            "goodput_ratio",
+            "fleet goodput: SLO-good tokens / total tokens (0 when no "
+            "tokens yet)")
 
     async def start(self) -> None:
         await self._watcher.start()
@@ -231,6 +263,50 @@ class MetricsAggregator:
             m.kv_stats.kv_active_blocks for m in fresh.values()))
         usages = [m.kv_stats.gpu_cache_usage_perc for m in fresh.values()]
         self._g_usage.set(sum(usages) / len(usages) if usages else 0.0)
+        self._refresh_ledger_gauges()
+
+    def _refresh_ledger_gauges(self) -> None:
+        """Sum the frontends' ledger series into the fleet aggregates.
+
+        Works off the raw scraped texts (not the watcher) because the
+        phase histograms and goodput counters live on the FRONTEND
+        registries, which only reach the aggregator as scrape targets.
+        """
+        sums: Dict[str, float] = {}
+        counts: Dict[str, float] = {}
+        good = total = 0.0
+        for entry in self._scraped.values():
+            for line in entry["text"].splitlines():
+                if line.startswith("dynamo_request_phase_seconds_"):
+                    m = _PHASE_RE.match(line)
+                    if not m:
+                        continue
+                    kind, phase = m.group(1), m.group(2)
+                    try:
+                        val = float(m.group(3))
+                    except ValueError:
+                        continue
+                    bucket = sums if kind == "sum" else counts
+                    bucket[phase] = bucket.get(phase, 0.0) + val
+                elif line.startswith("dynamo_goodput_"):
+                    name_labels, _, raw = line.rpartition(" ")
+                    try:
+                        val = float(raw)
+                    except ValueError:
+                        continue
+                    if name_labels.startswith(
+                            "dynamo_goodput_good_tokens_total"):
+                        good += val
+                    elif name_labels.startswith(
+                            "dynamo_goodput_tokens_total"):
+                        total += val
+        for phase, val in sums.items():
+            self._g_phase_sum.set(val, labels={"phase": phase})
+        for phase, val in counts.items():
+            self._g_phase_count.set(val, labels={"phase": phase})
+        self._g_goodput_good.set(good)
+        self._g_goodput_total.set(total)
+        self._g_goodput.set(good / total if total > 0 else 0.0)
 
     @staticmethod
     def _relabel(text: str, addr: str, seen_meta: set) -> str:

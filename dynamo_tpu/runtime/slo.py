@@ -114,8 +114,14 @@ class SloMonitor:
         page_burn: float = 14.4,
         registry: Optional[MetricsRegistry] = None,
         clock: Callable[[], float] = time.monotonic,
+        attribution_fn: Optional[Callable[[], Optional[str]]] = None,
     ) -> None:
+        """`attribution_fn`: () -> dominant ledger phase over the recent
+        window (LedgerSink.dominant_phase) — PAGE transitions then NAME
+        the hop burning the budget instead of just reporting that budget
+        burns."""
         self.objectives = list(objectives)
+        self.attribution_fn = attribution_fn
         self.fast_window = fast_window
         self.slow_window = slow_window
         self.warn_burn = warn_burn
@@ -236,14 +242,23 @@ class SloMonitor:
                 "compliant": compliant,
                 "state": state,
             })
+        dominant = None
+        if self.attribution_fn is not None:
+            try:
+                dominant = self.attribution_fn()
+            except Exception:
+                dominant = None     # attribution must never kill the tick
         if worst != self.state:
             # SLO state transition → flight-recorder event; a transition
             # INTO PAGE additionally dumps the ring — the black box's
             # "what led up to the page" trigger (throttled per reason so
             # a burn rate flapping at the threshold can't grind disk).
+            # The ledger's dominant phase rides along, so the PAGE names
+            # WHERE the budget went (queue, kv_transfer, migration, ...).
             rec = flight_recorder.get_recorder()
             rec.record("slo_state", prev=self.state, state=worst,
-                       burn=round(worst_burn, 3))
+                       burn=round(worst_burn, 3),
+                       dominant_phase=dominant)
             if worst == PAGE and rec.enabled:
                 # Async: tick may run on the serving event loop, which
                 # must not stall behind ring serialization + file I/O.
@@ -255,6 +270,7 @@ class SloMonitor:
         return {
             "enabled": True,
             "state": worst,
+            "dominant_phase": dominant,
             "windows": {"fast_s": self.fast_window,
                         "slow_s": self.slow_window},
             "thresholds": {"warn_burn": self.warn_burn,
@@ -343,6 +359,7 @@ def add_slo_args(p) -> None:
 
 def monitor_from_args(args, request_metrics: RequestMetrics,
                       registry: Optional[MetricsRegistry] = None,
+                      attribution_fn: Optional[Callable] = None,
                       ) -> Optional[SloMonitor]:
     """Build the monitor the flags describe over the process's
     RequestMetrics histograms; None when no objective is configured
@@ -371,4 +388,5 @@ def monitor_from_args(args, request_metrics: RequestMetrics,
         slow_window=args.slo_slow_window,
         warn_burn=args.slo_warn_burn,
         page_burn=args.slo_page_burn,
-        registry=registry)
+        registry=registry,
+        attribution_fn=attribution_fn)

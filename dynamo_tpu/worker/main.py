@@ -228,12 +228,14 @@ def parse_args(argv=None):
                         "to OPEN their KV pulls before the worker "
                         "starts watching for zero active streams")
     from dynamo_tpu.runtime.flight_recorder import add_flight_args
+    from dynamo_tpu.runtime.ledger import add_ledger_args
     from dynamo_tpu.runtime.slo import add_slo_args
     from dynamo_tpu.runtime.tracing import add_trace_args
 
     add_trace_args(p)
     add_slo_args(p)
     add_flight_args(p)
+    add_ledger_args(p)
     apply_to_parser_defaults(p, load_layered_config(
         {"control_plane": None, "namespace": "dynamo",
          "component": "backend", "endpoint": "generate",
@@ -505,6 +507,12 @@ async def run(args) -> None:
     recorder = flight_recorder.configure_from_args(
         args, service=f"worker-{args.component}")
     recorder.install_crash_dump()
+    # Request ledger (ISSUE 18): hop ledgers only start when BOTH this
+    # switch is on AND the incoming request carries the frontend's
+    # ledger annotation.
+    from dynamo_tpu.runtime import ledger as ledger_mod
+
+    ledger_mod.configure_from_args(args)
     await native.warmup()  # build the C++ hasher off the event loop
     cp = ControlPlaneClient(*_split(args.control_plane))
     await cp.start()

@@ -440,3 +440,29 @@ def test_registration_pid_dead_is_conservative():
     child.wait()
     assert registration_pid_dead(
         {"address": "127.0.0.1:1", "pid": child.pid})
+
+
+def test_summarize_goodput_and_dominant_phase_why_column():
+    """ISSUE 18: the frontend's ledger series fold into the WHY column —
+    fleet goodput %% plus the dominant (non-decode) phase — and land in
+    the --once --json snapshot for scripted checks."""
+    samples = [
+        ("dynamo_goodput_good_tokens_total", {}, 90.0),
+        ("dynamo_goodput_tokens_total", {}, 100.0),
+        ("dynamo_request_phase_seconds_sum", {"phase": "prefill"}, 4.0),
+        ("dynamo_request_phase_seconds_sum", {"phase": "route"}, 0.5),
+        ("dynamo_request_phase_seconds_sum", {"phase": "decode"}, 50.0),
+    ]
+    row = dynamo_top.summarize("frontend", "a:1", samples, None)
+    assert row["goodput"] == pytest.approx(0.9)
+    # decode excluded by construction: long generations would always win.
+    assert row["dominant_phase"] == "prefill"
+    assert dynamo_top._fmt_why(row) == "prefill 90%"
+    table = dynamo_top.render_table(
+        {"control_plane": "x", "processes": [row]})
+    assert "WHY" in table
+    assert "prefill 90%" in table
+    # Ledger-less processes (workers, old frontends): the no-data dash.
+    empty = dynamo_top.summarize("worker-both", "a:1", [], None)
+    assert empty["goodput"] is None and empty["dominant_phase"] is None
+    assert dynamo_top._fmt_why(empty) == "—"

@@ -688,3 +688,47 @@ def test_n_greater_than_one_and_clear_kv_blocks():
             await engine.stop()
 
     _run(main())
+
+
+def test_debug_requests_serves_folded_ledgers():
+    """ISSUE 18: a completed request's ledger lands on
+    /debug/requests?n=K (phases + attribution summary) and the fold
+    publishes the phase histograms + goodput counter pair on /metrics."""
+    import aiohttp
+
+    async def main():
+        svc, engine, port = await _serve_tiny()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/completions", json={
+                        "model": "tiny", "prompt": "hello ledger",
+                        "max_tokens": 4, "temperature": 0.0}) as r:
+                    assert r.status == 200
+
+                async with s.get(f"{base}/debug/requests?n=5") as r:
+                    assert r.status == 200
+                    payload = await r.json()
+                assert payload["folded"] == 1
+                assert payload["ledger_enabled"] is True
+                assert payload["goodput"] == 1.0   # no SLO thresholds set
+                entry = payload["slowest"][0]
+                assert entry["output_tokens"] == 4
+                assert entry["slo_good"] is True
+                phases = {st["phase"] for st in entry["stamps"]}
+                # Local single-process serving: frontend receive + the
+                # engine's first-token tiling must both be present.
+                for phase in ("receive", "queue", "prefill", "first_token"):
+                    assert phase in phases, (phase, phases)
+
+                async with s.get(f"{base}/metrics") as r:
+                    text = await r.text()
+                assert 'dynamo_request_phase_seconds_count{phase="prefill"}' \
+                    in text
+                assert "dynamo_goodput_tokens_total 4" in text
+                assert "dynamo_goodput_good_tokens_total 4" in text
+        finally:
+            await svc.stop()
+            await engine.stop()
+
+    _run(main())

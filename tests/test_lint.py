@@ -387,6 +387,50 @@ def test_dl006_allows_scalar_args_and_cold_paths(tmp_path):
     assert findings == []
 
 
+def test_dl006_flags_allocating_ledger_stamp_args(tmp_path):
+    """The request ledger's `.stamp(...)` (runtime/ledger.py) carries
+    the same scalar-cheap hot-path contract as the flight recorder's
+    `.record(...)` — allocating/formatting argument expressions inside
+    @hot_path bodies trip DL006 on every recognized ledger receiver."""
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime.contracts import hot_path
+
+        class Engine:
+            @hot_path
+            def step(self, led, hop, bucket, req):
+                led.stamp("prefill", msg=f"bucket {bucket}")
+                hop.stamp("queue", shape=[bucket, 2])
+                self.ledger.stamp("route", n=len(req.pages))
+                led.stamp("decode", s=bucket + 1)
+        """)
+    assert codes(findings) == ["DL006"] * 4
+    assert "ledger stamp" in findings[0].message
+
+
+def test_dl006_allows_scalar_ledger_stamps(tmp_path):
+    findings = lint_source(tmp_path, """\
+        from dynamo_tpu.runtime.contracts import hot_path
+
+        class Engine:
+            @hot_path
+            def step(self, led, bucket, dur):
+                if led is not None:
+                    led.stamp("prefill", dur=dur, bucket=bucket,
+                              cached=self.counters.cached, neg=-1,
+                              tag="steady")
+
+            def cold(self, led, req):
+                # No @hot_path: formatting is allowed off the hot path.
+                led.stamp("admit", worker=str(req.worker),
+                          n=len(req.pages))
+
+            @hot_path
+            def other(self, sink, x):
+                sink.stamp(f"not a ledger {x}")   # receiver not matched
+        """)
+    assert findings == []
+
+
 def test_dl006_suppressible(tmp_path):
     findings = lint_source(tmp_path, """\
         from dynamo_tpu.runtime.contracts import hot_path

@@ -176,6 +176,66 @@ def test_scrape_reaps_dead_pid_registration():
     _run(main())
 
 
+def test_fleet_ledger_merge_sums_phase_and_goodput_series():
+    """ISSUE 18 satellite: the aggregator re-exposes the frontends'
+    request-ledger series pre-summed — per-phase sum(_sum)/sum(_count)
+    plus the goodput counter pair and their ratio."""
+    async def main():
+        from dynamo_tpu.runtime.ledger import LedgerSink, RequestLedger
+        from dynamo_tpu.runtime.metrics import MetricsRegistry
+        from dynamo_tpu.runtime.status import (
+            StatusServer, register_status_endpoint)
+
+        cp = InProcessControlPlane()
+        await cp.start()
+        agg = MetricsAggregator(cp)
+
+        # Frontend A: one good request (no SLO thresholds set).
+        reg_a = MetricsRegistry()
+        sink_a = LedgerSink(reg_a)
+        led_a = RequestLedger("req-a")
+        led_a.stamp("queue", dur=0.25)
+        led_a.stamp("prefill", dur=1.0)
+        sink_a.fold(led_a, ttft=1.25, tpot=0.01, output_tokens=10)
+
+        # Frontend B: one request that blows its TTFT SLO.
+        reg_b = MetricsRegistry()
+        sink_b = LedgerSink(reg_b, slo_ttft=0.5)
+        led_b = RequestLedger("req-b")
+        led_b.stamp("prefill", dur=2.0)
+        sink_b.fold(led_b, ttft=2.0, tpot=0.01, output_tokens=8)
+
+        servers = []
+        try:
+            for name, reg in (("frontend-a", reg_a), ("frontend-b", reg_b)):
+                status = StatusServer(registry=reg)
+                port = await status.start()
+                servers.append(status)
+                await register_status_endpoint(cp, name, port)
+
+            await agg._scrape_once()
+            text = agg.expose()
+
+            def val(g, **labels):
+                return g.value(labels=labels or None)
+
+            assert val(agg._g_phase_sum, phase="prefill") == 3.0
+            assert val(agg._g_phase_count, phase="prefill") == 2.0
+            assert val(agg._g_phase_sum, phase="queue") == 0.25
+            assert val(agg._g_goodput_good) == 10.0
+            assert val(agg._g_goodput_total) == 18.0
+            assert abs(val(agg._g_goodput) - 10.0 / 18.0) < 1e-9
+            assert "dynamo_aggregate_request_phase_seconds_sum" in text
+            assert "dynamo_aggregate_goodput_ratio" in text
+        finally:
+            for status in servers:
+                await status.stop()
+            await agg.stop()
+            await cp.close()
+
+    _run(main())
+
+
 def test_http_exposition():
     async def main():
         import aiohttp

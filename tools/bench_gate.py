@@ -279,6 +279,98 @@ def flight_recorder_overhead_checks() -> dict:
     }
 
 
+def ledger_checks() -> dict:
+    """ISSUE 18: the request ledger must be HONEST and FREE.
+
+    Honest — a mocker fleet's assembled ledgers must explain >= 90% of
+    each request's measured TTFT (no dark time), and a FABRICATED
+    ledger claiming more phase time than the wall-clock envelope must
+    FAIL `coverage_ok` (a ledger that can over-claim can hide anything).
+    Free — steady-decode `EngineStepCounters` deltas are byte-identical
+    ledger-on vs ledger-off (the same pinning discipline as the
+    tracing/flight-recorder checks: zero added host syncs, dispatches or
+    recompiles)."""
+    import asyncio
+    import time
+
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.llm.mocker.engine import MockEngine, MockEngineArgs
+    from dynamo_tpu.llm.preprocessor import PreprocessedRequest
+    from dynamo_tpu.models import config as mcfg
+    from dynamo_tpu.runtime import ledger as ledger_mod
+
+    async def fleet_coverage():
+        """3 concurrent requests against a mocker whose prefill budget
+        forces multi-step (really-sleeping) prefills, so TTFT is real
+        wall time the queue/prefill stamps must account for."""
+        eng = MockEngine(MockEngineArgs(
+            block_size=32, num_blocks=4096, max_batched_tokens=64,
+            speedup_ratio=1.0))
+        try:
+            async def one(i: int) -> float:
+                req = PreprocessedRequest(
+                    request_id=f"led{i}", model="smoke",
+                    token_ids=list(range(1, 257)),
+                    sampling=SamplingParams(max_tokens=2))
+                led = ledger_mod.begin(req)
+                t0 = time.monotonic()
+                ttft = 0.0
+                async for d in eng.generate(req):
+                    if d.token_ids:
+                        ttft = time.monotonic() - t0
+                        break
+                return ledger_mod.ttft_coverage(led, ttft)
+            return await asyncio.gather(*(one(i) for i in range(3)))
+        finally:
+            await eng.stop()
+
+    ratios = asyncio.run(asyncio.wait_for(fleet_coverage(), 120))
+
+    # Fabricated over-claim: a ledger whose phases sum past the
+    # wall-clock envelope must FAIL the coverage check.
+    fab = ledger_mod.RequestLedger("fabricated")
+    fab.stamp("prefill", dur=2.0)
+    fabricated_fails = not ledger_mod.coverage_ok(fab, 1.0)
+
+    def steady_run(on: bool):
+        ledger_mod.set_enabled(on)
+        core = EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=128,
+            enable_prefix_cache=False, decode_window=2,
+            window_pipeline_depth=2,
+            scheduler=SchedulerConfig(
+                max_seqs=8, block_size=8, max_pages_per_seq=32,
+                max_prefill_chunk=128, decode_buckets=(1, 2, 4, 8),
+                prefill_buckets=(16, 128))))
+        core.add_request("a", list(range(1, 71)),
+                         SamplingParams(max_tokens=64))
+        for _ in range(8):   # prefill + window warmup
+            core.step()
+        base = core.counters.snapshot()
+        for _ in range(20):
+            core.step()
+        return core.counters.delta(base)
+
+    try:
+        d_off = steady_run(False)
+        d_on = steady_run(True)
+    finally:
+        ledger_mod.set_enabled(True)  # the process default
+
+    return {
+        "ledger_fleet_ttft_coverage": round(min(ratios), 4),
+        "ledger_coverage_ok": all(
+            ledger_mod.COVERAGE_FLOOR <= r <= ledger_mod.COVERAGE_CEIL
+            for r in ratios),
+        "ledger_fabricated_overclaim_fails": fabricated_fails,
+        "ledger_extra_host_syncs":
+            d_on["host_syncs"] - d_off["host_syncs"],
+        "ledger_counters_byte_identical": d_on == d_off,
+    }
+
+
 def decode_wall_checks() -> dict:
     """ISSUE 6 smoke: the decode-bandwidth-wall features measured on CPU
     with the tiny model —
@@ -692,6 +784,11 @@ def run_smoke(args) -> int:
        decode keeps EngineStepCounters deltas byte-identical to
        recorder-off (0 extra host syncs) and within the one-ring-write-
        per-window budget; a fabricated chatty recorder must fail it;
+    7c. request-ledger honesty + overhead (ISSUE 18): a mocker fleet's
+       assembled ledgers explain >= 90% of each measured TTFT, a
+       fabricated ledger claiming more time than the wall-clock
+       envelope FAILS coverage_ok, and ledger-on steady decode keeps
+       EngineStepCounters deltas byte-identical to ledger-off;
     8. decode-bandwidth-wall features (ISSUE 6): int8-KV traffic ratio
        <= 0.55 at serving geometry, tiny-model greedy pin bf16 == int8,
        spec-decode acceptance >= 0.6 + modeled sweep speedup >= 1.3 on
@@ -904,6 +1001,7 @@ def run_smoke(args) -> int:
         **tracing_overhead_checks(),
         **telemetry_overhead_checks(),
         **flight_recorder_overhead_checks(),
+        **ledger_checks(),
         **decode_wall_checks(),
         **moe_decode_checks(),
         **prefill_plane_checks(),

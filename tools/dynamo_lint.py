@@ -610,38 +610,49 @@ class ContractConsistency(Rule):
 
 
 class FlightRecorderDiscipline(Rule):
-    """DL006: `FlightRecorder.record(...)` in `@hot_path` bodies must
-    pass pre-computed scalars only.
+    """DL006: `FlightRecorder.record(...)` — and request-ledger
+    `.stamp(...)` — in `@hot_path` bodies must pass pre-computed
+    scalars only.
 
     The recorder's hot-path contract (runtime/flight_recorder.py) is
     that `record` itself does no formatting — which only holds if call
-    sites don't smuggle the formatting into the ARGUMENTS.  Allowed
-    argument expressions: constants, bare names, attribute chains up to
-    `a.b.c` (a plain slot read), and unary +/- of those.  Rejected:
-    f-strings / %-formatting / `.format()` and any call expression,
-    container displays and comprehensions (they allocate per event),
-    and deeper attribute chains (`a.b.c.d` — in this tree, a chain that
-    deep is reaching through an object graph and usually hides a
-    property).  Receivers recognized as flight recorders: any
-    `*.record(...)` whose receiver chain ends in `flight`, `recorder`,
-    `flight_recorder`, or the conventional local alias `fl`."""
+    sites don't smuggle the formatting into the ARGUMENTS.  The request
+    ledger (runtime/ledger.py) makes the same promise for `stamp`, so
+    the same rule covers both.  Allowed argument expressions:
+    constants, bare names, attribute chains up to `a.b.c` (a plain slot
+    read), and unary +/- of those.  Rejected: f-strings / %-formatting
+    / `.format()` and any call expression, container displays and
+    comprehensions (they allocate per event), and deeper attribute
+    chains (`a.b.c.d` — in this tree, a chain that deep is reaching
+    through an object graph and usually hides a property).  Receivers
+    recognized as flight recorders: any `*.record(...)` whose receiver
+    chain ends in `flight`, `recorder`, `flight_recorder`, or the
+    conventional local alias `fl`; as ledgers: `.stamp(...)` on
+    `ledger`, `led`, `hop`, or `request_ledger`."""
 
     code = "DL006"
     name = "flight-recorder-hot-path-args"
 
     RECEIVERS = frozenset({"flight", "recorder", "flight_recorder", "fl"})
+    LEDGER_RECEIVERS = frozenset({"ledger", "led", "hop",
+                                  "request_ledger"})
     MAX_ATTR_PARTS = 3        # self.x.y is a slot read; deeper is a smell
 
     def _is_recorder_call(self, call: ast.Call) -> bool:
         f = call.func
-        if not (isinstance(f, ast.Attribute)
-                and f.attr in ("record", "record_always")):
+        if not isinstance(f, ast.Attribute):
+            return False
+        if f.attr in ("record", "record_always"):
+            receivers = self.RECEIVERS
+        elif f.attr == "stamp":
+            receivers = self.LEDGER_RECEIVERS
+        else:
             return False
         recv = f.value
         if isinstance(recv, ast.Name):
-            return recv.id in self.RECEIVERS
+            return recv.id in receivers
         if isinstance(recv, ast.Attribute):
-            return recv.attr in self.RECEIVERS
+            return recv.attr in receivers
         if isinstance(recv, ast.Call):
             # flight_recorder.get_recorder().record(...) — the inline
             # singleton spelling must not evade the rule.
@@ -697,12 +708,14 @@ class FlightRecorderDiscipline(Rule):
                         or not self._is_recorder_call(node):
                     continue
                 exprs = list(node.args) + [kw.value for kw in node.keywords]
+                what = ("ledger stamp" if node.func.attr == "stamp"
+                        else "FlightRecorder.record")
                 for expr in exprs:
                     why = self._arg_problem(expr)
                     if why is not None:
                         out.append(self.finding(
                             ctx, expr,
-                            f"FlightRecorder.record arg in @hot_path "
+                            f"{what} arg in @hot_path "
                             f"function {fn.name!r} is not a pre-computed "
                             f"scalar: {why}"))
         return out

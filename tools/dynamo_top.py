@@ -241,6 +241,22 @@ def summarize(component: str, address: str, samples: List[Sample],
         row["moe_load_imbalance"] = None
     row["moe_dropped_tokens"] = total(
         samples, "dynamo_moe_dropped_tokens_total")
+    # Request-ledger attribution (ISSUE 18): goodput = SLO-good tokens /
+    # total tokens, and the dominant phase = the phase with the biggest
+    # summed seconds across completed ledgers (decode excluded — it
+    # scales with output length and would drown every upstream stall).
+    # The WHY column names the hop eating the latency budget.
+    good = total(samples, "dynamo_goodput_good_tokens_total")
+    tot = total(samples, "dynamo_goodput_tokens_total")
+    row["goodput"] = (good / tot if good is not None and tot else None)
+    phase_sums = {
+        labels["phase"]: v
+        for n, labels, v in samples
+        if n == "dynamo_request_phase_seconds_sum" and "phase" in labels
+        and labels["phase"] != "decode"}
+    row["dominant_phase"] = (
+        max(phase_sums, key=phase_sums.get)
+        if phase_sums and max(phase_sums.values()) > 0 else None)
     return row
 
 
@@ -412,6 +428,19 @@ def _fmt_exp(r: dict) -> str:
     return cell
 
 
+def _fmt_why(r: dict) -> str:
+    """WHY cell: the dominant request phase (where completed requests
+    spent the most summed time, decode excluded) plus goodput — the
+    fraction of emitted tokens from SLO-good requests.  Only frontends
+    fold ledgers, so worker rows render the no-data dash."""
+    phase = r.get("dominant_phase")
+    goodput = r.get("goodput")
+    if phase is None and goodput is None:
+        return "—"
+    g = "—" if goodput is None else f"{100.0 * goodput:.0f}%"
+    return f"{phase or '—'} {g}"
+
+
 def _fmt_mesh(r: dict) -> str:
     """MESH cell from the worker's published SliceSpec: the mesh shape
     (`describe()` string), suffixed :P / :D for a dedicated
@@ -450,6 +479,8 @@ COLUMNS = (
     ("TPOTp50", 8, lambda r: _fmt(r.get("tpot_p50_s"), "ms")),
     ("TPOTp99", 8, lambda r: _fmt(r.get("tpot_p99_s"), "ms")),
     ("SLO", 5, lambda r: r.get("slo_state") or "—"),
+    # Request-ledger attribution: dominant phase + goodput fraction.
+    ("WHY", 14, _fmt_why),
     # Engine heartbeat age / stall count (flight recorder + watchdog):
     # a wedged step loop reads as a growing AGE with a `!` marker.
     ("AGE/STL", 9, _fmt_age_stall),

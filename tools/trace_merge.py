@@ -30,6 +30,18 @@ choices, SLO transitions, stalls) becomes a Perfetto INSTANT marker on
 the owning process's track, time-aligned with the trace spans by their
 shared wall clock and deduped by (service, seq) — so "what was the
 engine doing when this request went slow" is one view, not two files.
+
+Request ledgers (ISSUE 18) merge the same way:
+
+    python tools/trace_merge.py http://127.0.0.1:8080 \
+        --ledger /tmp/requests.json -o merged.json
+
+where requests.json is a saved `/debug/requests?n=K` payload (or a bare
+list of ledger payloads).  Each phase stamp becomes a complete
+("ph":"X") child span on the owning request's trace track — ledger
+request ids ARE frontend trace ids, so the stamps land time-aligned
+under the request's own spans; requests without a trace get a `ledger`
+process lane.  Duplicate ledgers across dumps dedupe by request id.
 """
 
 from __future__ import annotations
@@ -161,6 +173,84 @@ def merge_flight_events(merged: dict, flight_events: List[dict]) -> int:
     return added
 
 
+def load_ledger_dump(path: str) -> List[dict]:
+    """Parse one saved ledger dump into payload dicts.  Accepts the
+    `/debug/requests` body (`{"slowest": [...]}`), a bare list of
+    ledger payloads, or a single payload; entries without a request_id
+    or stamps list are skipped — telemetry files must merge tolerantly
+    or not at all, never raise."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        rows = doc.get("slowest") or doc.get("requests") \
+            or ([doc] if doc.get("request_id") else [])
+    elif isinstance(doc, list):
+        rows = doc
+    else:
+        rows = []
+    out = []
+    for row in rows:
+        if (isinstance(row, dict) and row.get("request_id")
+                and isinstance(row.get("stamps"), list)):
+            out.append(row)
+    return out
+
+
+def merge_ledger_spans(merged: dict, ledgers: List[dict]) -> int:
+    """Append ledger phase stamps to a Chrome trace doc as complete
+    ("ph":"X") spans on the owning request's track.  Frontend trace ids
+    ARE request ids, so a request that was traced gets its ledger spans
+    on the SAME (pid, tid) lane as its spans — time-aligned by the
+    shared monotonic clock (stamp `t` is the phase END, so the span
+    starts at `t - dur`).  Requests with no trace share one `ledger`
+    process lane.  Dedupes by request id across dumps.  Returns the
+    number of spans added."""
+    events = merged["traceEvents"]
+    lanes: Dict[str, tuple] = {}      # trace_id -> (pid, tid)
+    max_pid = max_tid = 0
+    for ev in events:
+        max_pid = max(max_pid, ev.get("pid", 0))
+        max_tid = max(max_tid, ev.get("tid", 0))
+        tid_key = (ev.get("args") or {}).get("trace_id")
+        if tid_key is not None and tid_key not in lanes:
+            lanes[tid_key] = (ev["pid"], ev["tid"])
+    ledger_pid = None
+    seen: set = set()
+    added = 0
+    for led in ledgers:
+        rid = led["request_id"]
+        if rid in seen:
+            continue
+        seen.add(rid)
+        lane = lanes.get(rid)
+        if lane is None:
+            if ledger_pid is None:
+                max_pid += 1
+                ledger_pid = max_pid
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": ledger_pid, "tid": 0,
+                               "args": {"name": "ledger"}})
+            max_tid += 1
+            lane = (ledger_pid, max_tid)
+        pid, tid = lane
+        for stamp in led["stamps"]:
+            try:
+                t, dur = float(stamp["t"]), float(stamp["dur"])
+                phase = str(stamp["phase"])
+            except (KeyError, TypeError, ValueError):
+                continue  # partial dump: render what parses
+            args = dict(stamp.get("attrs") or {})
+            args["request_id"] = rid
+            events.append({
+                "name": f"ledger.{phase}", "cat": "ledger", "ph": "X",
+                "ts": round((t - dur) * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": pid, "tid": tid, "args": args,
+            })
+            added += 1
+    return added
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "tools/trace_merge.py", description=__doc__.splitlines()[0])
@@ -177,6 +267,12 @@ def main(argv=None) -> int:
                    help="flight-recorder JSONL dump(s) "
                         "(runtime/flight_recorder.py) merged as instant "
                         "markers on the owning process track; repeatable")
+    p.add_argument("--ledger", action="append", default=[],
+                   metavar="DUMP.json",
+                   help="saved /debug/requests payload(s) "
+                        "(runtime/ledger.py) — each request's phase "
+                        "stamps render as child spans on its own trace "
+                        "track, deduped by request id; repeatable")
     args = p.parse_args(argv)
 
     payloads = []
@@ -198,10 +294,20 @@ def main(argv=None) -> int:
                   file=sys.stderr)
     n_flight = merge_flight_events(merged, flight_events) \
         if flight_events else 0
+    ledgers: List[dict] = []
+    for lpath in args.ledger:
+        try:
+            ledgers.extend(load_ledger_dump(lpath))
+        except (OSError, ValueError) as e:
+            print(f"warning: skipping ledger dump {lpath}: {e}",
+                  file=sys.stderr)
+    n_ledger = merge_ledger_spans(merged, ledgers) if ledgers else 0
     n_spans = sum(1 for ev in merged["traceEvents"] if ev["ph"] == "X")
     with open(args.out, "w") as f:
         json.dump(merged, f)
     extra = f" + {n_flight} flight event(s)" if n_flight else ""
+    if n_ledger:
+        extra += f" + {n_ledger} ledger span(s)"
     print(f"wrote {args.out}: {n_spans} spans from {len(payloads)} "
           f"process(es){extra} — open in https://ui.perfetto.dev")
     return 0
