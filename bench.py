@@ -543,6 +543,22 @@ def main():
 
     moe_decode = run_moe_decode(batch=BATCH if on_tpu else 4)
 
+    # Ring-attention plane (ISSUE 19): the Pallas flash ring (next-hop
+    # RDMA under the fold) vs the XLA ppermute ring vs the meshless
+    # oracle at sp2 prefill shape, with modeled per-hop ICI bytes vs the
+    # datasheet (ring_ici_mbu) and the tiny-engine kernel-path
+    # attribution.  Gate floor on TPU: ring_plane.kernel_vs_xla >= 1.15
+    # (parity-zeroed — a fast-but-wrong kernel fails it); off-TPU the
+    # interpret-mode kernel slope shows plumbing, not silicon, and only
+    # presence/parity/attribution are smoke-gated.
+    from dynamo_tpu.bench.ring_plane import (
+        run_ring_plane, run_tiny_ring_plane)
+
+    if on_tpu:
+        ring_plane = run_ring_plane(cfg, batch=2, seq=CTX, sp=2)
+    else:
+        ring_plane = run_tiny_ring_plane()
+
     serving_tok_s = sorted(serving_runs)[len(serving_runs) // 2]
     prefill_cold = prefill_runs[0]
     prefill_steady = max(prefill_runs[1:])
@@ -611,6 +627,7 @@ def main():
         "drain_migration": drain_migration,
         "sharded_decode": sharded_decode,
         "moe_decode": moe_decode,
+        "ring_plane": ring_plane,
         "transfer": transfer,
         "peak_flops_nominal": round(peak / 1e12, 1),
         "peak_flops_measured": round(peak_measured / 1e12, 1),

@@ -130,6 +130,17 @@ class FloorSpec:
 #   parity vs the moe_dense oracle fails, so this floor also trips on a
 #   fast-but-wrong kernel.  Absent (skipped, not passed) on dense-model
 #   rounds or grouped-ineligible geometries.
+# - ring_plane.kernel_vs_xla >= 1.15 — ISSUE 19: the Pallas flash ring
+#   (double-buffered next-hop RDMA issued BEFORE the local block's
+#   online-softmax fold; per-hop s/p intermediates never leave VMEM)
+#   must beat the XLA ppermute ring by >= 1.15x at sp prefill shape.
+#   The XLA path's overlap is scheduler-dependent and its per-hop
+#   intermediates round-trip HBM, so parity-or-worse means the kernel
+#   silently fell back (or the RDMA stopped overlapping compute).  The
+#   bench ZEROES the ratio when numeric parity vs the XLA ring fails,
+#   so this floor also trips on a fast-but-wrong kernel.  Absent
+#   (skipped, not passed) when the round's geometry is
+#   ring_geometry_ok-ineligible or the rig has < 2 chips.
 # - sharded_decode.pp_fused_vs_single >= 1.2 — ISSUE 12: the all-in-one
 #   pp stage program (schedule + fused argmax, [B] tokens out) must beat
 #   the unfused loop it replaced (schedule dispatch returning [B, V] f32
@@ -148,6 +159,7 @@ TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("prefix_fleet.remote_hit_rate", minimum=0.2),
     FloorSpec("sharded_decode.tok_s_per_chip_ratio", minimum=0.8),
     FloorSpec("sharded_decode.pp_fused_vs_single", minimum=1.2),
+    FloorSpec("ring_plane.kernel_vs_xla", minimum=1.15),
     FloorSpec("moe_decode.grouped_vs_dense", minimum=1.5),
     FloorSpec("prefill_plane.packed_vs_padded_tok_s_ratio", minimum=1.2),
     FloorSpec("transfer.device_vs_host_ratio", minimum=2.0),

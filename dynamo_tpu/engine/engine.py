@@ -384,6 +384,7 @@ class EngineCore:
                              "mesh is not wired yet (multihost v1 covers "
                              "tp/dp/dp-attention)")
         self._sp_step = None
+        self._sp_pallas = False  # sp prefill step built with the kernel
         self.sp_prefill_count = 0  # served prefills that ran the ring path
         if self._pp:
             # Pipeline serving: stage-rotated GPipe step over the pp axis.
@@ -438,9 +439,23 @@ class EngineCore:
                 # impossible there) instead of a hand-coded combo list.
                 from dynamo_tpu.parallel.sharding import make_sp_prefill_step
 
+                # Pallas flash ring rides the same auto-pallas decision
+                # as decode, re-checked against the capability table
+                # with the sp_prefill role (multihost shard_map custom
+                # calls stay declared out); per-dispatch geometry
+                # eligibility is the kernel's own shared predicate at
+                # trace time (llama._sp_ring_attention).
+                self._sp_pallas = bool(pallas) and plane_capability(
+                    self.mesh,
+                    PlaneSpec(role="sp_prefill", moe=cfg.is_moe,
+                              quant=self.cache_cfg.quantized,
+                              use_pallas=True,
+                              dp_attention=config.dp_attention),
+                    multihost=self._mh).ok
                 self._sp_step = make_sp_prefill_step(
                     cfg, self.block_size, self.mesh,
-                    kv_quant=self.cache_cfg.quantized)
+                    kv_quant=self.cache_cfg.quantized,
+                    use_pallas=self._sp_pallas)
         else:
             from dynamo_tpu.parallel.sharding import resolve_moe_mode
 
@@ -1427,10 +1442,30 @@ class EngineCore:
             # (ring_payload_bytes_per_token), so the series halves under
             # int8 exactly like the decode read series does.
             sp = self.mesh.shape["sp"]
+            # PATH-INDEPENDENT by construction: the Pallas flash ring
+            # moves exactly the rows+scales the XLA ppermute ring moves
+            # (same per-token payload, same sp-1 hops), so the modeled
+            # series is charged before the path split and can never
+            # fork between them.
             self.counters.note_ring_exchange(
                 sum(w.length for w in batch.items)
                 * self.cache_cfg.ring_payload_bytes_per_token
                 * (sp - 1) // sp)
+            if self._sp_pallas:
+                # Kernel-path attribution via the SAME predicate the
+                # trace-time dispatch uses (shapes are static there),
+                # so this host counter can never disagree with the
+                # compiled program about which ring ran.
+                from dynamo_tpu.ops.pallas.ring_attention import (
+                    ring_kernel_supported)
+
+                cfg = self.config.model
+                tp = self.mesh.shape["tp"]
+                feat = cfg.num_kv_heads * cfg.head_dim // max(tp, 1)
+                if ring_kernel_supported(
+                        feat, T // sp,
+                        jax.default_backend() != "tpu"):
+                    self.counters.ring_kernel_prefills += len(batch.items)
             logits, self.cache = self._sp_step(
                 self.params, self.cache,
                 self._dev(tokens), self._dev(positions),

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from dynamo_tpu.engine import kv_cache as kvc
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.runtime.contracts import hot_path
 from dynamo_tpu.runtime.jax_compat import axis_size, shard_map
 from dynamo_tpu.ops.attention import paged_attention
 
@@ -134,6 +135,77 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+@hot_path
+def _sp_ring_attention(cfg, q, k, v, positions, ring_quant, sp_mesh,
+                       sp_pallas):
+    """Sequence-parallel whole-prompt attention dispatch: the Pallas
+    flash ring kernel (double-buffered RDMA exchange hidden under the
+    local flash fold — ops/pallas/ring_attention.py) when selected and
+    eligible, else the XLA ppermute ring, which stays the oracle.
+
+    Selection is static at trace time (shapes and mesh are): the SAME
+    `ring_kernel_supported` predicate the engine's kernel-path counter
+    and the measurement tools consult, so the served path and every
+    tool agree on which ring a geometry runs.  Ineligible geometry
+    under `sp_pallas` falls back LOUDLY here rather than silently
+    wrong-shaping inside Mosaic."""
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.ops.pallas.ring_attention import (
+        ring_flash_attention, ring_kernel_supported)
+    from dynamo_tpu.ops.ring_attention import ring_causal_attention
+
+    interp = jax.default_backend() != "tpu"
+    sp = sp_mesh.shape["sp"]
+    tp = sp_mesh.shape["tp"]
+    B, T = positions.shape
+    feat = cfg.num_kv_heads * cfg.head_dim // max(tp, 1)
+    use_kernel = sp_pallas and ring_kernel_supported(feat, T // sp,
+                                                     interp)
+
+    # Heads stay tp-sharded inside the ring (attention is
+    # head-independent): without "tp" in the specs GSPMD would
+    # all-gather the column-parallel q/k/v projections and every tp
+    # shard would redo all heads' attention.
+    spec4 = P("dp", "sp", "tp", None)
+    if use_kernel:
+        def ring(qs, ks, vs, ps, ksc=None, vsc=None):
+            return ring_flash_attention(
+                qs, ks, vs, ps, mesh=sp_mesh, scale=cfg.query_scale,
+                soft_cap=cfg.attn_soft_cap, k_scale=ksc, v_scale=vsc,
+                interpret=interp)
+    else:
+        def ring(qs, ks, vs, ps, ksc=None, vsc=None):
+            return ring_causal_attention(
+                qs, ks, vs, ps, axis_name="sp", scale=cfg.query_scale,
+                soft_cap=cfg.attn_soft_cap, k_scale=ksc, v_scale=vsc)
+
+    if ring_quant is not None:
+        # Quantized exchange: int8 chunk rows + per-token-per-head
+        # scales ride the ring together and each hop dequantizes
+        # in-register (both ring paths share kv_cache.dequantize_rows
+        # numerics) — the per-hop ICI payload drops to F + 4·Hkv
+        # bytes/token.
+        spec3 = P("dp", "sp", "tp")
+        kq4, vq4, ks3, vs3 = ring_quant
+        return shard_map(
+            lambda qs, ks_, vs_, ksc, vsc, ps: ring(
+                qs, ks_, vs_, ps, ksc, vsc),
+            mesh=sp_mesh,
+            in_specs=(spec4, spec4, spec4, spec3, spec3,
+                      P("dp", "sp")),
+            out_specs=spec4,
+            check_vma=False,
+        )(q, kq4, vq4, ks3, vs3, positions)
+    return shard_map(
+        lambda qs, ks, vs, ps: ring(qs, ks, vs, ps),
+        mesh=sp_mesh,
+        in_specs=(spec4, spec4, spec4, P("dp", "sp")),
+        out_specs=spec4,
+        check_vma=False,
+    )(q, k, v, positions)
+
+
 def _attention_block(
     cfg: ModelConfig,
     p_attn: Params,
@@ -148,6 +220,7 @@ def _attention_block(
     k_cache: jax.Array,      # [S, F] this layer's cache buffer (flat feat)
     v_cache: jax.Array,
     sp_mesh=None,            # mesh → ring attention over its sp axis
+    sp_pallas=False,         # sp branch: Pallas flash ring when eligible
     pallas_mesh=None,        # mesh → shard_map the decode kernel (dp, tp)
     dp_local_mesh=None,      # mesh → device-local dp-attention decode
     dp_local_pallas=False,   # dp-local body: pallas kernel on local slots
@@ -308,46 +381,12 @@ def _attention_block(
     if sp_mesh is not None:
         # Sequence-parallel full-prompt prefill: the chunk IS the whole
         # sequence, sharded over sp — ring attention visits every K/V
-        # block over the ICI ring (ops/ring_attention.py); no cached
+        # block over the ICI ring (the Pallas flash kernel or the XLA
+        # ppermute oracle, picked in _sp_ring_attention); no cached
         # context is read (chunked continuation stays on the paths
         # below).  Cache writes above remain GSPMD-managed.
-        from jax.sharding import PartitionSpec as P
-
-        from dynamo_tpu.ops.ring_attention import ring_causal_attention
-
-        # Heads stay tp-sharded inside the ring (attention is
-        # head-independent): without "tp" in the specs GSPMD would
-        # all-gather the column-parallel q/k/v projections and every tp
-        # shard would redo all heads' attention.
-        spec4 = P("dp", "sp", "tp", None)
-        if ring_quant is not None:
-            # Quantized exchange: int8 chunk rows + per-token-per-head
-            # scales ride the ring together and each hop dequantizes
-            # in-register (ring_causal_attention k_scale/v_scale) —
-            # the per-hop ICI payload drops to F + 4·Hkv bytes/token.
-            spec3 = P("dp", "sp", "tp")
-            kq4, vq4, ks3, vs3 = ring_quant
-            out = shard_map(
-                lambda qs, ks_, vs_, ksc, vsc, ps: ring_causal_attention(
-                    qs, ks_, vs_, ps, axis_name="sp",
-                    scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap,
-                    k_scale=ksc, v_scale=vsc),
-                mesh=sp_mesh,
-                in_specs=(spec4, spec4, spec4, spec3, spec3,
-                          P("dp", "sp")),
-                out_specs=spec4,
-                check_vma=False,
-            )(q, kq4, vq4, ks3, vs3, positions)
-        else:
-            out = shard_map(
-                lambda qs, ks, vs, ps: ring_causal_attention(
-                    qs, ks, vs, ps, axis_name="sp",
-                    scale=cfg.query_scale, soft_cap=cfg.attn_soft_cap),
-                mesh=sp_mesh,
-                in_specs=(spec4, spec4, spec4, P("dp", "sp")),
-                out_specs=spec4,
-                check_vma=False,
-            )(q, k, v, positions)
+        out = _sp_ring_attention(cfg, q, k, v, positions, ring_quant,
+                                 sp_mesh, sp_pallas)
     elif ctx_slots is None:
         # Decode hot path: stream pages via the Pallas kernel — no
         # materialised context gather (ops/pallas/paged_attention.py).
@@ -725,6 +764,7 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                       mesh=None,
                       with_expert_load: bool = False,
                       sp_ring: bool = False,
+                      sp_ring_pallas: bool = False,
                       return_hidden: bool = False,
                       with_input_embeds: bool = False,
                       dp_local: bool = False):
@@ -746,10 +786,12 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
     every non-MoE call site unchanged.
 
     `sp_ring`: sequence-parallel FULL-PROMPT prefill — the T axis shards
-    over the mesh's sp axis and attention runs on the ICI ring
-    (ops/ring_attention.py).  The chunk must be the whole sequence (no
-    prior cached context is read); build via
-    parallel.sharding.make_sp_prefill_step.
+    over the mesh's sp axis and attention runs on the ICI ring.  The
+    chunk must be the whole sequence (no prior cached context is read);
+    build via parallel.sharding.make_sp_prefill_step.  With
+    `sp_ring_pallas`, eligible geometry runs the Pallas flash ring
+    kernel (ops/pallas/ring_attention.py — RDMA exchange hidden under
+    the fold) instead of the XLA ppermute ring.
     """
     cfg.validate()
 
@@ -814,6 +856,7 @@ def make_forward_step(cfg: ModelConfig, block_size: int,
                 block_tables, block_size,
                 k_layers[i], v_layers[i],
                 sp_mesh=mesh if (sp_ring and T > 1) else None,
+                sp_pallas=sp_ring_pallas,
                 # dp_local owns its own shard_map body; pallas routing
                 # there happens INSIDE it (local slot rebase), not via
                 # the head-sharded pallas_mesh wrapper.

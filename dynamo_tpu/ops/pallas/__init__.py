@@ -22,9 +22,15 @@ from dynamo_tpu.ops.pallas.paged_prefill import (
     PACK_ALIGN,
     paged_prefill_attention,
 )
+from dynamo_tpu.ops.pallas.ring_attention import (
+    ring_flash_attention,
+    ring_geometry_ok,
+    ring_kernel_supported,
+)
 
 __all__ = ["paged_decode_attention", "paged_prefill_attention",
            "mosaic_geometry_ok", "PACK_ALIGN",
            "grouped_expert_ffn", "moe_grouped_geometry_ok",
            "quantize_moe_params", "dequantize_moe_params",
-           "moe_params_quantized"]
+           "moe_params_quantized", "ring_flash_attention",
+           "ring_geometry_ok", "ring_kernel_supported"]

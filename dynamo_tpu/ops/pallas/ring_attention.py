@@ -1,0 +1,450 @@
+"""Latency-hiding Pallas ring attention for sequence-parallel prefill.
+
+The XLA ring (`ops/ring_attention.py`) rotates K/V blocks with
+`lax.ppermute` and HOPES the scheduler overlaps each hop with the local
+einsums — nothing guarantees it, and the per-hop `s`/`p` intermediates
+round-trip HBM.  This kernel makes the overlap structural (blockwise
+ring attention, Liu et al.): each shard keeps its Q block resident in
+VMEM with online-softmax (m, l, acc) state, and the NEXT hop's K/V
+block — absolute positions and, when quantized, the int8 rows' `[T,
+Hkv]` f32 scales riding with them exactly as on the XLA path — is
+shipped over ICI via double-buffered `make_async_remote_copy` RDMA
+issued BEFORE the local block's compute.  The transfer hides under the
+flash fold on every hop instead of being scheduled on faith.
+
+Numerics mirror `ring_causal_attention` operand-for-operand (same
+visiting order starting at the shard's own block, same f32 softmax
+path, same `NEG` mask fill, same dequant-to-compute-dtype-then-f32
+int8 path via `kv_cache.dequantize_rows` semantics), so the XLA ring
+stays the oracle: `tests/test_ring_kernel.py` pins kernel == XLA ring
+== meshless `causal_attention` for bf16 and int8.
+
+Hardware sync protocol (compiled mode only; interpret executes
+sequentially so the races cannot occur and the remote-signal
+primitives aren't implemented there):
+
+- an initial neighbor barrier (`get_barrier_semaphore`) so no shard
+  RDMAs into a peer that hasn't entered the kernel;
+- credit-based ack backpressure: the send at step s writes the
+  receiver's slot (s+1) % 2, which the receiver last reads at step
+  s-1 — so the sender waits for the receiver's ack before the send at
+  every step >= 1, and each shard acks its LEFT neighbor (the device
+  writing into its buffers) after folding a slot it will never read
+  again.
+
+Eligibility is `ring_geometry_ok` (the mosaic_geometry_ok discipline:
+one predicate shared by the model's trace-time dispatch, the engine's
+kernel-path counter, profile_decode and the bench so they can never
+disagree on which path a geometry runs); ineligible shapes fall back
+to the XLA ppermute path loudly at the dispatch site.
+
+Interpret mode: CPU tier-1 exercises the kernel body end to end.
+jax's interpret-mode discharge of `dma_start_p` only supports remote
+copies under a SINGLE named mesh axis, but every repo mesh binds five
+(dp, pp, sp, ep, tp) — `_install_interpret_remote_dma()` re-registers
+a narrowly generalized discharge rule (flattened row-major logical id
+over the axis env, multi-name all_gathers; single-axis behavior
+delegated untouched to the stock rule) so the same kernel body runs
+under the real serving meshes on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+# ---------------------------------------------------------------------------
+# Eligibility
+
+
+def ring_geometry_ok(feat: int, t_local: int) -> bool:
+    """THE eligibility rule for the ring kernel: the per-shard K/V
+    feature width (F/tp under head-sharded tp) must fill MXU lanes
+    (128-aligned) and the per-shard chunk length must be
+    sublane-aligned (8), or Mosaic's DMA lowering dies.  Shared by the
+    trace-time dispatch in `models/llama._attention_block`, the
+    engine's kernel-path counter, profile_decode and bench/ring_plane —
+    the same discipline as `mosaic_geometry_ok` — so the served
+    engine and every measurement tool agree on which path runs."""
+    return feat % 128 == 0 and t_local % 8 == 0 and t_local >= 8
+
+
+# ---------------------------------------------------------------------------
+# Interpret-mode remote-DMA support under multi-axis meshes
+
+_interpret_patch_state: Optional[bool] = None
+
+
+def _generalized_dma_discharge(stock_rule, prims, in_avals, out_avals,
+                               *args, tree, device_id_type):
+    """Discharge rule for `dma_start_p` that extends the stock
+    interpret-mode rule to remote LOGICAL copies under MULTI-axis
+    envs.  Anything the stock rule already handles (local copies,
+    single-axis envs, MESH ids) is delegated to it untouched."""
+    from jax._src import core as jax_core
+    from jax._src import tree_util
+    from jax._src.state import discharge as state_discharge
+
+    (src_ref, src_transforms, dst_ref, dst_transforms, dst_sem,
+     dst_sem_transforms, src_sem, src_sem_transforms,
+     device_id) = tree_util.tree_unflatten(tree, args)
+    (_, src_transforms_avals, _, dst_transforms_avals, dst_sem_aval,
+     dst_sem_transforms_avals, src_sem_aval, src_sem_transforms_avals,
+     _) = tree_util.tree_unflatten(tree, in_avals)
+
+    axis_env = jax_core.get_axis_env()
+    nonempty_axes = [n for n in axis_env.axis_sizes if n is not None]
+    if (device_id is None or len(nonempty_axes) <= 1
+            or device_id_type != prims.DeviceIdType.LOGICAL):
+        return stock_rule(in_avals, out_avals, *args, tree=tree,
+                          device_id_type=device_id_type)
+
+    pl_core = prims.pl_core
+    num_src_sem_transforms = len(
+        tree_util.tree_leaves(src_sem_transforms_avals))
+    num_dst_sem_transforms = len(
+        tree_util.tree_leaves(dst_sem_transforms_avals))
+    num_src_transform_vals = len(
+        tree_util.tree_leaves(src_transforms_avals))
+    num_dst_transform_vals = len(
+        tree_util.tree_leaves(dst_transforms_avals))
+
+    updates = state_discharge.transform_array(src_ref, src_transforms)
+    local_src = updates
+
+    # The generalization: a LOGICAL id is the flattened row-major index
+    # over the mesh axes in binding order (exactly how `make_mesh` lays
+    # devices out), so under a multi-axis env we gather over ALL axes
+    # and compute our own flattened index the same way.
+    shard_axis = tuple(nonempty_axes)
+    my_axis = jnp.int32(0)
+    for name in nonempty_axes:
+        my_axis = (my_axis * axis_env.axis_sizes[name]
+                   + jax.lax.axis_index(name))
+
+    who_copy_to_me = jax.lax.all_gather(device_id, shard_axis) == my_axis
+    index = jnp.argmax(who_copy_to_me, axis=0)
+    global_updates = jax.lax.all_gather(updates, shard_axis)
+    updates = jax.lax.dynamic_index_in_dim(global_updates, index, axis=0,
+                                           keepdims=False)
+    global_dst_transforms = tree_util.tree_map(
+        lambda x: jax.lax.all_gather(x, shard_axis), dst_transforms)
+    dst_transforms = tree_util.tree_map(
+        lambda x: jax.lax.dynamic_index_in_dim(x, index, axis=0,
+                                               keepdims=False),
+        global_dst_transforms)
+
+    _, new_dst = state_discharge.transform_swap_array(
+        dst_ref, dst_transforms, updates)
+
+    recv_size = jnp.minimum(updates.size, pl_core.SEMAPHORE_MAX_VALUE)
+    recv_size = jnp.array(recv_size,
+                          dtype=pl_core.SEMAPHORE_INTERPRET_DTYPE)
+    dst_sem_value = prims._transform_semaphore(
+        dst_sem, dst_sem_transforms, dst_sem_aval)
+    _, new_dst_sem = state_discharge.transform_swap_array(
+        dst_sem, dst_sem_transforms, dst_sem_value + recv_size)
+
+    send_size = jnp.minimum(local_src.size, pl_core.SEMAPHORE_MAX_VALUE)
+    send_size = jnp.array(send_size,
+                          dtype=pl_core.SEMAPHORE_INTERPRET_DTYPE)
+    src_sem_value = prims._transform_semaphore(
+        src_sem, src_sem_transforms, src_sem_aval)
+    _, new_src_sem = state_discharge.transform_swap_array(
+        src_sem, src_sem_transforms, src_sem_value + send_size)
+
+    new_vals = (None,)
+    new_vals += (None,) * num_src_transform_vals
+    new_vals += (new_dst,)
+    new_vals += (None,) * num_dst_transform_vals
+    new_vals += (new_dst_sem,)
+    new_vals += (None,) * num_dst_sem_transforms
+    new_vals += (new_src_sem,)
+    new_vals += (None,) * num_src_sem_transforms
+    new_vals += (None,)  # device_id
+    assert len(new_vals) == len(in_avals)
+    return new_vals, []
+
+
+def _install_interpret_remote_dma() -> bool:
+    """Re-register the generalized `dma_start_p` discharge rule
+    (idempotent; returns False — making the whole kernel fall back to
+    the XLA ring — if the jax internals this leans on ever move)."""
+    global _interpret_patch_state
+    if _interpret_patch_state is not None:
+        return _interpret_patch_state
+    try:
+        from jax._src.pallas.mosaic import primitives as prims
+        from jax._src.state import discharge as state_discharge
+
+        stock = state_discharge._discharge_rules[prims.dma_start_p]
+        rule = functools.partial(_generalized_dma_discharge, stock, prims)
+        state_discharge.register_discharge_rule(prims.dma_start_p)(rule)
+        _interpret_patch_state = True
+    except Exception:  # pragma: no cover - future-jax drift guard
+        _interpret_patch_state = False
+    return _interpret_patch_state
+
+
+def ring_kernel_supported(feat: int, t_local: int,
+                          interpret: bool) -> bool:
+    """The ONE kernel-vs-XLA-ring selection predicate (engine counter,
+    model dispatch, tools).  Compiled mode needs Mosaic-legal geometry;
+    interpret mode runs ANY shape (nothing lowers through Mosaic — this
+    is how CPU tier-1 exercises the kernel body at tiny geometry) but
+    needs the generalized remote-DMA discharge installed."""
+    if interpret:
+        return _install_interpret_remote_dma()
+    return ring_geometry_ok(feat, t_local)
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+
+
+def _flash_fold(q_ref, qpos_col_ref, k_buf, v_buf, pos_buf, ks_buf,
+                vs_buf, cur, state, *, B, t_loc, Hq, G, D, soft_cap,
+                compute_dtype):
+    """Fold the visiting K/V block (buffer slot `cur`) into the
+    (m, l, acc) state — the same update `ring_causal_attention` applies
+    per ppermute step, on 2D tiles: per (batch row, q head) a
+    [T_loc, D] x [D, T_loc] MXU matmul in f32."""
+    for b in range(B):
+        r0 = b * t_loc
+        # mask[t, c]: visiting key c attends query t iff its absolute
+        # position is <= the query's (causality carried by the rotating
+        # positions, correct for any block interleaving).
+        mask = (pos_buf[cur, b:b + 1, :]
+                <= qpos_col_ref[r0:r0 + t_loc, :])
+        for h in range(Hq):
+            hk = h // G
+            q_h = q_ref[r0:r0 + t_loc, h * D:(h + 1) * D]
+            k_h = k_buf[cur, r0:r0 + t_loc, hk * D:(hk + 1) * D]
+            v_h = v_buf[cur, r0:r0 + t_loc, hk * D:(hk + 1) * D]
+            if ks_buf is not None:
+                # Dequant in VMEM to the compute dtype FIRST, then f32 —
+                # the exact kv_cache.dequantize_rows operand path every
+                # cache read (and the XLA ring) sees.
+                k_h = (k_h.astype(jnp.float32)
+                       * ks_buf[cur, r0:r0 + t_loc, hk:hk + 1]
+                       ).astype(compute_dtype).astype(jnp.float32)
+                v_h = (v_h.astype(jnp.float32)
+                       * vs_buf[cur, r0:r0 + t_loc, hk:hk + 1]
+                       ).astype(compute_dtype).astype(jnp.float32)
+            else:
+                k_h = k_h.astype(jnp.float32)
+                v_h = v_h.astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q_h, k_h, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if soft_cap is not None:
+                s = soft_cap * jnp.tanh(s / soft_cap)
+            s = jnp.where(mask, s, _NEG_INF)
+            m, l, acc = state[b][h]
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, v_h, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            state[b][h] = (m_new, l, acc * alpha + pv)
+
+
+def _ring_kernel(nbr_ref, q_ref, qpos_col_ref, k_ref, v_ref, kpos_ref,
+                 *rest, sp, B, t_loc, Hq, Hkv, D, soft_cap, quant,
+                 interpret, compute_dtype):
+    """One program per shard: flash-fold the resident slot while the
+    next hop's K/V (+positions, +scales) RDMAs into the other slot."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    if quant:
+        ks_ref, vs_ref, o_ref = rest[0], rest[1], rest[2]
+        scratch = rest[3:]
+    else:
+        ks_ref = vs_ref = None
+        o_ref = rest[0]
+        scratch = rest[1:]
+    (k_buf, v_buf, pos_buf, ks_buf, vs_buf, load_sem, send_sem,
+     recv_sem, ack_sem) = scratch
+
+    right = nbr_ref[0]
+    left = nbr_ref[1]
+    G = Hq // Hkv
+
+    streams = [(k_ref, k_buf), (v_ref, v_buf), (kpos_ref, pos_buf)]
+    if quant:
+        streams += [(ks_ref, ks_buf), (vs_ref, vs_buf)]
+
+    if sp > 1 and not interpret:
+        # Neighbor barrier: no shard may RDMA into a peer that hasn't
+        # entered the kernel and allocated these buffers.
+        bsem = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bsem, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(bsem, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(bsem, 2)
+
+    # Stage the local block into slot 0 (HBM -> VMEM).
+    loads = [pltpu.make_async_copy(src, buf.at[0], load_sem.at[i])
+             for i, (src, buf) in enumerate(streams)]
+    for cp in loads:
+        cp.start()
+    for cp in loads:
+        cp.wait()
+
+    zero = jnp.zeros((t_loc, 1), jnp.float32)
+    state = [[(jnp.full((t_loc, 1), _NEG_INF, jnp.float32), zero,
+               jnp.zeros((t_loc, D), jnp.float32))
+              for _ in range(Hq)] for _ in range(B)]
+
+    for step in range(sp):
+        cur, nxt = step % 2, (step + 1) % 2
+        rdmas = []
+        if step + 1 < sp:
+            if step >= 1 and not interpret:
+                # Credit: the receiver read slot `nxt` for the last
+                # time at step-1; only its ack makes overwriting safe.
+                pltpu.semaphore_wait(ack_sem, 1)
+            # Ship the NEXT hop before any compute — the whole point.
+            for i, (_, buf) in enumerate(streams):
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=buf.at[cur], dst_ref=buf.at[nxt],
+                    send_sem=send_sem.at[i, cur],
+                    recv_sem=recv_sem.at[i, nxt],
+                    device_id=right,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+                rdma.start()
+                rdmas.append(rdma)
+        _flash_fold(q_ref, qpos_col_ref, k_buf, v_buf, pos_buf,
+                    ks_buf if quant else None,
+                    vs_buf if quant else None, cur, state,
+                    B=B, t_loc=t_loc, Hq=Hq, G=G, D=D,
+                    soft_cap=soft_cap, compute_dtype=compute_dtype)
+        if step + 1 < sp:
+            if step <= sp - 3 and not interpret:
+                # Slot `cur` is dead to us — credit the LEFT neighbor
+                # (the device whose sends land in our buffers).
+                pltpu.semaphore_signal(
+                    ack_sem, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+            for rdma in rdmas:
+                rdma.wait()
+
+    for b in range(B):
+        r0 = b * t_loc
+        for h in range(Hq):
+            m, l, acc = state[b][h]
+            # Fully-masked (padding) rows are junk-but-finite, exactly
+            # as on the XLA ring — the divide guard matches it.
+            o_ref[r0:r0 + t_loc, h * D:(h + 1) * D] = (
+                acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def ring_flash_attention(
+    q: jax.Array,            # [B, T_loc, Hq, D]
+    k: jax.Array,            # [B, T_loc, Hkv, D] (int8 when k_scale given)
+    v: jax.Array,            # [B, T_loc, Hkv, D]
+    q_positions: jax.Array,  # [B, T_loc] absolute token positions
+    kv_positions: Optional[jax.Array] = None,
+    *,
+    mesh,                    # the Mesh this shard_map body runs under
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+    soft_cap: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,  # [B, T_loc, Hkv] f32
+    v_scale: Optional[jax.Array] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash ring attention with RDMA'd K/V rotation; call inside
+    `shard_map` with the T axis sharded over `axis_name`.  Drop-in for
+    `ring_causal_attention` at eligible geometry (same signature modulo
+    the static `mesh`); sp == 1 degenerates to plain flash attention
+    with no remote traffic."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, t_loc, Hq, D = q.shape
+    Hkv = k.shape[2]
+    feat = Hkv * D
+    if scale is None:
+        scale = D ** -0.5
+    if kv_positions is None:
+        kv_positions = q_positions
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    sp = mesh.shape[axis_name]
+    quant = k_scale is not None
+    if not ring_kernel_supported(feat, t_loc, interpret):
+        raise ValueError(
+            f"ring kernel geometry rejected: per-shard feat={feat} "
+            f"(needs % 128 == 0), t_local={t_loc} (needs % 8 == 0, "
+            ">= 8) — dispatch the XLA ppermute ring "
+            "(ops/ring_attention.ring_causal_attention) instead")
+
+    # Flattened LOGICAL ids of the ring neighbors: row-major over the
+    # mesh axes in binding order, the layout make_mesh gives the device
+    # array (and the flattening the interpret discharge rule mirrors).
+    names = list(mesh.axis_names)
+    flat = jnp.int32(0)
+    for n in names:
+        flat = flat * mesh.shape[n] + jax.lax.axis_index(n)
+    stride = 1
+    for n in names[names.index(axis_name) + 1:]:
+        stride *= mesh.shape[n]
+    idx = jax.lax.axis_index(axis_name)
+    right = flat + ((idx + 1) % sp - idx) * stride
+    left = flat + ((idx + sp - 1) % sp - idx) * stride
+    nbr = jnp.stack([right, left]).astype(jnp.int32)
+
+    # 2D operand views; q pre-scaled in f32 exactly like the XLA ring's
+    # `qg` (one multiply outside the hop loop).
+    q2 = (q.astype(jnp.float32) * scale).reshape(B * t_loc, Hq * D)
+    qpos_col = q_positions.reshape(B * t_loc, 1).astype(jnp.int32)
+    k2 = k.reshape(B * t_loc, feat)
+    v2 = v.reshape(B * t_loc, feat)
+    kpos = kv_positions.astype(jnp.int32)
+    args = [nbr, q2, qpos_col, k2, v2, kpos]
+    if quant:
+        args += [k_scale.reshape(B * t_loc, Hkv).astype(jnp.float32),
+                 v_scale.reshape(B * t_loc, Hkv).astype(jnp.float32)]
+
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM), vmem, vmem,
+                any_spec, any_spec, any_spec]
+    if quant:
+        in_specs += [any_spec, any_spec]
+    n_streams = 5 if quant else 3
+    scratch = [
+        pltpu.VMEM((2, B * t_loc, feat), k.dtype),            # k_buf
+        pltpu.VMEM((2, B * t_loc, feat), v.dtype),            # v_buf
+        pltpu.VMEM((2, B, t_loc), jnp.int32),                 # pos_buf
+        pltpu.VMEM((2, B * t_loc, Hkv), jnp.float32),         # ks_buf
+        pltpu.VMEM((2, B * t_loc, Hkv), jnp.float32),         # vs_buf
+        pltpu.SemaphoreType.DMA((n_streams,)),                # load
+        pltpu.SemaphoreType.DMA((n_streams, 2)),              # send
+        pltpu.SemaphoreType.DMA((n_streams, 2)),              # recv
+        pltpu.SemaphoreType.REGULAR,                          # ack
+    ]
+
+    kernel = functools.partial(
+        _ring_kernel, sp=sp, B=B, t_loc=t_loc, Hq=Hq, Hkv=Hkv, D=D,
+        soft_cap=soft_cap, quant=quant, interpret=interpret,
+        compute_dtype=q.dtype)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * t_loc, Hq * D), q.dtype),
+        in_specs=in_specs,
+        out_specs=vmem,
+        scratch_shapes=scratch,
+        interpret=interpret,
+        compiler_params=pltpu.TPUCompilerParams(collective_id=1),
+    )(*args)
+    return out.reshape(B, t_loc, Hq, D)

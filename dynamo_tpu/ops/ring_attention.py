@@ -9,9 +9,17 @@ over the `sp` mesh axis, every shard keeps its Q block resident, and
 K/V blocks rotate one hop per step around the ICI ring via
 `lax.ppermute` while an online-softmax accumulator folds each visiting
 block in.  After sp steps every Q block has seen every K/V block; peak
-memory per chip is O(T/sp) and the per-hop transfer overlaps the local
-attention compute (XLA schedules the ppermute concurrently with the
-einsums since there is no data dependence within a step).
+memory per chip is O(T/sp).
+
+Comm/compute overlap on this path is SCHEDULER-DEPENDENT: there is no
+data dependence between a step's ppermute and its einsums, so XLA *may*
+overlap them, but nothing guarantees it, and the per-hop `s`/`p`
+intermediates round-trip HBM either way.  The Pallas flash ring
+(`ops/pallas/ring_attention.py`) makes the overlap structural — the
+next hop's RDMA is issued before the local block's fold — and eligible
+geometry dispatches it instead (llama._sp_ring_attention); THIS module
+remains the fallback for ineligible shapes and the parity oracle both
+implementations are pinned against.
 
 Causality is enforced with ABSOLUTE positions carried alongside the
 rotating K/V — masks stay correct for any block interleaving, and fully

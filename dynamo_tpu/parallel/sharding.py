@@ -505,8 +505,13 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
         # conflicts with dp×ep token dispatch).  Quantized caches ride
         # the ring as int8 chunks + scales (llama._attention_block sp
         # branch — ISSUE 12 leg 1).
+        # plane.use_pallas routes eligible geometry through the Pallas
+        # flash ring kernel (RDMA exchange hidden under the fold); the
+        # XLA ppermute ring stays the fallback and the oracle
+        # (llama._sp_ring_attention picks per trace).
         step = make_forward_step(cfg, block_size, moe_mode="dense",
-                                 mesh=mesh, sp_ring=True)
+                                 mesh=mesh, sp_ring=True,
+                                 sp_ring_pallas=plane.use_pallas)
         seq = nsh(P("dp", "sp"))
         in_shardings = (param_sh, cache_sh, seq, seq, nsh(P("dp")),
                         nsh(P("dp", None)), nsh(P("dp")))
@@ -645,11 +650,15 @@ def make_sharded_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
 
 
 def make_sp_prefill_step(cfg: ModelConfig, block_size: int, mesh: Mesh,
-                         kv_quant: bool = False):
+                         kv_quant: bool = False,
+                         use_pallas: bool = False):
     """Ring-SP whole-prompt prefill (`role="sp_prefill"`): tokens and
-    positions shard P(dp, sp); same step signature otherwise."""
+    positions shard P(dp, sp); same step signature otherwise.
+    `use_pallas` selects the flash ring kernel at eligible geometry
+    (ops/pallas/ring_attention.py)."""
     return make_sharded_step(cfg, block_size, mesh,
-                             PlaneSpec(role="sp_prefill", quant=kv_quant))
+                             PlaneSpec(role="sp_prefill", quant=kv_quant,
+                                       use_pallas=use_pallas))
 
 
 def make_sharded_window(cfg: ModelConfig, block_size: int, mesh: Mesh,

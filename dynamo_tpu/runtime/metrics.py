@@ -277,6 +277,13 @@ class EngineStepCounters:
         # (KvCacheConfig.ring_payload_bytes_per_token) — the sp analog of
         # the kv_read_bytes_modeled honesty series.
         self.ring_exchange_bytes_modeled = 0
+        # Prefills whose ring exchange ran the Pallas flash kernel
+        # (ops/pallas/ring_attention.py) rather than the XLA ppermute
+        # ring.  The byte series above is PATH-INDEPENDENT (both rings
+        # move the same rows+scales over the same sp-1 hops — charged
+        # before the dispatch split); this counter is the attribution:
+        # kernel-path tests and bench_gate --smoke assert it went up.
+        self.ring_kernel_prefills = 0
         # Mixed-prefill cost calibration (ISSUE 10 satellite): EWMAs of
         # engine-thread wall seconds per window-decode token (plain
         # windows) and per concurrently-dispatched prefill token (the
@@ -379,6 +386,7 @@ class EngineStepCounters:
             "kv_read_bytes_modeled": self.kv_read_bytes_modeled,
             "decode_tokens_emitted": self.decode_tokens_emitted,
             "ring_exchange_bytes_modeled": self.ring_exchange_bytes_modeled,
+            "ring_kernel_prefills": self.ring_kernel_prefills,
         }
 
     def snapshot(self) -> "EngineStepCounters":

@@ -92,6 +92,15 @@ CELLS = {
     # ISSUE 12 leg 1: quantized ring-SP exchange, then int8 decode.
     "sp2+int8+window": dict(mesh_name="sp2", kv_quant="int8",
                             decode_window=4),
+    # ISSUE 19: pallas × ring-SP — the flash ring kernel (double-
+    # buffered RDMA exchange under the fold, interpret mode on CPU)
+    # serves the sp prefill; the ring-path AND kernel-path counters
+    # are asserted so an XLA-ring fallback can't pass silently.
+    "sp2+pallas": dict(mesh_name="sp2", use_pallas_decode=True),
+    # ISSUE 19: sp_prefill × pallas × int8 — int8 rows + scales ride
+    # the kernel's RDMA streams and dequantize in VMEM.
+    "sp2+pallas+int8": dict(mesh_name="sp2", use_pallas_decode=True,
+                            kv_quant="int8"),
     # ISSUE 12 leg 3: the pp decode window (schedule-looping program).
     "pp2+window": dict(mesh_name="pp2", decode_window=4),
     # ISSUE 12 leg 3: the all-in-one fused pp greedy step.
@@ -155,6 +164,12 @@ def _assert_cell(name, kwargs, oracle):
         assert core.sp_prefill_count == len(PROMPTS), \
             f"cell {name} prefill skipped the ring path"
         assert core.counters.ring_exchange_bytes_modeled > 0
+        # Kernel-path attribution (ISSUE 19): pallas sp cells must have
+        # run the flash ring kernel, non-pallas cells the XLA ring.
+        want_kernel = len(PROMPTS) if kwargs.get("use_pallas_decode") \
+            else 0
+        assert core.counters.ring_kernel_prefills == want_kernel, \
+            f"cell {name} ran the wrong ring implementation"
     if kwargs.get("decode_window", 1) > 1:
         assert core.counters.window_dispatches > 0, \
             f"cell {name} never dispatched a decode window"
@@ -397,9 +412,21 @@ def test_declared_impossible_cells_are_pointed():
             spec=bool(kw.get("spec")),
             window=kw.get("decode_window", 1),
             fused=kw.get("decode_window", 1) <= 1,
+            use_pallas=bool(kw.get("use_pallas_decode")),
             dp_attention=bool(mesh_kwargs.get("dp_attention")),
             dp_local=bool(mesh_kwargs.get("dp_attention")),
             moe=kw.get("model") == "tiny-moe")
         cap = plane_capability(mesh, plane)
         assert cap.ok, f"grid cell {name} is declared impossible: " \
                        f"{cap.reason}"
+        if kw.get("mesh_name") == "sp2":
+            # The sp cells ALSO consult the table with the sp_prefill
+            # role (the engine's gate for building the ring step) —
+            # including pallas × sp_prefill, the cell ISSUE 19 composed.
+            sp_plane = PlaneSpec(
+                role="sp_prefill", quant=plane.quant,
+                use_pallas=plane.use_pallas,
+                moe=kw.get("model") == "tiny-moe")
+            cap = plane_capability(mesh, sp_plane)
+            assert cap.ok, f"sp grid cell {name} declared impossible: " \
+                           f"{cap.reason}"

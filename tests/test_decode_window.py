@@ -381,6 +381,7 @@ def test_counters_expose_dict():
                       "packed_prefill_dispatches", "spec_dispatches",
                       "h2d_uploads", "kv_read_bytes_modeled",
                       "decode_tokens_emitted",
-                      "ring_exchange_bytes_modeled"}
+                      "ring_exchange_bytes_modeled",
+                      "ring_kernel_prefills"}
     assert d["prefill_dispatches"] >= 1
     assert d["xla_cache_misses"] >= 1  # cold engine must compile

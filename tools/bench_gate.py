@@ -485,6 +485,43 @@ def sharded_decode_checks() -> dict:
     }
 
 
+def ring_plane_checks() -> dict:
+    """ISSUE 19 smoke: the ring-attention plane measured on the CPU mesh
+    rig — the flash ring kernel (interpret mode) must agree with the XLA
+    ppermute ring numerically, the section must carry the gated ratio
+    and both modeled per-hop payload figures, and the tiny sp2+pallas
+    engine must serve token-identical output with EVERY sp prefill
+    attributed to the kernel path (ring_kernel_prefills — an XLA-ring
+    fallback can't pass silently).
+
+    The CPU ratio itself is NOT gated (interpret-mode kernel cost swamps
+    it); the 1.15 floor binds on TPU rounds and is fabricated-failure-
+    checked in run_smoke."""
+    from dynamo_tpu.bench.ring_plane import run_tiny_ring_plane
+
+    out = run_tiny_ring_plane()
+    eng = out.get("engine", {})
+    return {
+        "ring_plane_ratio": out.get("kernel_vs_xla"),
+        "ring_plane_numeric_parity": out.get("numeric_parity"),
+        "ring_plane_section_ok": all(
+            isinstance(out.get(k), (int, float))
+            for k in ("kernel_ms", "xla_ring_ms", "meshless_ms",
+                      "kernel_vs_xla", "per_hop_bytes",
+                      "per_hop_bytes_int8_modeled")),
+        # int8 exchange modeled payload must be smaller than bf16's —
+        # the scales-ride-with-rows accounting, not a forked formula.
+        "ring_plane_int8_payload_smaller": (
+            out.get("per_hop_bytes_int8_modeled", 0)
+            < out.get("per_hop_bytes", 0)),
+        "ring_plane_engine_token_parity": eng.get("tokens_match"),
+        "ring_plane_kernel_path_counted": (
+            eng.get("ring_kernel_prefills", 0) > 0
+            and eng.get("ring_kernel_prefills")
+            == eng.get("sp_prefill_count")),
+    }
+
+
 def moe_decode_checks() -> dict:
     """ISSUE 17 smoke: the MoE fast-decode plane measured on CPU with
     tiny-moe — the grouped kernel (interpret mode) must be BITWISE equal
@@ -805,6 +842,11 @@ def run_smoke(args) -> int:
         interpret mode) with every assignment accounted and zero drops,
         and the grouped_vs_dense floor verified to fail a fabricated
         slower-than-dense run;
+    9c. ring-attention plane (ISSUE 19): the Pallas flash ring kernel
+        (interpret mode) numerically equal to the XLA ppermute ring at
+        sp2, the tiny sp2+pallas engine token-identical with every sp
+        prefill attributed to the kernel path, and the kernel_vs_xla
+        floor verified to fail a fabricated slower-than-XLA kernel run;
     10. prefill plane (ISSUE 10): packed ragged vs padded prefill on the
         tiny model with byte-identical first tokens, and the
         packed_vs_padded_tok_s_ratio floor verified to fail a
@@ -906,6 +948,8 @@ def run_smoke(args) -> int:
                         "packed_vs_padded_tok_s_ratio": 1.45},
                     moe_decode={"grouped_vs_dense": 2.7,
                                 "token_parity": True},
+                    ring_plane={"kernel_vs_xla": 1.6,
+                                "numeric_parity": True},
                     transfer={"device_vs_host_ratio": 3.4})
     tpu_low_mbu = dict(tpu_good, mbu=0.60)
     tpu_interfered = dict(
@@ -950,6 +994,13 @@ def run_smoke(args) -> int:
     tpu_moe_slow = dict(
         tpu_good, moe_decode={"grouped_vs_dense": 0.9,
                               "token_parity": True})
+    # ISSUE-19 floor: a flash ring kernel that stopped beating the XLA
+    # ppermute ring (RDMA no longer overlapping the fold, or a silent
+    # fallback) must fail — as must a parity failure, which zeroes the
+    # ratio at the bench.
+    tpu_ring_slow = dict(
+        tpu_good, ring_plane={"kernel_vs_xla": 1.05,
+                              "numeric_parity": True})
     # ISSUE-13 floor: a device plane slower than the host-staged wire
     # (regressed to host staging under the covers, or double-copying on
     # inject) must fail — as must a parity failure, which zeroes the
@@ -989,6 +1040,8 @@ def run_smoke(args) -> int:
                                                      tpu_slow_prefill).ok,
         "slow_moe_grouped_fails": not gate.compare(tpu_moe_slow,
                                                    tpu_moe_slow).ok,
+        "slow_ring_kernel_fails": not gate.compare(tpu_ring_slow,
+                                                   tpu_ring_slow).ok,
         "slow_device_transfer_fails": not gate.compare(
             tpu_slow_transfer, tpu_slow_transfer).ok,
         "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),
@@ -1004,6 +1057,7 @@ def run_smoke(args) -> int:
         **ledger_checks(),
         **decode_wall_checks(),
         **moe_decode_checks(),
+        **ring_plane_checks(),
         **prefill_plane_checks(),
         **transfer_plane_checks(),
         **prefix_fleet_checks(),

@@ -413,7 +413,10 @@ def main(argv=None):
                         "decode (its win is ring prefill), and "
                         "dividing would flatter the per-chip numbers "
                         "(the engine's kv_traffic_shards makes the "
-                        "same call)")
+                        "same call).  Also grows a ring-kernel phase: "
+                        "flash-ring vs XLA-ring vs meshless slopes at "
+                        "this geometry + modeled per-hop ICI bytes "
+                        "(skip with --no-kernel)")
     p.add_argument("--json", action="store_true",
                    help="emit one JSON object instead of the text report")
     p.add_argument("--no-probes", action="store_true",
@@ -554,6 +557,22 @@ def main(argv=None):
             batch=args.batch, ctx=args.ctx, block=args.block,
             width=args.width, window=args.window,
             kv_quant=args.kv_quant, mesh=mesh) * 1e3, 6)
+
+    if args.sp > 1:
+        # Ring-kernel phase (ISSUE 19): one measurement methodology with
+        # the gated `ring_plane` bench section — import, don't fork.
+        # Reports the flash-ring-kernel vs XLA-ppermute-ring vs meshless
+        # slopes at this geometry plus the modeled per-hop ICI payload
+        # in both cache modes (interpret mode off-TPU unless --no-kernel
+        # — times then show plumbing, not silicon).
+        if args.no_kernel:
+            out["ring"] = {"skipped": "--no-kernel"}
+        else:
+            from dynamo_tpu.bench.ring_plane import run_ring_plane
+
+            out["ring"] = run_ring_plane(
+                cfg, batch=min(args.batch, 4), seq=args.ctx, sp=args.sp,
+                with_engine=False)
 
     if args.transfer:
         # Device-transfer transport phase (ISSUE 13): per-batch-size
