@@ -141,6 +141,19 @@ class FloorSpec:
 #   so this floor also trips on a fast-but-wrong kernel.  Absent
 #   (skipped, not passed) when the round's geometry is
 #   ring_geometry_ok-ineligible or the rig has < 2 chips.
+# - device_truth.modeled_vs_measured_kv <= 1.25 — ISSUE 20: the drift
+#   auditor's kv_decode ratio folds the engine's MODELED per-chip KV
+#   decode bytes against XLA's own bytes-accessed cost analysis for the
+#   compiled decode programs.  Modeled KV traffic is a strict component
+#   of what the program actually touches (XLA's total adds weights and
+#   activations on top), so an honest ratio sits WELL below 1 — measured
+#   ~0.14 on the CPU tiny model, higher but still sub-1 at serving
+#   geometry where KV dominates.  A ratio above 1.25 means the
+#   analytical model claims more bytes than the device moves: exactly
+#   the PR-16 int8 bug class (modeled bytes double-counting scales /
+#   missing a quantization factor) that made "halved KV traffic" claims
+#   uncheckable.  One-sided on purpose: under-claim is expected, only
+#   over-claim is a lie the capacity planner would act on.
 # - sharded_decode.pp_fused_vs_single >= 1.2 — ISSUE 12: the all-in-one
 #   pp stage program (schedule + fused argmax, [B] tokens out) must beat
 #   the unfused loop it replaced (schedule dispatch returning [B, V] f32
@@ -163,6 +176,7 @@ TPU_FLOORS: Tuple[FloorSpec, ...] = (
     FloorSpec("moe_decode.grouped_vs_dense", minimum=1.5),
     FloorSpec("prefill_plane.packed_vs_padded_tok_s_ratio", minimum=1.2),
     FloorSpec("transfer.device_vs_host_ratio", minimum=2.0),
+    FloorSpec("device_truth.modeled_vs_measured_kv", maximum=1.25),
 )
 
 

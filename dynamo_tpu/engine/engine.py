@@ -63,7 +63,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
 )
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models.llama import Params, init_params, make_forward_step
-from dynamo_tpu.runtime import contracts, flight_recorder
+from dynamo_tpu.runtime import contracts, device_profiler, flight_recorder
 from dynamo_tpu.runtime import ledger as request_ledger
 from dynamo_tpu.runtime.contracts import (
     engine_thread_only,
@@ -736,6 +736,13 @@ class EngineCore:
         # pre-computed scalars only (lint rule DL006).
         self.flight = flight_recorder.get_recorder()
         self.counters.on_recompile = self._flight_recompile
+        # Device-truth plane (runtime/device_profiler.py): on first-seen
+        # shapes the dispatch sites hand the about-to-compile callable +
+        # args to _harvest_program, which records XLA's cost analysis
+        # (flops / bytes accessed) in the program registry.  Disabled by
+        # default; worker --device-profiler enables it.  Zero steady-path
+        # cost: the harvest rides the compile event only.
+        self.profiler = device_profiler.get_profiler()
         # Mixed-mode duty state: windows dispatched since the last
         # concurrent prefill chunk (see EngineConfig.mixed_prefill_duty).
         self._windows_since_prefill = 0
@@ -942,6 +949,17 @@ class EngineCore:
         if self.flight.enabled:
             self.flight.record("recompile", tag=str(key[0]),
                                sig=repr(key[1:]))
+
+    def _harvest_program(self, first_seen: bool, tag: str, sig: tuple,
+                         fn, args: tuple) -> None:
+        """Feed the device-profiler's cost registry on a first-seen
+        shape (note_dispatch returned True): `fn.lower(*args)` traces
+        without executing or donating, so the harvest is safe right
+        before the real dispatch compiles the same program.  Off the
+        steady path by construction — first_seen is False on every
+        warm dispatch and the call degrades to one branch."""
+        if first_seen and self.profiler.enabled:
+            self.profiler.harvest(tag, sig, fn, args)
 
     @hot_path
     def _flight_counters(self) -> None:
@@ -1215,7 +1233,7 @@ class EngineCore:
             draft_arr[row] = drafts[i]
 
         # sample_positions=None → logits at EVERY chunk position [B,T,V].
-        self.counters.note_dispatch("spec", bucket, T, width)
+        first = self.counters.note_dispatch("spec", bucket, T, width)
         self.counters.spec_dispatches += 1
         fl = self.flight
         if fl.enabled:
@@ -1226,9 +1244,15 @@ class EngineCore:
         self.counters.note_kv_read(
             sum(r.context_len + K for r in reqs)
             * self._ctx_token_bytes_chip, 0)
+        tok_d = jnp.asarray(tokens)
+        pos_d = jnp.asarray(positions)
+        sl_d = jnp.asarray(seq_lens)
+        bts_d = jnp.asarray(bts)
+        self._harvest_program(
+            first, "spec", (bucket, T, width), self._step,
+            (self.params, self.cache, tok_d, pos_d, sl_d, bts_d, None))
         logits, self.cache = self._run_step(
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(seq_lens), jnp.asarray(bts), None)
+            tok_d, pos_d, sl_d, bts_d, None)
         emitted_dev, n_emit_dev = self._spec_verify_fn()(
             logits, jnp.asarray(draft_arr), jnp.asarray(temp),
             jnp.asarray(top_k), jnp.asarray(top_p),
@@ -1426,11 +1450,13 @@ class EngineCore:
 
         mm_items = [w for w in batch.items
                     if w.request.prompt_embeds is not None]
+        sp_elig = self._sp_eligible(batch)
         # The sp / multimodal / plain branches are distinct compiled
         # programs — the shape signature must not collide across them.
-        self.counters.note_dispatch(
-            "prefill", R, T, P, bool(mm_items), self._sp_eligible(batch))
-        if self._sp_eligible(batch):
+        first = self.counters.note_dispatch(
+            "prefill", R, T, P, bool(mm_items), sp_elig)
+        prefill_sig = (R, T, P, bool(mm_items), sp_elig)
+        if sp_elig:
             # Served long-context path: whole-prompt prefill over the ICI
             # ring, T sharded over sp (VERDICT r3 next-4 — the ring was
             # test-only before; now EngineCore routes real requests
@@ -1466,11 +1492,12 @@ class EngineCore:
                         feat, T // sp,
                         jax.default_backend() != "tpu"):
                     self.counters.ring_kernel_prefills += len(batch.items)
-            logits, self.cache = self._sp_step(
-                self.params, self.cache,
-                self._dev(tokens), self._dev(positions),
-                self._dev(seq_lens), self._dev(bts),
-                self._dev(sample_pos))
+            sp_args = (self.params, self.cache, self._dev(tokens),
+                       self._dev(positions), self._dev(seq_lens),
+                       self._dev(bts), self._dev(sample_pos))
+            self._harvest_program(first, "prefill", prefill_sig,
+                                  self._sp_step, sp_args)
+            logits, self.cache = self._sp_step(*sp_args)
         elif mm_items:
             # Multimodal prefill: chunk positions inside a request's
             # embedding span take the provided vision embeddings instead
@@ -1503,17 +1530,25 @@ class EngineCore:
                                           self.block_size,
                                           with_input_embeds=True),
                         donate_argnums=(1,))
-            logits, self.cache = self._mm_step(
-                self.params, self.cache,
-                self._dev(tokens), self._dev(positions),
-                self._dev(seq_lens), self._dev(bts),
-                self._dev(sample_pos), self._dev(embeds),
-                self._dev(mask))
+            mm_args = (self.params, self.cache, self._dev(tokens),
+                       self._dev(positions), self._dev(seq_lens),
+                       self._dev(bts), self._dev(sample_pos),
+                       self._dev(embeds), self._dev(mask))
+            self._harvest_program(first, "prefill", prefill_sig,
+                                  self._mm_step, mm_args)
+            logits, self.cache = self._mm_step(*mm_args)
         else:
+            tok_d = self._dev(tokens)
+            pos_d = self._dev(positions)
+            sl_d = self._dev(seq_lens)
+            bts_d = self._dev(bts)
+            smp_d = self._dev(sample_pos)
+            self._harvest_program(
+                first, "prefill", prefill_sig, self._step,
+                (self.params, self.cache, tok_d, pos_d, sl_d, bts_d,
+                 smp_d))
             logits, self.cache = self._run_step(
-                self._dev(tokens), self._dev(positions),
-                self._dev(seq_lens), self._dev(bts),
-                self._dev(sample_pos))
+                tok_d, pos_d, sl_d, bts_d, smp_d)
 
         return self._finish_prefill_items(batch.items, logits, async_first)
 
@@ -1622,16 +1657,19 @@ class EngineCore:
             off += -(-L // PACK_ALIGN) * PACK_ALIGN
         self.counters.prefill_dispatches += 1
         self.counters.packed_prefill_dispatches += 1
-        self.counters.note_dispatch("prefill_packed", T, R, P)
+        first = self.counters.note_dispatch("prefill_packed", T, R, P)
         fl = self.flight
         if fl.enabled:
             fl.record("prefill_packed", tokens=T, segs=R, pages=P)
         self._prefill_cost_tokens += sum(w.length for w in items)
-        res = self._packed_prefill_fn()(
-            self.params, self.cache, self._dev(tokens),
-            self._dev(positions), self._dev(seg_ids), self._dev(bts),
-            self._dev(q_starts), self._dev(q_lens), self._dev(seq_lens),
-            self._dev(sample_pos))
+        pfn = self._packed_prefill_fn()
+        pargs = (self.params, self.cache, self._dev(tokens),
+                 self._dev(positions), self._dev(seg_ids), self._dev(bts),
+                 self._dev(q_starts), self._dev(q_lens),
+                 self._dev(seq_lens), self._dev(sample_pos))
+        self._harvest_program(first, "prefill_packed", (T, R, P),
+                              pfn, pargs)
+        res = pfn(*pargs)
         if self._moe:
             logits, self.cache, load = res
             # Same lazy-sync discipline as _run_step: accumulate the
@@ -1671,12 +1709,21 @@ class EngineCore:
             positions = np.full((T,), self._pad_position, np.int32)
             seg_ids = np.zeros((T,), np.int32)
             zeros_r = self._dev(np.zeros((R,), np.int32))
-            _, self.cache = fn(
-                self.params, self.cache, self._dev(tokens),
-                self._dev(positions), self._dev(seg_ids),
-                self._dev(np.zeros((R, P), np.int32)), zeros_r, zeros_r,
-                zeros_r, zeros_r)
-            self.counters.note_dispatch("prefill_packed", T, R, P)
+            # note_dispatch BEFORE the dispatch: the compile stamp must
+            # cover the compile it announces (watchdog grace), and the
+            # first-seen harvest must run while self.cache is still
+            # live — fn donates the cache buffer on the real call.
+            # Prewarmed shapes land in the cost registry through the
+            # same path as serving dispatches, so `--prewarm-prefill`
+            # cannot create a permanently-dark program set.
+            first = self.counters.note_dispatch("prefill_packed", T, R, P)
+            cargs = (self.params, self.cache, self._dev(tokens),
+                     self._dev(positions), self._dev(seg_ids),
+                     self._dev(np.zeros((R, P), np.int32)), zeros_r,
+                     zeros_r, zeros_r, zeros_r)
+            self._harvest_program(first, "prefill_packed", (T, R, P),
+                                  fn, cargs)
+            _, self.cache = fn(*cargs)
         return len(shapes)
 
     def _decode_row(self, req: Request, compact_index: int) -> int:
@@ -1748,11 +1795,15 @@ class EngineCore:
             # lockstep stream replays THIS fused step (its token output
             # is replicated so every process reads locally) — the cliff
             # is dead on every mesh (ISSUE 12 legs 3-4).
-            self.counters.note_dispatch("decode1g", bucket, work.pages)
-            res = self._greedy_step_fn()(
-                self.params, self.cache, self._dev(tokens),
-                self._dev(positions), self._dev(seq_lens), self._dev(bts),
-                zeros)
+            first = self.counters.note_dispatch("decode1g", bucket,
+                                                work.pages)
+            gfn = self._greedy_step_fn()
+            gargs = (self.params, self.cache, self._dev(tokens),
+                     self._dev(positions), self._dev(seq_lens),
+                     self._dev(bts), zeros)
+            self._harvest_program(first, "decode1g",
+                                  (bucket, work.pages), gfn, gargs)
+            res = gfn(*gargs)
             if self._moe:
                 toks_dev, self.cache, load = res
                 self._load_dev = (load if self._load_dev is None
@@ -1763,10 +1814,18 @@ class EngineCore:
             sampled = np.asarray(jax.device_get(toks_dev))[np.asarray(rows)]
             lps = None
         else:
-            self.counters.note_dispatch("decode1", bucket, work.pages)
+            first = self.counters.note_dispatch("decode1", bucket,
+                                                work.pages)
+            tok_d = self._dev(tokens)
+            pos_d = self._dev(positions)
+            sl_d = self._dev(seq_lens)
+            bts_d = self._dev(bts)
+            self._harvest_program(
+                first, "decode1", (bucket, work.pages), self._step,
+                (self.params, self.cache, tok_d, pos_d, sl_d, bts_d,
+                 zeros))
             logits, self.cache = self._run_step(
-                self._dev(tokens), self._dev(positions),
-                self._dev(seq_lens), self._dev(bts), zeros)
+                tok_d, pos_d, sl_d, bts_d, zeros)
             sampled, lps = self._sample_rows(
                 self._select_rows(logits, rows), live)
         deltas = []
@@ -1948,7 +2007,8 @@ class EngineCore:
             self.counters.h2d_uploads += 1
         self._window_state = st
         self.counters.window_dispatches += 1
-        self.counters.note_dispatch("window", greedy_only, bucket, width)
+        first = self.counters.note_dispatch("window", greedy_only, bucket,
+                                            width)
         fl = self.flight
         if fl.enabled:
             # THE per-window ring write (budget: one per window
@@ -1973,10 +2033,13 @@ class EngineCore:
                            else req.prompt_tokens[-1])
             last_tokens = self._dev_row(toks)
 
-        res = self._window_fn(greedy_only)(
-            self.params, self.cache, last_tokens,
-            st["pos"], st["seq"], st["bts"], st["temp"], st["topk"],
-            st["topp"], st["keys"], st["off"])
+        wfn = self._window_fn(greedy_only)
+        wargs = (self.params, self.cache, last_tokens,
+                 st["pos"], st["seq"], st["bts"], st["temp"], st["topk"],
+                 st["topp"], st["keys"], st["off"])
+        self._harvest_program(first, "window",
+                              (greedy_only, bucket, width), wfn, wargs)
+        res = wfn(*wargs)
         if self._moe:
             (self.cache, out, st["pos"], st["seq"], st["off"],
              load) = res

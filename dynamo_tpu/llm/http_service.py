@@ -70,6 +70,8 @@ class HttpService:
         self.app.router.add_get("/debug/slo", self.debug_slo)
         self.app.router.add_get("/debug/flightrecorder",
                                 self.debug_flightrecorder)
+        self.app.router.add_get("/debug/deviceprofile",
+                                self.debug_deviceprofile)
         self.app.router.add_get("/health", self.health)
         self.app.router.add_get("/live", self.live)
         self._runner: Optional[web.AppRunner] = None
@@ -206,6 +208,31 @@ class HttpService:
             return self._error(400, "n must be an integer")
         return web.json_response(
             flight_recorder.get_recorder().debug_payload(n))
+
+    async def debug_deviceprofile(self, req: web.Request) -> web.Response:
+        """This process's device-truth plane
+        (runtime/device_profiler.py): state without `?ms=`, one bounded
+        jax.profiler capture with `?ms=N` — same payload shape as the
+        worker StatusServer route, so tooling treats every process
+        uniformly.  (Worker captures ride the workers' own status
+        ports or the control-plane `profile/<pid>` command; this route
+        covers frontend-side device work.)"""
+        import asyncio
+
+        from dynamo_tpu.runtime import device_profiler
+
+        prof = device_profiler.get_profiler()
+        ms_raw = req.query.get("ms")
+        if ms_raw is None:
+            return web.json_response(prof.debug_payload())
+        try:
+            ms = int(ms_raw)
+            if ms <= 0:
+                raise ValueError
+        except ValueError:
+            return self._error(400, "ms must be a positive integer")
+        res = await asyncio.to_thread(prof.capture, ms)
+        return web.json_response(res, status=200 if res.get("ok") else 503)
 
     async def debug_slo(self, _req: web.Request) -> web.Response:
         """Current SLO burn-rate evaluation over this frontend's request

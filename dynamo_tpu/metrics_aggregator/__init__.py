@@ -46,6 +46,14 @@ _PHASE_RE = re.compile(
     r'^dynamo_request_phase_seconds_(sum|count)'
     r'\{[^}]*phase="([^"]+)"[^}]*\}\s+([0-9.eE+-]+)')
 
+# Device-truth drift series scraped from workers (ISSUE 20): the
+# per-series modeled-vs-measured ratio and the XLA cost-registry size.
+_DRIFT_RE = re.compile(
+    r'^dynamo_modeled_vs_measured_ratio'
+    r'\{[^}]*series="([^"]+)"[^}]*\}\s+([0-9.eE+-]+)')
+_REGISTRY_SIZE_RE = re.compile(
+    r'^dynamo_program_registry_size\s+([0-9.eE+-]+)')
+
 
 class MetricsAggregator:
     """Subscribes, aggregates, exposes — and scrapes advertised status
@@ -114,6 +122,22 @@ class MetricsAggregator:
             "goodput_ratio",
             "fleet goodput: SLO-good tokens / total tokens (0 when no "
             "tokens yet)")
+        # Device-truth drift (ISSUE 20): workers audit their analytical
+        # model (KV-byte accounting, roofline time) against XLA's
+        # per-program cost analysis and expose
+        # dynamo_modeled_vs_measured_ratio{series=}.  Merge semantics
+        # are MEAN per series: the ratio is already a normalized
+        # per-worker quantity (modeled/measured), so summing would scale
+        # with fleet size while a mean stays comparable to the
+        # per-worker drift band.  Registry sizes SUM — distinct workers
+        # compile distinct program sets.
+        self._g_drift_ratio = self.registry.gauge(
+            "modeled_vs_measured_ratio",
+            "mean modeled-vs-measured drift ratio across workers "
+            "(label series=; >1 = the analytical model over-claims)")
+        self._g_registry_size = self.registry.gauge(
+            "program_registry_size",
+            "XLA cost-registry programs summed across workers")
 
     async def start(self) -> None:
         await self._watcher.start()
@@ -264,6 +288,7 @@ class MetricsAggregator:
         usages = [m.kv_stats.gpu_cache_usage_perc for m in fresh.values()]
         self._g_usage.set(sum(usages) / len(usages) if usages else 0.0)
         self._refresh_ledger_gauges()
+        self._refresh_drift_gauges()
 
     def _refresh_ledger_gauges(self) -> None:
         """Sum the frontends' ledger series into the fleet aggregates.
@@ -307,6 +332,35 @@ class MetricsAggregator:
         self._g_goodput_good.set(good)
         self._g_goodput_total.set(total)
         self._g_goodput.set(good / total if total > 0 else 0.0)
+
+    def _refresh_drift_gauges(self) -> None:
+        """Pre-sum the workers' device-truth drift series into fleet
+        aggregates (dashboards alert on ONE series, not per-worker
+        fan-out).  Ratios average per series; registry sizes sum.  Works
+        off the raw scraped texts like the ledger gauges — the drift
+        series live on the WORKER registries."""
+        ratios: Dict[str, list] = {}
+        registry_total = 0.0
+        for entry in self._scraped.values():
+            for line in entry["text"].splitlines():
+                m = _DRIFT_RE.match(line)
+                if m:
+                    try:
+                        ratios.setdefault(m.group(1), []).append(
+                            float(m.group(2)))
+                    except ValueError:
+                        continue
+                    continue
+                m = _REGISTRY_SIZE_RE.match(line)
+                if m:
+                    try:
+                        registry_total += float(m.group(1))
+                    except ValueError:
+                        continue
+        for series, vals in ratios.items():
+            self._g_drift_ratio.set(sum(vals) / len(vals),
+                                    labels={"series": series})
+        self._g_registry_size.set(registry_total)
 
     @staticmethod
     def _relabel(text: str, addr: str, seen_meta: set) -> str:

@@ -306,9 +306,12 @@ class EngineStepCounters:
         # for it.
         self.on_recompile: Optional[Callable] = None
 
-    def note_dispatch(self, tag: str, *sig) -> None:
+    def note_dispatch(self, tag: str, *sig) -> bool:
         """Record a jitted-program dispatch; a first-seen (tag, sig)
-        counts as an XLA cache miss (a new shape compiles)."""
+        counts as an XLA cache miss (a new shape compiles).  Returns
+        True exactly on first-seen — the dispatch site uses it to feed
+        the device-profiler's compile-time cost-analysis harvest
+        without any steady-state branch cost."""
         key = (tag,) + sig
         if key not in self._seen_shapes:
             self._seen_shapes.add(key)
@@ -316,6 +319,8 @@ class EngineStepCounters:
             cb = self.on_recompile
             if cb is not None:
                 cb(key)
+            return True
+        return False
 
     def note_kv_read(self, nbytes: int, tokens: int) -> None:
         """Tally modeled decode KV traffic (bytes swept) and the tokens

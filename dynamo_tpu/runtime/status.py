@@ -136,6 +136,8 @@ class StatusServer:
         app.router.add_get("/debug/slo", self._debug_slo)
         app.router.add_get("/debug/flightrecorder",
                            self._debug_flightrecorder)
+        app.router.add_get("/debug/deviceprofile",
+                           self._debug_deviceprofile)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, host, port)
@@ -188,6 +190,31 @@ class StatusServer:
                                      status=400)
         return web.json_response(
             flight_recorder.get_recorder().debug_payload(n))
+
+    async def _debug_deviceprofile(self, req: web.Request) -> web.Response:
+        """Device-truth plane (runtime/device_profiler.py).  Without
+        `?ms=` it reports the plane's state (program registry, drift
+        band states, capture history); with `?ms=N` it runs one bounded
+        jax.profiler capture on this live process — off the event loop
+        (asyncio.to_thread: the capture sleeps for its bound while the
+        serving threads keep dispatching) — and returns what landed."""
+        import asyncio
+
+        from dynamo_tpu.runtime import device_profiler
+
+        prof = device_profiler.get_profiler()
+        ms_raw = req.query.get("ms")
+        if ms_raw is None:
+            return web.json_response(prof.debug_payload())
+        try:
+            ms = int(ms_raw)
+            if ms <= 0:
+                raise ValueError
+        except ValueError:
+            return web.json_response(
+                {"error": "ms must be a positive integer"}, status=400)
+        res = await asyncio.to_thread(prof.capture, ms)
+        return web.json_response(res, status=200 if res.get("ok") else 503)
 
     async def _debug_slo(self, _req: web.Request) -> web.Response:
         """Current SLO burn-rate evaluation (runtime/slo.py) — same

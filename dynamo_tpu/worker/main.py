@@ -227,6 +227,7 @@ def parse_args(argv=None):
                    help="grace after the last stream handoff for peers "
                         "to OPEN their KV pulls before the worker "
                         "starts watching for zero active streams")
+    from dynamo_tpu.runtime.device_profiler import add_device_profiler_args
     from dynamo_tpu.runtime.flight_recorder import add_flight_args
     from dynamo_tpu.runtime.ledger import add_ledger_args
     from dynamo_tpu.runtime.slo import add_slo_args
@@ -236,6 +237,7 @@ def parse_args(argv=None):
     add_slo_args(p)
     add_flight_args(p)
     add_ledger_args(p)
+    add_device_profiler_args(p)
     apply_to_parser_defaults(p, load_layered_config(
         {"control_plane": None, "namespace": "dynamo",
          "component": "backend", "endpoint": "generate",
@@ -507,6 +509,14 @@ async def run(args) -> None:
     recorder = flight_recorder.configure_from_args(
         args, service=f"worker-{args.component}")
     recorder.install_crash_dump()
+    # Device-truth plane (ISSUE 20): the XLA cost-analysis harvest must
+    # be live BEFORE the engine builds — prewarmed prefill shapes and
+    # startup compiles are first-seen exactly once and must land in the
+    # program registry.  Captures write next to the flight dumps.
+    from dynamo_tpu.runtime import device_profiler
+
+    device_profiler.configure_from_args(
+        args, service=f"worker-{args.component}")
     # Request ledger (ISSUE 18): hop ledgers only start when BOTH this
     # switch is on AND the incoming request carries the frontend's
     # ledger annotation.
@@ -810,6 +820,15 @@ async def run(args) -> None:
             # reasons): a fleet silently degraded to host staging shows
             # up here and in `dynamo top`'s PLANE column.
             kv_metrics.observe_transfer_plane()
+            # Device-truth plane (ISSUE 20): fold modeled counters
+            # against the XLA cost registry at scrape time (host floats
+            # only — the engine thread never participates), then export
+            # the program registry + drift ratios.
+            prof = device_profiler.get_profiler()
+            if prof.enabled:
+                if core is not None:
+                    prof.audit_engine(core)
+                lines.extend(prof.metrics_lines())
             return "\n".join(lines) + "\n"
 
         status = StatusServer(
@@ -922,12 +941,51 @@ async def run(args) -> None:
 
     drain_watch = (asyncio.create_task(watch_drain_commands())
                    if args.drain != "off" else None)
+
+    async def watch_profile_commands():
+        """The control-plane `profile` command: a put under
+        profile/<pid> or profile/instance/<id> runs one bounded device
+        capture on this worker (value: capture ms, default 500) — the
+        operator surface for boxes where /debug/deviceprofile isn't
+        reachable.  Loops: one worker serves many captures."""
+        import os as _os
+
+        from dynamo_tpu.runtime.device_profiler import (
+            PROFILE_PREFIX, profile_key_instance, profile_key_pid)
+
+        mine = {profile_key_pid(_os.getpid()),
+                profile_key_instance(instance.instance_id)}
+        prof = device_profiler.get_profiler()
+        try:
+            watch = await cp.watch_prefix(PROFILE_PREFIX)
+            async for ev in watch:
+                if ev.kind != "put" or ev.key not in mine:
+                    continue
+                try:
+                    ms = int(ev.value)
+                except (TypeError, ValueError):
+                    ms = 500
+                logger.warning("control-plane profile command: %s "
+                               "(%d ms)", ev.key, ms)
+                # to_thread: the capture sleeps for its bound; the
+                # worker's event loop must keep serving under it.
+                res = await asyncio.to_thread(prof.capture, ms)
+                logger.warning("device capture result: %s",
+                               {k: res.get(k)
+                                for k in ("ok", "dir", "error")})
+        except (ConnectionError, asyncio.CancelledError):
+            return  # cp gone / shutdown: /debug/deviceprofile remains
+
+    profile_watch = (asyncio.create_task(watch_profile_commands())
+                     if device_profiler.get_profiler().enabled else None)
     await stop_ev.wait()
 
     # Graceful drain: leave routing instantly, finish in-flight streams
     # (already done — and bounded — when start_drain ran).
     if drain_watch is not None:
         drain_watch.cancel()
+    if profile_watch is not None:
+        profile_watch.cancel()
     await endpoint.leave()
     stream_deadline = loop.time() + max(5.0, args.drain_timeout_s)
     while runtime.rpc.active_streams > 0 and loop.time() < stream_deadline:

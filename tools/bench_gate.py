@@ -279,6 +279,78 @@ def flight_recorder_overhead_checks() -> dict:
     }
 
 
+def device_truth_checks() -> dict:
+    """ISSUE 20: the device-truth plane must be FREE and HONEST.
+
+    Free — a steady decode window with the profiler ENABLED produces
+    EngineStepCounters deltas byte-identical to profiler-off: the
+    cost-analysis harvest rides first-seen shapes only (the compile
+    event), never the steady window.  Honest — the harvest lands real
+    programs in the cost registry, the drift audit's modeled-vs-measured
+    ratios sit INSIDE the one-sided band on the CPU tiny model (modeled
+    KV bytes are a component of XLA's totals, so the honest ratio is
+    well under 1), and a FABRICATED 2x modeled over-claim must drive the
+    auditor to PAGE after its strike budget — the gate this plane exists
+    to provide."""
+    from dynamo_tpu.engine.engine import EngineConfig, EngineCore
+    from dynamo_tpu.engine.sampling import SamplingParams
+    from dynamo_tpu.engine.scheduler import SchedulerConfig
+    from dynamo_tpu.models import config as mcfg
+    from dynamo_tpu.runtime import device_profiler
+
+    prof = device_profiler.get_profiler()
+
+    def steady_run():
+        core = EngineCore(EngineConfig(
+            model=mcfg.get_config("tiny-test"), num_blocks=128,
+            enable_prefix_cache=False, decode_window=2,
+            window_pipeline_depth=2,
+            scheduler=SchedulerConfig(
+                max_seqs=8, block_size=8, max_pages_per_seq=32,
+                max_prefill_chunk=128, decode_buckets=(1, 2, 4, 8),
+                prefill_buckets=(16, 128))))
+        core.add_request("a", list(range(1, 71)),
+                         SamplingParams(max_tokens=64))
+        for _ in range(8):   # prefill + window warmup (harvests land)
+            core.step()
+        base = core.counters.snapshot()
+        for _ in range(20):
+            core.step()
+        return core, core.counters.delta(base)
+
+    try:
+        prof.reset()
+        prof.enabled = False
+        _, d_off = steady_run()
+        prof.configure(enabled=True)
+        core_on, d_on = steady_run()
+        registry_size = prof.registry.size()
+        ratios = prof.audit_engine(core_on)
+        states = prof.auditor.states()
+        in_band = bool(ratios) and all(
+            st["state"] == "ok" for st in states.values())
+        # The drift band must have teeth: an accounting bug that
+        # over-claims modeled bytes 2x (the PR-16 int8 scale-pack
+        # double-count class) must strike out and PAGE.
+        fab = device_profiler.DriftAuditor()
+        for _ in range(device_profiler.PAGE_STRIKES):
+            fab.observe("kv_decode", modeled=2.0, measured=1.0)
+    finally:
+        # Never leak an enabled profiler into the other smoke checks.
+        prof.enabled = False
+        prof.reset()
+
+    return {
+        "device_truth_counters_byte_identical": d_on == d_off,
+        "device_truth_registry_programs": registry_size,
+        "device_truth_registry_nonempty": registry_size > 0,
+        "device_truth_ratios": {k: round(v, 4)
+                                for k, v in sorted(ratios.items())},
+        "device_truth_ratios_in_band": in_band,
+        "device_truth_overclaim_pages": fab.paged(),
+    }
+
+
 def ledger_checks() -> dict:
     """ISSUE 18: the request ledger must be HONEST and FREE.
 
@@ -826,6 +898,12 @@ def run_smoke(args) -> int:
        fabricated ledger claiming more time than the wall-clock
        envelope FAILS coverage_ok, and ledger-on steady decode keeps
        EngineStepCounters deltas byte-identical to ledger-off;
+    7d. device-truth plane (ISSUE 20): profiler-on steady decode keeps
+       EngineStepCounters deltas byte-identical to profiler-off, the
+       compile-time harvest lands a non-empty XLA cost registry, the
+       drift audit's modeled-vs-measured ratios sit inside the band on
+       CPU, a fabricated 2x modeled over-claim drives the auditor to
+       PAGE, and the new TPU floor fails a fabricated over-claiming run;
     8. decode-bandwidth-wall features (ISSUE 6): int8-KV traffic ratio
        <= 0.55 at serving geometry, tiny-model greedy pin bf16 == int8,
        spec-decode acceptance >= 0.6 + modeled sweep speedup >= 1.3 on
@@ -950,7 +1028,8 @@ def run_smoke(args) -> int:
                                 "token_parity": True},
                     ring_plane={"kernel_vs_xla": 1.6,
                                 "numeric_parity": True},
-                    transfer={"device_vs_host_ratio": 3.4})
+                    transfer={"device_vs_host_ratio": 3.4},
+                    device_truth={"modeled_vs_measured_kv": 0.95})
     tpu_low_mbu = dict(tpu_good, mbu=0.60)
     tpu_interfered = dict(
         tpu_good, mixed_prefill_decode={"interference_ratio": 0.70})
@@ -1007,6 +1086,11 @@ def run_smoke(args) -> int:
     # ratio at the bench.
     tpu_slow_transfer = dict(
         tpu_good, transfer={"device_vs_host_ratio": 0.8})
+    # ISSUE-20 floor: a modeled series claiming 2x the bytes XLA says
+    # the decode programs actually touch (the accounting-over-claim bug
+    # class the drift auditor pages on) must fail.
+    tpu_drift_overclaim = dict(
+        tpu_good, device_truth={"modeled_vs_measured_kv": 2.0})
 
     from dynamo_tpu.bench.disagg import run_disagg_ttft_model
 
@@ -1044,6 +1128,8 @@ def run_smoke(args) -> int:
                                                    tpu_ring_slow).ok,
         "slow_device_transfer_fails": not gate.compare(
             tpu_slow_transfer, tpu_slow_transfer).ok,
+        "drift_overclaim_fails": not gate.compare(
+            tpu_drift_overclaim, tpu_drift_overclaim).ok,
         "disagg_ttft_serial_ms": round(disagg["ttft_serial_s"] * 1e3, 1),
         "disagg_ttft_streamed_ms": round(
             disagg["ttft_streamed_s"] * 1e3, 1),
@@ -1054,6 +1140,7 @@ def run_smoke(args) -> int:
         **tracing_overhead_checks(),
         **telemetry_overhead_checks(),
         **flight_recorder_overhead_checks(),
+        **device_truth_checks(),
         **ledger_checks(),
         **decode_wall_checks(),
         **moe_decode_checks(),

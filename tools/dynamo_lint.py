@@ -36,7 +36,10 @@ Rules:
          reads.  f-strings, %-formatting, container displays,
          comprehensions, call expressions and deep attribute chains
          allocate/format on the hot path and are rejected; do the
-         formatting at dump time, not per step
+         formatting at dump time, not per step.  The same contract
+         covers the request ledger's `.stamp(...)` and the
+         device-truth plane's `.record(...)`/`.observe(...)` on
+         profiler/auditor receivers (runtime/device_profiler.py)
 
 Suppression: append `# dynamo-lint: disable=DL003 <reason>` to the
 flagged line (or put it on its own line immediately above).  Multiple
@@ -628,14 +631,27 @@ class FlightRecorderDiscipline(Rule):
     recognized as flight recorders: any `*.record(...)` whose receiver
     chain ends in `flight`, `recorder`, `flight_recorder`, or the
     conventional local alias `fl`; as ledgers: `.stamp(...)` on
-    `ledger`, `led`, `hop`, or `request_ledger`."""
+    `ledger`, `led`, `hop`, or `request_ledger`.
+
+    The device-truth plane (runtime/device_profiler.py) makes the same
+    no-formatting promise for its hot-path-adjacent entry points, so
+    the rule also covers `.record(...)` on profiler/registry receivers
+    (`profiler`, `prof`, `device_profiler`, `registry`) and
+    `.observe(...)` on drift-auditor receivers (`auditor`, `drift`,
+    `drift_auditor`) — an f-string program label built per step inside
+    `@hot_path` would defeat the zero-steady-state-cost design."""
 
     code = "DL006"
     name = "flight-recorder-hot-path-args"
 
-    RECEIVERS = frozenset({"flight", "recorder", "flight_recorder", "fl"})
+    RECEIVERS = frozenset({"flight", "recorder", "flight_recorder", "fl",
+                           # device-truth plane: ProgramCostRegistry
+                           # .record on the profiler / registry objects
+                           "profiler", "prof", "device_profiler",
+                           "registry"})
     LEDGER_RECEIVERS = frozenset({"ledger", "led", "hop",
                                   "request_ledger"})
+    AUDITOR_RECEIVERS = frozenset({"auditor", "drift", "drift_auditor"})
     MAX_ATTR_PARTS = 3        # self.x.y is a slot read; deeper is a smell
 
     def _is_recorder_call(self, call: ast.Call) -> bool:
@@ -646,6 +662,10 @@ class FlightRecorderDiscipline(Rule):
             receivers = self.RECEIVERS
         elif f.attr == "stamp":
             receivers = self.LEDGER_RECEIVERS
+        elif f.attr == "observe":
+            # DriftAuditor.observe — receiver-gated so plain metric
+            # Histogram.observe on other receivers stays out of scope.
+            receivers = self.AUDITOR_RECEIVERS
         else:
             return False
         recv = f.value
@@ -708,8 +728,9 @@ class FlightRecorderDiscipline(Rule):
                         or not self._is_recorder_call(node):
                     continue
                 exprs = list(node.args) + [kw.value for kw in node.keywords]
-                what = ("ledger stamp" if node.func.attr == "stamp"
-                        else "FlightRecorder.record")
+                what = {"stamp": "ledger stamp",
+                        "observe": "DriftAuditor.observe"}.get(
+                            node.func.attr, "FlightRecorder.record")
                 for expr in exprs:
                     why = self._arg_problem(expr)
                     if why is not None:

@@ -257,6 +257,17 @@ def summarize(component: str, address: str, samples: List[Sample],
     row["dominant_phase"] = (
         max(phase_sums, key=phase_sums.get)
         if phase_sums and max(phase_sums.values()) > 0 else None)
+    # Device-truth plane (ISSUE 20): modeled-vs-measured drift ratios
+    # per series plus the XLA cost-registry size — the DRIFT column.
+    # A ratio creeping toward the band ceiling means the analytical
+    # model (roofline math, KV-byte accounting) is drifting from what
+    # XLA says the compiled programs actually do.
+    row["drift_ratios"] = {
+        labels["series"]: v
+        for n, labels, v in samples
+        if n == "dynamo_modeled_vs_measured_ratio" and "series" in labels}
+    row["program_registry_size"] = total(
+        samples, "dynamo_program_registry_size")
     return row
 
 
@@ -441,6 +452,23 @@ def _fmt_why(r: dict) -> str:
     return f"{phase or '—'} {g}"
 
 
+def _fmt_drift(r: dict) -> str:
+    """DRIFT cell: worst modeled-vs-measured ratio across audited
+    series + the program-count of the XLA cost registry.  The ratio is
+    modeled/measured, so >1 means the analytical model OVER-claims
+    versus device truth (the drift auditor pages past its band).
+    Processes without the device-truth plane render the no-data dash."""
+    ratios = r.get("drift_ratios") or {}
+    size = r.get("program_registry_size")
+    if not ratios and size is None:
+        return "—"
+    n = "—" if size is None else str(int(size))
+    if not ratios:
+        return f"—/{n}p"
+    worst = max(ratios.values())
+    return f"{worst:.2f}/{n}p"
+
+
 def _fmt_mesh(r: dict) -> str:
     """MESH cell from the worker's published SliceSpec: the mesh shape
     (`describe()` string), suffixed :P / :D for a dedicated
@@ -488,6 +516,9 @@ COLUMNS = (
     ("QOS/DRN", 8, _fmt_qos_drain),
     # MoE expert-load plane: active/total experts, imbalance, drops.
     ("EXP", 11, _fmt_exp),
+    # Device-truth drift: worst modeled/measured ratio + XLA cost
+    # registry size.  >1 = the model over-claims vs compiled reality.
+    ("DRIFT", 9, _fmt_drift),
     # How far from the profiled saturation knee (--profile): 100% idle,
     # 0% at the knee, negative past it.
     ("HEADRM", 7, lambda r: _fmt(r.get("capacity_headroom"), "pct")),

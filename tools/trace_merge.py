@@ -42,6 +42,21 @@ list of ledger payloads).  Each phase stamp becomes a complete
 request ids ARE frontend trace ids, so the stamps land time-aligned
 under the request's own spans; requests without a trace get a `ledger`
 process lane.  Duplicate ledgers across dumps dedupe by request id.
+
+On-demand device captures (ISSUE 20) merge the same way:
+
+    python tools/trace_merge.py http://127.0.0.1:8080 \
+        --device /tmp/deviceprofile_worker-backend_12345 -o merged.json
+
+where the directory is what `/debug/deviceprofile?ms=N` (or the
+control-plane `profile/<pid>` command) wrote: jax.profiler's Chrome
+trace (`*.trace.json.gz`) plus the `capture_meta.json` sidecar
+runtime/device_profiler.py drops next to it.  Device lanes (one per
+XLA device/stream) land as their own process tracks named after the
+owning worker service, with timestamps re-anchored from the sidecar's
+wall clock — so host spans (ledger phases, flight markers) and the
+device execution they paid for line up on one timeline.  Re-merging
+the same capture dedupes by (service, lane, ts, name).
 """
 
 from __future__ import annotations
@@ -251,6 +266,114 @@ def merge_ledger_spans(merged: dict, ledgers: List[dict]) -> int:
     return added
 
 
+def load_device_capture(capture_dir: str) -> List[dict]:
+    """Parse one device-capture directory (device_profiler.capture
+    output) into per-trace-file dicts: {"service", "wall_start",
+    "events": [...]}.  Service/pid come from the capture_meta.json
+    sidecar when present, else from the deviceprofile_<service>_<pid>
+    directory name; malformed or missing trace files are skipped — a
+    partial capture must still merge."""
+    import glob as globmod
+    import gzip
+
+    meta = {}
+    meta_path = os.path.join(capture_dir, "capture_meta.json")
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            meta = {}
+    service = meta.get("service")
+    if not service:
+        base = os.path.basename(os.path.normpath(capture_dir))
+        if base.startswith("deviceprofile_"):
+            # deviceprofile_<service>_<pid> — the pid is the last part.
+            service = base[len("deviceprofile_"):].rsplit("_", 1)[0]
+        else:
+            service = base or "device"
+    out: List[dict] = []
+    for path in sorted(globmod.glob(
+            os.path.join(capture_dir, "**", "*.trace.json.gz"),
+            recursive=True)):
+        try:
+            with gzip.open(path, "rt") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            print(f"warning: skipping unreadable device trace {path}",
+                  file=sys.stderr)
+            continue
+        events = (doc.get("traceEvents") if isinstance(doc, dict)
+                  else doc)
+        if not isinstance(events, list):
+            continue
+        out.append({"service": service,
+                    "wall_start": meta.get("wall_start"),
+                    "events": events})
+    return out
+
+
+def merge_device_events(merged: dict, captures: List[dict]) -> int:
+    """Append device-capture trace events to a Chrome trace doc.  Each
+    device lane (a pid in the capture's own numbering) becomes a fresh
+    process track named `<service> device/<lane name>` so the capture
+    sits visually next to the owning worker's host lanes.  The
+    profiler's timestamps are relative to trace start — the sidecar's
+    `wall_start` re-anchors them onto the shared wall clock the host
+    spans use (captures without a sidecar merge un-anchored, still
+    inspectable).  Dedupes by (service, lane, tid, ts, name, ph) so
+    re-merging a capture adds nothing.  Returns events added."""
+    events = merged["traceEvents"]
+    max_pid = max((ev.get("pid", 0) for ev in events), default=0)
+    seen: set = set()
+    added = 0
+    for cap in captures:
+        service = cap["service"]
+        offset_us = (float(cap["wall_start"]) * 1e6
+                     if cap.get("wall_start") else 0.0)
+        lane_names: Dict[int, str] = {}
+        for ev in cap["events"]:
+            if (ev.get("ph") == "M"
+                    and ev.get("name") == "process_name"):
+                name = (ev.get("args") or {}).get("name")
+                if name is not None:
+                    lane_names[ev.get("pid", 0)] = str(name)
+        lane_pids: Dict[int, int] = {}
+        for ev in cap["events"]:
+            ph = ev.get("ph")
+            if ph == "M":
+                continue    # lane metadata re-emitted below, renamed
+            if not ph:
+                continue    # jax emits degenerate phase-less rows
+                            # (nothing to render, nothing to anchor)
+            try:
+                ts = float(ev.get("ts", 0.0)) + offset_us
+            except (TypeError, ValueError):
+                continue
+            lane = ev.get("pid", 0)
+            key = (service, lane, ev.get("tid", 0), round(ts, 3),
+                   ev.get("name"), ph)
+            if key in seen:
+                continue
+            seen.add(key)
+            pid = lane_pids.get(lane)
+            if pid is None:
+                max_pid += 1
+                pid = lane_pids[lane] = max_pid
+                lane_name = lane_names.get(lane, f"lane {lane}")
+                events.append({
+                    "name": "process_name", "ph": "M", "pid": pid,
+                    "tid": 0,
+                    "args": {"name": f"{service} device/{lane_name}"}})
+            row = dict(ev)
+            row["ts"] = round(ts, 3)
+            row["pid"] = pid
+            row.setdefault("cat", "device")
+            events.append(row)
+            added += 1
+    return added
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         "tools/trace_merge.py", description=__doc__.splitlines()[0])
@@ -273,6 +396,15 @@ def main(argv=None) -> int:
                         "(runtime/ledger.py) — each request's phase "
                         "stamps render as child spans on its own trace "
                         "track, deduped by request id; repeatable")
+    p.add_argument("--device", action="append", default=[],
+                   metavar="CAPTURE_DIR",
+                   help="device-capture directory(ies) written by "
+                        "/debug/deviceprofile?ms=N "
+                        "(runtime/device_profiler.py) — jax.profiler's "
+                        "device lanes merge as process tracks named "
+                        "after the owning worker, re-anchored to the "
+                        "wall clock via the capture_meta.json sidecar; "
+                        "repeatable")
     args = p.parse_args(argv)
 
     payloads = []
@@ -302,12 +434,22 @@ def main(argv=None) -> int:
             print(f"warning: skipping ledger dump {lpath}: {e}",
                   file=sys.stderr)
     n_ledger = merge_ledger_spans(merged, ledgers) if ledgers else 0
-    n_spans = sum(1 for ev in merged["traceEvents"] if ev["ph"] == "X")
+    captures: List[dict] = []
+    for dpath in args.device:
+        try:
+            captures.extend(load_device_capture(dpath))
+        except OSError as e:
+            print(f"warning: skipping device capture {dpath}: {e}",
+                  file=sys.stderr)
+    n_device = merge_device_events(merged, captures) if captures else 0
+    n_spans = sum(1 for ev in merged["traceEvents"] if ev.get("ph") == "X")
     with open(args.out, "w") as f:
         json.dump(merged, f)
     extra = f" + {n_flight} flight event(s)" if n_flight else ""
     if n_ledger:
         extra += f" + {n_ledger} ledger span(s)"
+    if n_device:
+        extra += f" + {n_device} device event(s)"
     print(f"wrote {args.out}: {n_spans} spans from {len(payloads)} "
           f"process(es){extra} — open in https://ui.perfetto.dev")
     return 0
